@@ -1,0 +1,20 @@
+"""mxnet_tpu: a TPU-native deep learning framework with the capability
+surface of Apache MXNet ≈1.2 (reference: yangyu12/incubator-mxnet).
+
+Not a port: the compute path is JAX/XLA (MXU matmuls/convs, XLA fusion, ICI
+collectives via pjit/shard_map), with Pallas kernels for hot non-standard ops;
+the host runtime (dependency engine, data pipeline, KVStore façade) keeps the
+reference's contracts.  See SURVEY.md for the blueprint.
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+
+from .ndarray import NDArray

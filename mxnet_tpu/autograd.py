@@ -1,0 +1,316 @@
+"""Imperative autograd: record scopes + tape + backward.
+
+Reference analog: ``src/imperative/imperative.cc`` (``Imperative::{RecordOp,
+MarkVariables,Backward}``), the ``AGInfo`` tape stamped on NNVM nodes
+(``include/mxnet/imperative.h:42-66``), and the Python face
+``python/mxnet/autograd.py`` (record/pause/train_mode/predict_mode scopes,
+``backward``, ``grad``).
+
+TPU-native design: instead of replaying an NNVM gradient graph, each recorded
+op call captures a ``jax.vjp`` closure (per-op VJP, the FGradient analog);
+``backward`` walks the tape in reverse topological order accumulating
+cotangents.  The user API is identical: ``with autograd.record(): ...;
+loss.backward(); x.grad``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    old, st.recording = st.recording, flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    old, st.training = st.training, flag
+    return old
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """Scope: record ops for autograd (ref autograd.py:122)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# --------------------------------------------------------------------------
+# tape
+# --------------------------------------------------------------------------
+class TapeNode:
+    """One recorded op call (the AGInfo analog)."""
+
+    __slots__ = ("vjp_fn", "in_entries", "n_out", "op_name", "saved")
+
+    def __init__(self, vjp_fn, in_entries, n_out, op_name):
+        self.vjp_fn = vjp_fn
+        # per op-input: (TapeNode, out_idx) | NDArray leaf-with-grad | None
+        self.in_entries = in_entries
+        self.n_out = n_out
+        self.op_name = op_name
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (ref: Imperative::MarkVariables)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._ag_leaf = True
+        v._ag_entry = None  # reset any prior tape link
+
+
+def _entry_of(arr):
+    """Tape entry for an NDArray input: tape link, leaf, or None."""
+    e = getattr(arr, "_ag_entry", None)
+    if e is not None:
+        return e
+    if getattr(arr, "_ag_leaf", False):
+        return arr
+    return None
+
+
+def record_op(op_name, vjp_fn, in_arrays, out_arrays):
+    """Called by the dispatch layer for each op executed under record()."""
+    entries = [_entry_of(a) for a in in_arrays]
+    if all(e is None for e in entries):
+        return
+    node = TapeNode(vjp_fn, entries, len(out_arrays), op_name)
+    for i, o in enumerate(out_arrays):
+        o._ag_entry = (node, i)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (ref: Imperative::Backward, imperative.cc:270-470)."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # per-leaf accumulation for THIS pass; grad_req applied at the end
+    # (within one backward, contributions from multiple paths always sum —
+    # reference semantics; grad_req governs behavior across backward calls)
+    leaf_acc: Dict[int, Tuple[object, jax.Array]] = {}
+
+    def _leaf_accumulate(arr, g):
+        prev = leaf_acc.get(id(arr))
+        leaf_acc[id(arr)] = (arr, g if prev is None else prev[1] + g)
+
+    # seed cotangents
+    cotangents: Dict[Tuple[int, int], jax.Array] = {}
+    nodes: Dict[int, TapeNode] = {}
+    roots: List[TapeNode] = []
+    for h, hg in zip(heads, head_grads):
+        entry = getattr(h, "_ag_entry", None)
+        if entry is None:
+            if getattr(h, "_ag_leaf", False):
+                g = jnp.ones_like(h._data) if hg is None else hg._data
+                _leaf_accumulate(h, g)
+                continue
+            raise MXNetError("cannot differentiate: head is not connected "
+                             "to any recorded computation")
+        node, idx = entry
+        g = jnp.ones_like(h._data) if hg is None else hg._data
+        key = (id(node), idx)
+        cotangents[key] = cotangents.get(key, 0) + g
+        nodes[id(node)] = node
+        roots.append(node)
+
+    # topological order over the tape DAG (iterative DFS postorder)
+    order: List[TapeNode] = []
+    visited = set()
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for e in node.in_entries:
+            if isinstance(e, tuple):
+                parent = e[0]
+                if id(parent) not in visited:
+                    stack.append((parent, False))
+
+    # reverse-topological cotangent propagation
+    for node in reversed(order):
+        outs = []
+        missing = True
+        for i in range(node.n_out):
+            g = cotangents.get((id(node), i))
+            outs.append(g)
+            if g is not None:
+                missing = False
+        if missing:
+            continue
+        outs = [g if g is not None else None for g in outs]
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "gradient graph has already been freed by a previous "
+                "backward(); pass retain_graph=True to backward() if you "
+                "need to differentiate through shared subgraphs twice")
+        in_grads = node.vjp_fn(outs)
+        for e, g in zip(node.in_entries, in_grads):
+            if e is None or g is None:
+                continue
+            if isinstance(e, tuple):
+                pnode, pidx = e
+                key = (id(pnode), pidx)
+                prev = cotangents.get(key)
+                cotangents[key] = g if prev is None else prev + g
+            else:  # leaf NDArray
+                _leaf_accumulate(e, g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    # apply grad_req once per leaf
+    for arr, g in leaf_acc.values():
+        req = getattr(arr, "_grad_req", "write")
+        if req == "null" or arr._grad is None:
+            continue
+        if req == "add":
+            arr._grad._data = arr._grad._data + g.astype(arr._grad.dtype)
+        else:
+            arr._grad._data = g.astype(arr._grad.dtype)
+
+    if not retain_graph:
+        for h in heads:
+            if getattr(h, "_ag_entry", None) is not None:
+                h._ag_entry = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (ref autograd.py:grad).  Returns grads of
+    heads w.r.t. variables without touching .grad buffers."""
+    from .ndarray import ndarray as _nd
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order imperative grad) "
+                         "is not supported; use hybridized blocks + jax.grad")
+    single = not isinstance(variables, (list, tuple))
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", None),
+              getattr(v, "_ag_leaf", False)) for v in variables]
+    for v in variables:
+        if not getattr(v, "_ag_leaf", False):
+            raise MXNetError("variables passed to grad() must have been "
+                             "marked (attach_grad) before recording")
+        v._grad = _nd.zeros(v.shape, dtype=v.dtype, ctx=v.context)
+        v._grad_req = "add"
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    out = [v._grad for v in variables]
+    for v, (g, req, leaf) in zip(variables, saved):
+        v._grad, v._grad_req, v._ag_leaf = g, req, leaf
+    return out[0] if single else out
+
+
+class Function:
+    """Custom differentiable function (ref autograd.py:363 Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` using NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, array as _array
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def vjp(cots):
+                grads_in = fn.backward(*[
+                    _array(c) if c is not None else None for c in cots])
+                if not isinstance(grads_in, (list, tuple)):
+                    grads_in = [grads_in]
+                return [g._data if g is not None else None for g in grads_in]
+
+            record_op(type(self).__name__, vjp, list(inputs), outs)
+        return outs[0] if single else outs

@@ -1,0 +1,265 @@
+"""Dependency-scheduling engine, TPU-native.
+
+Re-design of the reference dependency engine (``src/engine/threaded_engine.{h,cc}``,
+``threaded_engine_perdevice.cc``, ``naive_engine.cc``; interface
+``include/mxnet/engine.h:134-213``).
+
+Division of labor on TPU: *device* asynchrony (kernel launch, overlap of
+compute with ICI collectives and HBM traffic) is owned by XLA/PjRt — every op
+dispatched through JAX is already async and ordered per-buffer by the runtime,
+so NDArray compute does NOT need a host scheduler to be parallel.  What still
+needs the reference's var-dependency protocol is *host-side* work: data
+pipeline decode/augment, KVStore host reductions, checkpoint writes, custom
+Python ops — anything that must overlap with device compute while respecting
+read/write ordering on shared state.  This module keeps the reference Engine
+contract (NewVariable / NewOperator / Push / WaitForVar / WaitForAll, plus
+async exception propagation, SURVEY.md §5.2) for that host-side work, with the
+same two personalities:
+
+- ``NaiveEngine``: synchronous, deterministic (``MXNET_ENGINE_TYPE=NaiveEngine``
+  debug mode, reference ``engine.cc:40``).
+- ``ThreadedEngine``: a thread pool executing ops when their var deps resolve,
+  the analog of ``ThreadedEnginePerDevice`` with its per-var queues of
+  ``VersionedVarBlock`` (``threaded_engine.h:99-116``).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
+
+from .base import get_env
+
+__all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get", "set_engine"]
+
+
+class Var:
+    """An engine variable: a serialization point for reads/writes.
+
+    Analog of ``ThreadedVar`` (``threaded_engine.h:99``).  Scheduling protocol
+    (mirrors ``AppendRead/WriteDependency`` + ``CompleteRead/WriteDependency``,
+    ``threaded_engine.cc:51-143``): requests queue FIFO; the head is granted
+    when it is a read and no write is currently granted, or a write and
+    nothing is granted; consecutive reads at the head are granted together.
+    Exceptions raised by an op are stored and re-thrown at the next
+    ``wait_to_read``-style sync, matching the reference's
+    ``std::exception_ptr`` propagation (``threaded_engine.cc:466-468``).
+    """
+
+    __slots__ = ("queue", "granted_reads", "granted_write", "exc", "name")
+
+    def __init__(self, name: str = ""):
+        self.queue = collections.deque()  # of (opr, is_write) in push order
+        self.granted_reads = 0
+        self.granted_write = False
+        self.exc: Optional[BaseException] = None
+        self.name = name
+
+    def __repr__(self):
+        return "Var(%s)" % (self.name,)
+
+
+class _OprBlock:
+    """Analog of ``OprBlock`` (``threaded_engine.h:66``)."""
+
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "name", "exc",
+                 "done")
+
+    def __init__(self, fn, const_vars, mutable_vars, name):
+        self.fn = fn
+        self.const_vars = const_vars
+        self.mutable_vars = mutable_vars
+        self.wait = 0  # vars that have not yet granted this op
+        self.name = name
+        self.exc: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class Engine:
+    """Engine interface (reference ``include/mxnet/engine.h``)."""
+
+    def new_variable(self, name: str = "") -> Var:
+        return Var(name)
+
+    def push(self, fn: Callable[[], None], const_vars: Sequence[Var] = (),
+             mutable_vars: Sequence[Var] = (), name: str = "") -> None:
+        raise NotImplementedError
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), name=""):
+        """Push and block until fn completes (reference Engine::PushSync)."""
+        self.push(fn, const_vars, mutable_vars, name)
+        for v in mutable_vars:
+            self.wait_for_var(v)
+
+    def wait_for_var(self, var: Var) -> None:
+        raise NotImplementedError
+
+    def wait_for_all(self) -> None:
+        raise NotImplementedError
+
+    def delete_variable(self, var: Var) -> None:
+        """Reference ``DeleteVariable``: GC of vars is automatic in Python."""
+
+    def stop(self):
+        pass
+
+    def start(self):
+        pass
+
+
+class NaiveEngine(Engine):
+    """Synchronous engine: ops run inline at push (``naive_engine.cc``)."""
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name=""):
+        for v in tuple(const_vars) + tuple(mutable_vars):
+            if v.exc is not None:
+                raise v.exc
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - must propagate like ref
+            for v in mutable_vars:
+                v.exc = e
+            raise
+
+    def wait_for_var(self, var):
+        if var.exc is not None:
+            exc, var.exc = var.exc, None
+            raise exc
+
+    def wait_for_all(self):
+        pass
+
+
+class ThreadedEngine(Engine):
+    """Threaded var-dependency scheduler (see Var docstring for protocol)."""
+
+    def __init__(self, num_workers: Optional[int] = None):
+        n = num_workers or get_env("MXNET_CPU_WORKER_NTHREADS",
+                                   min(16, os.cpu_count() or 4), int)
+        self._pool = ThreadPoolExecutor(max_workers=n,
+                                        thread_name_prefix="mxtpu-engine")
+        self._lock = threading.Lock()  # guards all var state + counters
+        self._inflight = 0
+        self._idle = threading.Condition(self._lock)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name=""):
+        self._push(fn, const_vars, mutable_vars, name)
+
+    def _push(self, fn, const_vars=(), mutable_vars=(), name=""):
+        mvars = list(dict.fromkeys(mutable_vars))
+        cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
+        opr = _OprBlock(fn, cvars, mvars, name)
+        to_run: List[_OprBlock] = []
+        with self._lock:
+            self._inflight += 1
+            opr.wait = len(cvars) + len(mvars)
+            for v in cvars:
+                v.queue.append((opr, False))
+            for v in mvars:
+                v.queue.append((opr, True))
+            if opr.wait == 0:  # no deps at all
+                to_run.append(opr)
+            for v in cvars + mvars:
+                self._try_grant(v, to_run)
+        for o in to_run:
+            self._pool.submit(self._execute, o)
+        return opr
+
+    def _try_grant(self, var: Var, to_run: List[_OprBlock]):
+        """Grant queue heads per reader/writer rules; caller holds _lock."""
+        while var.queue:
+            opr, is_write = var.queue[0]
+            if is_write:
+                if var.granted_reads > 0 or var.granted_write:
+                    break
+                var.granted_write = True
+            else:
+                if var.granted_write:
+                    break
+                var.granted_reads += 1
+            var.queue.popleft()
+            opr.wait -= 1
+            if opr.wait == 0:
+                to_run.append(opr)
+            if is_write:
+                break
+
+    def _execute(self, opr: _OprBlock):
+        try:
+            for v in opr.const_vars + opr.mutable_vars:
+                if v.exc is not None:
+                    raise v.exc
+            opr.fn()
+        except BaseException as e:  # noqa: BLE001
+            opr.exc = e
+            for v in opr.mutable_vars:
+                v.exc = e
+        finally:
+            self._on_complete(opr)
+
+    def _on_complete(self, opr: _OprBlock):
+        """Analog of ``ThreadedEngine::OnComplete`` (threaded_engine.cc:412)."""
+        to_run: List[_OprBlock] = []
+        with self._lock:
+            for v in opr.const_vars:
+                v.granted_reads -= 1
+                self._try_grant(v, to_run)
+            for v in opr.mutable_vars:
+                v.granted_write = False
+                self._try_grant(v, to_run)
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.notify_all()
+        opr.done.set()
+        for o in to_run:
+            self._pool.submit(self._execute, o)
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), name=""):
+        """Push and block until fn itself completes — including const-only
+        ops (reference Engine::PushSync semantics)."""
+        opr = self._push(fn, const_vars, mutable_vars, name)
+        opr.done.wait()
+        if opr.exc is not None:
+            raise opr.exc
+
+    def wait_for_var(self, var: Var):
+        # push a no-op read; once it completes, all prior writes are done.
+        opr = self._push(lambda: None, const_vars=(var,), name="WaitForVar")
+        opr.done.wait()
+        if var.exc is not None:
+            exc, var.exc = var.exc, None
+            raise exc
+
+    def wait_for_all(self):
+        with self._idle:
+            while self._inflight > 0:
+                self._idle.wait()
+
+    def stop(self):
+        self._pool.shutdown(wait=True)
+
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get() -> Engine:
+    """Singleton accessor (reference ``Engine::Get``), selected by
+    ``MXNET_ENGINE_TYPE`` just like ``engine.cc:32-47``."""
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                kind = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+                if "naive" in kind.lower():
+                    _engine = NaiveEngine()
+                else:
+                    _engine = ThreadedEngine()
+    return _engine
+
+
+def set_engine(engine: Engine):
+    global _engine
+    _engine = engine

@@ -1,0 +1,14 @@
+"""NDArray package: imperative arrays + generated op namespace.
+
+Parity target: ``python/mxnet/ndarray/`` (ndarray.py, generated gen_*,
+sparse.py, random.py).
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      invoke, concatenate, save, load, imperative_invoke,
+                      waitall, moveaxis, onehot_encode)
+from . import register as _register
+from . import random  # noqa: F401
+
+_register.populate(globals())
+
+from .utils import *  # noqa: F401,F403

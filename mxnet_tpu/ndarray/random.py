@@ -1,0 +1,86 @@
+"""nd.random sampling namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .ndarray import imperative_invoke, NDArray, array as _array
+
+
+def _pair(a, b):
+    """Promote (NDArray, scalar) pairs for the _sample_* ops, which take
+    per-element distribution params as arrays (reference requires both to be
+    the same type; we accept mixed and broadcast the scalar)."""
+    if not isinstance(b, NDArray):
+        b = _array([float(b)] * a.size).reshape(a.shape)
+    return a, b
+
+
+def _call(op, shape=None, dtype=None, ctx=None, out=None, **params):
+    kw = dict(params)
+    if shape is not None:
+        kw["shape"] = shape
+    if dtype is not None:
+        kw["dtype"] = dtype
+    if ctx is not None:
+        kw["ctx"] = ctx
+    if out is not None:
+        kw["out"] = out
+    return imperative_invoke(op, **kw)
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    if isinstance(low, NDArray):
+        low, high = _pair(low, high)
+        return imperative_invoke("_sample_uniform", low, high,
+                                 shape=shape, dtype=dtype)
+    return _call("_random_uniform", shape, dtype, ctx, out, low=low, high=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    if isinstance(loc, NDArray):
+        loc, scale = _pair(loc, scale)
+        return imperative_invoke("_sample_normal", loc, scale,
+                                 shape=shape, dtype=dtype)
+    return _call("_random_normal", shape, dtype, ctx, out, loc=loc, scale=scale)
+
+
+def randn(*shape, **kw):
+    return normal(shape=shape or (1,), **kw)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    if isinstance(alpha, NDArray):
+        alpha, beta = _pair(alpha, beta)
+        return imperative_invoke("_sample_gamma", alpha, beta,
+                                 shape=shape, dtype=dtype)
+    return _call("_random_gamma", shape, dtype, ctx, out,
+                 alpha=alpha, beta=beta)
+
+
+def exponential(lam=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    return _call("_random_exponential", shape, dtype, ctx, out, lam=lam)
+
+
+def poisson(lam=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    return _call("_random_poisson", shape, dtype, ctx, out, lam=lam)
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    return _call("_random_negative_binomial", shape, dtype, ctx, out, k=k, p=p)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype=None,
+                                  ctx=None, out=None, **kw):
+    return _call("_random_generalized_negative_binomial", shape, dtype, ctx,
+                 out, mu=mu, alpha=alpha)
+
+
+def randint(low, high, shape=(), dtype=None, ctx=None, out=None, **kw):
+    return _call("_random_randint", shape, dtype, ctx, out, low=low, high=high)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    return imperative_invoke("_sample_multinomial", data, shape=shape,
+                             get_prob=get_prob, dtype=dtype)
+
+
+def shuffle(data, **kw):
+    return imperative_invoke("_shuffle", data)

@@ -1,0 +1,30 @@
+"""Auto-generate ``nd.<op>`` functions from the operator registry.
+
+Reference analog: ``python/mxnet/ndarray/register.py:142`` which code-gens
+Python functions from C-API op introspection.  Here the registry is native
+Python, so generation is a closure per op; every generated function accepts
+positional NDArrays, keyword attrs, and ``out=``.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import OPS
+from .ndarray import imperative_invoke
+
+
+def _make_fn(op_name):
+    def fn(*args, **kwargs):
+        return imperative_invoke(op_name, *args, **kwargs)
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = OPS[op_name].doc
+    return fn
+
+
+def populate(module_dict, include_private=True):
+    for name in list(OPS):
+        if not include_private and name.startswith("_"):
+            continue
+        if name not in module_dict:
+            module_dict[name] = _make_fn(name)

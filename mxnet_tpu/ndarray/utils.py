@@ -1,0 +1,11 @@
+"""NDArray utility helpers (parity: python/mxnet/ndarray/utils.py)."""
+from __future__ import annotations
+
+from .ndarray import NDArray, array, zeros as _zeros
+
+__all__ = ["zeros_like_fn"]
+
+
+def zeros_like_fn(arr):
+    from .ndarray import invoke
+    return invoke("zeros_like", [arr])

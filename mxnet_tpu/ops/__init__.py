@@ -1,0 +1,16 @@
+"""Operator library: registry + op families.
+
+The TPU-native replacement for ``src/operator/**`` (SURVEY.md N7): each op is
+one pure jittable JAX function registered in :mod:`.registry`; gradients come
+from jax.vjp, shape/type inference from jax.eval_shape.
+"""
+from .registry import (Operator, register, get_op, list_ops, apply_op, param,
+                       OPS)
+
+# importing the families populates the registry
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import matrix        # noqa: F401
+from . import nn            # noqa: F401
+from . import init_random   # noqa: F401
+from . import optimizer_ops # noqa: F401

@@ -1,0 +1,197 @@
+"""Elementwise / scalar / comparison operator families.
+
+Reference analog: ``src/operator/tensor/elemwise_binary_op*.cc``,
+``elemwise_unary_op*.cc``, ``elemwise_binary_broadcast_op*.cc``,
+``elemwise_binary_scalar_op*.cc``, ``elemwise_sum.cc`` — the "4-family"
+elementwise ops (SURVEY.md N7).  On TPU these are single XLA HLO ops that the
+compiler fuses into adjacent matmuls/convs (VPU work riding on MXU output),
+so each is just its jnp expression; no hand kernels needed.
+
+Naming parity: both the broadcast_* names and the legacy elemwise names /
+``_plus``-style internal names are registered, matching what Symbol JSON files
+and ``mx.nd`` users expect.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, param
+
+__all__ = []
+
+
+# --------------------------------------------------------------------------
+# binary broadcasting ops
+# --------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+
+_LEGACY_BINARY_ALIAS = {  # elemwise (same-shape) names share the kernel
+    "add": ("elemwise_add", "_plus", "_add"),
+    "sub": ("elemwise_sub", "_minus", "_sub"),
+    "mul": ("elemwise_mul", "_mul"),
+    "div": ("elemwise_div", "_div"),
+    "mod": ("_mod",),
+    "power": ("_power", "_pow"),
+    "maximum": ("_maximum",),
+    "minimum": ("_minimum",),
+    "hypot": ("_hypot",),
+}
+
+for _name, _f in _BINARY.items():
+    register("broadcast_" + _name, nin=2,
+             aliases=_LEGACY_BINARY_ALIAS.get(_name, ()))(
+        (lambda f: lambda attrs, lhs, rhs: f(lhs, rhs))(_f))
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+for _name, _f in _CMP.items():
+    # reference comparison ops return same-dtype 0/1 arrays, not bools
+    register("broadcast_" + _name, nin=2, aliases=("_" + _name,))(
+        (lambda f: lambda attrs, lhs, rhs:
+            f(lhs, rhs).astype(jnp.result_type(lhs)))(_f))
+
+
+# --------------------------------------------------------------------------
+# binary scalar ops (attrs: scalar)
+# --------------------------------------------------------------------------
+_SCALAR_P = {"scalar": param(float, 0.0)}
+
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(jnp.full_like(x, s), x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
+}
+
+for _name, _f in _SCALAR_OPS.items():
+    register(_name, params=dict(_SCALAR_P), nin=1)(
+        (lambda f: lambda attrs, x: f(x, attrs["scalar"]))(_f))
+
+
+# --------------------------------------------------------------------------
+# unary math ops
+# --------------------------------------------------------------------------
+def _softrelu(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+_UNARY = {
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "erfinv": lambda x: jax.scipy.special.erfinv(x),
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "softrelu": _softrelu,
+    "reciprocal": jnp.reciprocal,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name, nin=1)(
+        (lambda f: lambda attrs, x: f(x))(_f))
+
+register("_copy", nin=1, aliases=("identity",))(lambda attrs, x: x)
+register("BlockGrad", nin=1, aliases=("stop_gradient",))(
+    lambda attrs, x: jax.lax.stop_gradient(x))
+register("make_loss", nin=1)(lambda attrs, x: x)
+
+register("hard_sigmoid", nin=1,
+         params={"alpha": param(float, 0.2), "beta": param(float, 0.5)})(
+    lambda attrs, x: jnp.clip(attrs["alpha"] * x + attrs["beta"], 0.0, 1.0))
+
+register("clip", nin=1, params={"a_min": param(float, 0.0, required=True),
+                                "a_max": param(float, 0.0, required=True)})(
+    lambda attrs, x: jnp.clip(x, attrs["a_min"], attrs["a_max"]))
+
+
+@register("smooth_l1", nin=1, params={"scalar": param(float, 1.0)})
+def _smooth_l1(attrs, x):
+    """Huber-style loss used by SSD/RCNN (ref: src/operator/tensor/
+    elemwise_binary_scalar_op_extended.cc smooth_l1)."""
+    s2 = attrs["scalar"] ** 2
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+@register("add_n", nin=-1, aliases=("ElementWiseSum", "_sum"))
+def _add_n(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

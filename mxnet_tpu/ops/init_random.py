@@ -1,0 +1,199 @@
+"""Initialization and random-sampling operators.
+
+Reference analog: ``src/operator/tensor/init_op.cc`` (_zeros/_ones/_full/
+_arange/_eye) and ``src/operator/random/sample_op.cc`` + ``multisample``/
+``shuffle``/``multinomial``.  RNG design (SURVEY.md §7.3 "RNG parity"): the
+reference gives each op a ``kRandom`` resource of device RNG states; here
+every random op takes an explicit threefry key threaded by the dispatch layer
+from the global seed state (``mxnet_tpu.random``), preserving the
+``mx.random.seed`` UX while staying functional under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, param
+
+_INIT_PARAMS = {
+    "shape": param("shape", ()),
+    "dtype": param("dtype", "float32"),
+    "ctx": param(str, None),
+}
+
+
+def _dt(attrs, default="float32"):
+    return np.dtype(attrs.get("dtype") or default)
+
+
+register("_zeros", params=dict(_INIT_PARAMS), nin=0, aliases=("zeros",))(
+    lambda attrs: jnp.zeros(attrs["shape"], _dt(attrs)))
+register("_ones", params=dict(_INIT_PARAMS), nin=0, aliases=("ones",))(
+    lambda attrs: jnp.ones(attrs["shape"], _dt(attrs)))
+register("_full", params={**_INIT_PARAMS, "value": param(float, 0.0)},
+         nin=0, aliases=("full",))(
+    lambda attrs: jnp.full(attrs["shape"], attrs["value"], _dt(attrs)))
+
+
+@register("_arange", nin=0, aliases=("arange",),
+          params={**_INIT_PARAMS,
+                  "start": param(float, 0.0), "stop": param(float, None),
+                  "step": param(float, 1.0), "repeat": param(int, 1),
+                  "infer_range": param(bool, False)})
+def _arange(attrs, ):
+    out = jnp.arange(attrs["start"],
+                     attrs["stop"], attrs["step"], dtype=_dt(attrs))
+    if attrs["repeat"] > 1:
+        out = jnp.repeat(out, attrs["repeat"])
+    return out
+
+
+@register("_linspace", nin=0, aliases=("linspace",),
+          params={**_INIT_PARAMS, "start": param(float, 0.0),
+                  "stop": param(float, 1.0), "num": param(int, 50),
+                  "endpoint": param(bool, True)})
+def _linspace(attrs):
+    return jnp.linspace(attrs["start"], attrs["stop"], attrs["num"],
+                        endpoint=attrs["endpoint"], dtype=_dt(attrs))
+
+
+@register("_eye", nin=0, aliases=("eye",),
+          params={**_INIT_PARAMS, "N": param(int, 0), "M": param(int, 0),
+                  "k": param(int, 0)})
+def _eye(attrs):
+    return jnp.eye(attrs["N"], attrs["M"] or None, attrs["k"], dtype=_dt(attrs))
+
+
+# --------------------------------------------------------------------------
+# samplers — attrs carry distribution params; key threaded by dispatch
+# --------------------------------------------------------------------------
+_SAMPLE_COMMON = {"shape": param("shape", ()), "dtype": param("dtype", None),
+                  "ctx": param(str, None)}
+
+
+def _sample_shape(attrs):
+    return attrs["shape"] or ()
+
+
+@register("_random_uniform", nin=0, needs_rng=True,
+          aliases=("uniform", "random_uniform"),
+          params={**_SAMPLE_COMMON, "low": param(float, 0.0),
+                  "high": param(float, 1.0)})
+def _uniform(attrs, key):
+    return jax.random.uniform(key, _sample_shape(attrs),
+                              _dt(attrs), attrs["low"], attrs["high"])
+
+
+@register("_random_normal", nin=0, needs_rng=True,
+          aliases=("normal", "random_normal"),
+          params={**_SAMPLE_COMMON, "loc": param(float, 0.0),
+                  "scale": param(float, 1.0)})
+def _normal(attrs, key):
+    return attrs["loc"] + attrs["scale"] * \
+        jax.random.normal(key, _sample_shape(attrs), _dt(attrs))
+
+
+@register("_random_gamma", nin=0, needs_rng=True, aliases=("random_gamma",),
+          params={**_SAMPLE_COMMON, "alpha": param(float, 1.0),
+                  "beta": param(float, 1.0)})
+def _gamma(attrs, key):
+    return attrs["beta"] * jax.random.gamma(
+        key, attrs["alpha"], _sample_shape(attrs), _dt(attrs))
+
+
+@register("_random_exponential", nin=0, needs_rng=True,
+          aliases=("random_exponential",),
+          params={**_SAMPLE_COMMON, "lam": param(float, 1.0)})
+def _exponential(attrs, key):
+    return jax.random.exponential(key, _sample_shape(attrs), _dt(attrs)) \
+        / attrs["lam"]
+
+
+@register("_random_poisson", nin=0, needs_rng=True, aliases=("random_poisson",),
+          params={**_SAMPLE_COMMON, "lam": param(float, 1.0)})
+def _poisson(attrs, key):
+    return jax.random.poisson(key, attrs["lam"], _sample_shape(attrs)) \
+        .astype(_dt(attrs))
+
+
+@register("_random_negative_binomial", nin=0, needs_rng=True,
+          aliases=("random_negative_binomial",),
+          params={**_SAMPLE_COMMON, "k": param(int, 1), "p": param(float, 1.0)})
+def _neg_binomial(attrs, key):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, attrs["k"], _sample_shape(attrs)) \
+        * (1 - attrs["p"]) / attrs["p"]
+    return jax.random.poisson(k2, lam).astype(_dt(attrs))
+
+
+@register("_random_generalized_negative_binomial", nin=0, needs_rng=True,
+          aliases=("random_generalized_negative_binomial",),
+          params={**_SAMPLE_COMMON, "mu": param(float, 1.0),
+                  "alpha": param(float, 1.0)})
+def _gen_neg_binomial(attrs, key):
+    k1, k2 = jax.random.split(key)
+    a = 1.0 / max(attrs["alpha"], 1e-12)
+    lam = jax.random.gamma(k1, a, _sample_shape(attrs)) * attrs["mu"] / a
+    return jax.random.poisson(k2, lam).astype(_dt(attrs))
+
+
+@register("_random_randint", nin=0, needs_rng=True, aliases=("random_randint",),
+          params={**_SAMPLE_COMMON, "low": param(int, 0),
+                  "high": param(int, 1)})
+def _randint(attrs, key):
+    return jax.random.randint(key, _sample_shape(attrs), attrs["low"],
+                              attrs["high"],
+                              dtype=_dt(attrs, "int32"))
+
+
+@register("_sample_multinomial", nin=1, needs_rng=True,
+          aliases=("sample_multinomial",), nout=1,
+          params={"shape": param("shape", ()), "get_prob": param(bool, False),
+                  "dtype": param("dtype", "int32")})
+def _multinomial(attrs, key, data):
+    n = int(np.prod(attrs["shape"])) if attrs["shape"] else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        out = out.reshape(attrs["shape"]) if attrs["shape"] else out.reshape(())
+    else:
+        out = jax.random.categorical(key, logits[:, None, :].repeat(n, 1),
+                                     axis=-1)
+        out = out.reshape((data.shape[0],) + (attrs["shape"] or ()))
+    return out.astype(_dt(attrs, "int32"))
+
+
+@register("_shuffle", nin=1, needs_rng=True, aliases=("shuffle",))
+def _shuffle(attrs, key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+# sample_* variants: per-element distribution params as input arrays
+@register("_sample_uniform", nin=2, needs_rng=True, aliases=("sample_uniform",),
+          params={"shape": param("shape", ()), "dtype": param("dtype", None)})
+def _sample_uniform(attrs, key, low, high):
+    sh = low.shape + (attrs["shape"] or ())
+    u = jax.random.uniform(key, sh, _dt(attrs))
+    extra = (1,) * (len(sh) - low.ndim)
+    return low.reshape(low.shape + extra) + \
+        (high - low).reshape(low.shape + extra) * u
+
+
+@register("_sample_normal", nin=2, needs_rng=True, aliases=("sample_normal",),
+          params={"shape": param("shape", ()), "dtype": param("dtype", None)})
+def _sample_normal(attrs, key, mu, sigma):
+    sh = mu.shape + (attrs["shape"] or ())
+    extra = (1,) * (len(sh) - mu.ndim)
+    return mu.reshape(mu.shape + extra) + \
+        sigma.reshape(sigma.shape + extra) * \
+        jax.random.normal(key, sh, _dt(attrs))
+
+
+@register("_sample_gamma", nin=2, needs_rng=True, aliases=("sample_gamma",),
+          params={"shape": param("shape", ()), "dtype": param("dtype", None)})
+def _sample_gamma(attrs, key, alpha, beta):
+    sh = alpha.shape + (attrs["shape"] or ())
+    extra = (1,) * (len(sh) - alpha.ndim)
+    return jax.random.gamma(key, alpha.reshape(alpha.shape + extra), sh) \
+        * beta.reshape(beta.shape + extra)

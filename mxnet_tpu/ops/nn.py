@@ -1,0 +1,582 @@
+"""Neural-network operators: Convolution, FullyConnected, Pooling, norms,
+softmax family, Dropout, activations, UpSampling.
+
+Reference analog: ``src/operator/nn/*`` (convolution.cc:476-519 is the
+canonical registration; batch_norm.cc, pooling.cc, fully_connected.cc,
+softmax.cc, dropout.cc, layer_norm.cc, lrn.cc, upsampling.cc) plus the cuDNN
+fast paths (``src/operator/nn/cudnn/``).  TPU-native design: convolutions and
+FC lower straight onto the MXU via ``lax.conv_general_dilated`` / ``dot``; the
+cuDNN algo-selection machinery has no analog because XLA picks conv strategies
+itself.  NCHW is kept as the user-facing layout (reference default); XLA
+relayouts internally for the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, param
+from ..base import MXNetError
+
+
+def _spatial_dims(kernel):
+    return len(kernel)
+
+
+def _conv_dnums(nd):
+    sp = "DHW"[-nd:] if nd <= 3 else None
+    return jax.lax.conv_dimension_numbers(
+        (1, 1) + (1,) * nd, (1, 1) + (1,) * nd,
+        ("NC" + sp, "OI" + sp, "NC" + sp))
+
+
+_CONV_PARAMS = {
+    "kernel": param("shape", (), required=True),
+    "stride": param("shape", ()),
+    "dilate": param("shape", ()),
+    "pad": param("shape", ()),
+    "num_filter": param(int, 0, required=True),
+    "num_group": param(int, 1),
+    "no_bias": param(bool, False),
+    "workspace": param(int, 1024),      # accepted, ignored (XLA owns memory)
+    "cudnn_tune": param(str, None),     # accepted, ignored on TPU
+    "cudnn_off": param(bool, False),
+    "layout": param(str, None),
+}
+
+
+@register("Convolution", nin=-1, aliases=("convolution", "Convolution_v1"),
+          params=dict(_CONV_PARAMS))
+def _convolution(attrs, data, weight, *maybe_bias):
+    """N-D convolution on the MXU (ref: src/operator/nn/convolution.cc)."""
+    k = attrs["kernel"]
+    nd = len(k)
+    stride = attrs["stride"] or (1,) * nd
+    dilate = attrs["dilate"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dnums(nd),
+        feature_group_count=attrs["num_group"],
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    out = out.astype(data.dtype)
+    if not attrs["no_bias"] and maybe_bias:
+        bias = maybe_bias[0].reshape((1, -1) + (1,) * nd)
+        out = out + bias
+    return out
+
+
+@register("Deconvolution", nin=-1, aliases=("deconvolution",),
+          params={**_CONV_PARAMS, "adj": param("shape", ()),
+                  "target_shape": param("shape", ())})
+def _deconvolution(attrs, data, weight, *maybe_bias):
+    """Transposed conv (ref: src/operator/nn/deconvolution.cc): gradient of
+    Convolution w.r.t. its input, expressed with lhs dilation."""
+    k = attrs["kernel"]
+    nd = len(k)
+    stride = attrs["stride"] or (1,) * nd
+    dilate = attrs["dilate"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    adj = attrs["adj"] or (0,) * nd
+    # output_size = stride*(in-1) + dilate*(k-1) + 1 - 2*pad + adj
+    padding = [(dilate[i] * (k[i] - 1) - pad[i],
+                dilate[i] * (k[i] - 1) - pad[i] + adj[i]) for i in range(nd)]
+    # weight layout (in_c, out_c/g, *k) → IOHW spec with flipped spatial dims
+    sp = "DHW"[-nd:]
+    dnums = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, ("NC" + sp, "IO" + sp, "NC" + sp))
+    out = jax.lax.conv_general_dilated(
+        data, jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dnums,
+        feature_group_count=attrs["num_group"])
+    out = out.astype(data.dtype)
+    if not attrs["no_bias"] and maybe_bias:
+        out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("FullyConnected", nin=-1, aliases=("fullyconnected", "FullyConnected_v1"),
+          params={"num_hidden": param(int, 0, required=True),
+                  "no_bias": param(bool, False),
+                  "flatten": param(bool, True)})
+def _fully_connected(attrs, data, weight, *maybe_bias):
+    """y = x·Wᵀ + b on the MXU (ref: src/operator/nn/fully_connected.cc)."""
+    if attrs["flatten"]:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.matmul(x, weight.T)
+    if not attrs["no_bias"] and maybe_bias:
+        out = out + maybe_bias[0]
+    return out
+
+
+_POOL_PARAMS = {
+    "kernel": param("shape", ()),
+    "pool_type": param(["max", "avg", "sum", "lp"], "max"),
+    "global_pool": param(bool, False),
+    "kernel_layout": param(str, None),
+    "cudnn_off": param(bool, False),
+    "pooling_convention": param(["valid", "full", "same"], "valid"),
+    "stride": param("shape", ()),
+    "pad": param("shape", ()),
+    "p_value": param(int, 2),
+    "count_include_pad": param(bool, True),
+}
+
+
+@register("Pooling", nin=1, aliases=("pooling", "Pooling_v1"),
+          params=dict(_POOL_PARAMS))
+def _pooling(attrs, data):
+    """Max/avg/sum pooling via windowed reduction on the VPU
+    (ref: src/operator/nn/pooling.cc)."""
+    nd = data.ndim - 2
+    if attrs["global_pool"]:
+        axes = tuple(range(2, data.ndim))
+        if attrs["pool_type"] == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif attrs["pool_type"] == "sum":
+            out = jnp.sum(data, axis=axes, keepdims=True)
+        else:
+            out = jnp.mean(data, axis=axes, keepdims=True)
+        return out
+    k = attrs["kernel"]
+    stride = attrs["stride"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if attrs["pooling_convention"] == "full":
+        # ceil instead of floor for output size: add extra padding on the right
+        extra = []
+        for i in range(nd):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            rem = (in_sz - k[i]) % stride[i]
+            extra.append((stride[i] - rem) % stride[i] if rem else 0)
+        pads = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad, extra))
+    pt = attrs["pool_type"]
+    if pt == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+    ssum = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+    if pt == "sum":
+        return ssum.astype(data.dtype)
+    if pt == "lp":
+        p = attrs["p_value"]
+        sp = jax.lax.reduce_window(jnp.abs(data) ** p, 0.0, jax.lax.add,
+                                   window, strides, pads)
+        return (sp ** (1.0 / p)).astype(data.dtype)
+    # avg
+    if attrs["count_include_pad"]:
+        denom = float(np.prod(k))
+        return (ssum / denom).astype(data.dtype)
+    ones = jnp.ones_like(data)
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+    return (ssum / counts).astype(data.dtype)
+
+
+@register("Activation", nin=1, aliases=("activation",),
+          params={"act_type": param(["relu", "sigmoid", "tanh", "softrelu",
+                                     "softsign"], "relu", required=True)})
+def _activation(attrs, x):
+    act = attrs["act_type"]
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act == "tanh":
+        return jnp.tanh(x)
+    if act == "softrelu":
+        return jnp.logaddexp(x, 0.0)
+    return jax.nn.soft_sign(x)
+
+
+@register("LeakyReLU", nin=-1, aliases=("leakyrelu",), needs_rng=True,
+          train_aware=True,
+          params={"act_type": param(["elu", "leaky", "prelu", "rrelu", "selu",
+                                     "gelu"], "leaky"),
+                  "slope": param(float, 0.25),
+                  "lower_bound": param(float, 0.125),
+                  "upper_bound": param(float, 0.334),
+                  "__train__": param(bool, False)})
+def _leaky_relu(attrs, key, x, *maybe_gamma):
+    act = attrs["act_type"]
+    if act == "leaky":
+        return jnp.where(x > 0, x, attrs["slope"] * x)
+    if act == "elu":
+        return jnp.where(x > 0, x, attrs["slope"] * jnp.expm1(x))
+    if act == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act == "prelu":
+        gamma = maybe_gamma[0]
+        shape = [1] * x.ndim
+        if gamma.ndim == 1 and x.ndim > 1:
+            shape[1] = gamma.shape[0] if gamma.shape[0] > 1 else 1
+        g = gamma.reshape(shape)
+        return jnp.where(x > 0, x, g * x)
+    # rrelu: random slope in [lower, upper] at train, mean at eval
+    lo, hi = attrs["lower_bound"], attrs["upper_bound"]
+    if attrs.get("__train__"):
+        slope = jax.random.uniform(key, x.shape, x.dtype, lo, hi)
+    else:
+        slope = (lo + hi) / 2.0
+    return jnp.where(x > 0, x, slope * x)
+
+
+# --------------------------------------------------------------------------
+# normalization
+# --------------------------------------------------------------------------
+_BN_PARAMS = {
+    "eps": param(float, 1e-3),
+    "momentum": param(float, 0.9),
+    "fix_gamma": param(bool, True),
+    "use_global_stats": param(bool, False),
+    "output_mean_var": param(bool, False),
+    "axis": param(int, 1),
+    "cudnn_off": param(bool, False),
+    "__train__": param(bool, False),
+}
+
+
+@register("BatchNorm", nin=5, aliases=("batchnorm", "BatchNorm_v1"),
+          params=dict(_BN_PARAMS), train_aware=True, nout=3,
+          aux_writeback={1: 3, 2: 4},
+          visible=lambda a: 3 if a["output_mean_var"] else 1)
+def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """BatchNorm (ref: src/operator/nn/batch_norm.cc).
+
+    Outputs (out, new_moving_mean, new_moving_var); in training mode the
+    dispatch layer writes outputs 1,2 back into the moving-stat aux arrays —
+    the functional TPU expression of the reference's in-kernel aux mutation.
+    """
+    ax = attrs["axis"] % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    train = attrs.get("__train__") and not attrs["use_global_stats"]
+    if train:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+        m = attrs["momentum"]
+        new_mm = moving_mean * m + mean * (1 - m)
+        new_mv = moving_var * m + var * (1 - m)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma
+    inv = jax.lax.rsqrt(var + attrs["eps"])
+    out = (data - mean.reshape(shape)) * (inv * g).reshape(shape) \
+        + beta.reshape(shape)
+    return out.astype(data.dtype), new_mm, new_mv
+
+
+@register("LayerNorm", nin=3, aliases=("layernorm",),
+          params={"axis": param(int, -1), "eps": param(float, 1e-5),
+                  "output_mean_var": param(bool, False)}, nout=3,
+          visible=lambda a: 3 if a["output_mean_var"] else 1)
+def _layer_norm(attrs, data, gamma, beta):
+    ax = attrs["axis"] % data.ndim
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=ax, keepdims=True)
+    var = jnp.var(x32, axis=ax, keepdims=True)
+    inv = jax.lax.rsqrt(var + attrs["eps"])
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = (x32 - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    return (out.astype(data.dtype), jnp.squeeze(mean, ax), jnp.squeeze(var, ax))
+
+
+@register("InstanceNorm", nin=3, aliases=("instancenorm",),
+          params={"eps": param(float, 1e-3)})
+def _instance_norm(attrs, data, gamma, beta):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return ((data - mean) * jax.lax.rsqrt(var + attrs["eps"])
+            * gamma.reshape(shape) + beta.reshape(shape))
+
+
+@register("L2Normalization", nin=1,
+          params={"eps": param(float, 1e-10),
+                  "mode": param(["instance", "channel", "spatial"], "instance")})
+def _l2_normalization(attrs, data):
+    mode = attrs["mode"]
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:
+        red = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True)
+                    + attrs["eps"])
+    return data / norm
+
+
+@register("LRN", nin=1, aliases=("lrn",), nout=2, visible=1,
+          params={"alpha": param(float, 1e-4), "beta": param(float, 0.75),
+                  "knorm": param(float, 2.0), "nsize": param(int, 0, required=True)})
+def _lrn(attrs, data):
+    """Local response norm across channels (ref: src/operator/nn/lrn.cc)."""
+    n = attrs["nsize"]
+    half = n // 2
+    sq = jnp.square(data)
+    # sum over channel window via padded cumulative trick
+    pad = [(0, 0)] * data.ndim
+    pad[1] = (half, half)
+    sqp = jnp.pad(sq, pad)
+    window = [1] * data.ndim
+    window[1] = n
+    ssum = jax.lax.reduce_window(sqp, 0.0, jax.lax.add, tuple(window),
+                                 (1,) * data.ndim, "valid")
+    scale = (attrs["knorm"] + attrs["alpha"] * ssum / n) ** attrs["beta"]
+    return data / scale, scale
+
+
+# --------------------------------------------------------------------------
+# softmax family
+# --------------------------------------------------------------------------
+@register("softmax", nin=1, params={"axis": param(int, -1),
+                                    "temperature": param(float, None),
+                                    "dtype": param("dtype", None)})
+def _softmax(attrs, x):
+    t = attrs["temperature"]
+    if t is not None and t != 1.0:
+        x = x / t
+    out = jax.nn.softmax(x, axis=attrs["axis"])
+    return out.astype(np.dtype(attrs["dtype"])) if attrs["dtype"] else out
+
+
+@register("log_softmax", nin=1, params={"axis": param(int, -1),
+                                        "temperature": param(float, None)})
+def _log_softmax(attrs, x):
+    t = attrs["temperature"]
+    if t is not None and t != 1.0:
+        x = x / t
+    return jax.nn.log_softmax(x, axis=attrs["axis"])
+
+
+@register("SoftmaxActivation", nin=1,
+          params={"mode": param(["instance", "channel"], "instance")})
+def _softmax_activation(attrs, x):
+    axis = 1 if attrs["mode"] == "channel" else -1
+    if attrs["mode"] == "instance" and x.ndim > 2:
+        return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+    return jax.nn.softmax(x, axis=axis)
+
+
+_SOFTMAX_OUT_PARAMS = {
+    "grad_scale": param(float, 1.0),
+    "ignore_label": param(float, -1.0),
+    "multi_output": param(bool, False),
+    "use_ignore": param(bool, False),
+    "preserve_shape": param(bool, False),
+    "normalization": param(["null", "batch", "valid"], "null"),
+    "out_grad": param(bool, False),
+    "smooth_alpha": param(float, 0.0),
+}
+
+
+def _softmax_output_impl(attrs, data, label):
+    if attrs["multi_output"]:
+        prob = jax.nn.softmax(data, axis=1)
+    elif attrs["preserve_shape"]:
+        prob = jax.nn.softmax(data, axis=-1)
+    else:
+        prob = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1)
+        prob = prob.reshape(data.shape)
+    return prob
+
+
+@register("SoftmaxOutput", nin=2, aliases=("softmaxoutput", "Softmax"),
+          params=dict(_SOFTMAX_OUT_PARAMS))
+def _softmax_output(attrs, data, label):
+    """Softmax with implicit cross-entropy gradient
+    (ref: src/operator/softmax_output.cc).  Forward = softmax(data); the
+    backward is (p - onehot(label)) * grad_scale with ignore-label masking —
+    expressed as a custom VJP so autograd/Symbol backward matches the
+    reference exactly (the incoming head gradient is ignored, as in MXNet)."""
+
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return _softmax_output_impl(attrs, d, l)
+
+    def _fwd_fwd(d, l):
+        p = _softmax_output_impl(attrs, d, l)
+        return p, (p, l)
+
+    def _fwd_bwd(res, g):
+        p, l = res
+        axis = 1 if attrs["multi_output"] else -1
+        if attrs["multi_output"]:
+            lab = l.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, p.shape[1], dtype=p.dtype, axis=1)
+        else:
+            flat_label = l.reshape(l.shape[0], -1) if l.ndim > 1 else l
+            lab = flat_label.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab.reshape(p.shape[:-1]), p.shape[-1],
+                                dtype=p.dtype)
+        grad = (p - oh)
+        if attrs["use_ignore"]:
+            mask = (l != attrs["ignore_label"]).astype(p.dtype)
+            mask = jnp.expand_dims(mask, 1 if attrs["multi_output"] else -1)
+            grad = grad * mask
+        scale = attrs["grad_scale"]
+        if attrs["normalization"] == "batch":
+            scale = scale / p.shape[0]
+        elif attrs["normalization"] == "valid" and attrs["use_ignore"]:
+            nvalid = jnp.maximum(jnp.sum(l != attrs["ignore_label"]), 1)
+            scale = scale / nvalid
+        return grad * scale, jnp.zeros_like(l)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data, label)
+
+
+@register("softmax_cross_entropy", nin=2)
+def _softmax_cross_entropy(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+@register("LinearRegressionOutput", nin=2, aliases=("linearregressionoutput",),
+          params={"grad_scale": param(float, 1.0)})
+def _linear_regression_output(attrs, data, label):
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return d
+
+    def _f(d, l):
+        return d, (d, l)
+
+    def _b(res, g):
+        d, l = res
+        return ((d - l.reshape(d.shape)) * attrs["grad_scale"],
+                jnp.zeros_like(l))
+
+    _fwd.defvjp(_f, _b)
+    return _fwd(data, label)
+
+
+@register("LogisticRegressionOutput", nin=2, aliases=("logisticregressionoutput",),
+          params={"grad_scale": param(float, 1.0)})
+def _logistic_regression_output(attrs, data, label):
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return jax.nn.sigmoid(d)
+
+    def _f(d, l):
+        p = jax.nn.sigmoid(d)
+        return p, (p, l)
+
+    def _b(res, g):
+        p, l = res
+        return ((p - l.reshape(p.shape)) * attrs["grad_scale"], jnp.zeros_like(l))
+
+    _fwd.defvjp(_f, _b)
+    return _fwd(data, label)
+
+
+@register("MAERegressionOutput", nin=2, aliases=("maeregressionoutput",),
+          params={"grad_scale": param(float, 1.0)})
+def _mae_regression_output(attrs, data, label):
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return d
+
+    def _f(d, l):
+        return d, (d, l)
+
+    def _b(res, g):
+        d, l = res
+        return (jnp.sign(d - l.reshape(d.shape)) * attrs["grad_scale"],
+                jnp.zeros_like(l))
+
+    _fwd.defvjp(_f, _b)
+    return _fwd(data, label)
+
+
+# --------------------------------------------------------------------------
+# dropout
+# --------------------------------------------------------------------------
+@register("Dropout", nin=1, aliases=("dropout",), needs_rng=True,
+          train_aware=True, nout=2, visible=1,
+          params={"p": param(float, 0.5),
+                  "mode": param(["training", "always"], "training"),
+                  "axes": param("shape", ()),
+                  "cudnn_off": param(bool, False),
+                  "__train__": param(bool, False)})
+def _dropout(attrs, key, data):
+    """Inverted dropout (ref: src/operator/nn/dropout.cc); returns
+    (out, mask)."""
+    p = attrs["p"]
+    active = attrs.get("__train__") or attrs["mode"] == "always"
+    if not active or p == 0.0:
+        return data, jnp.ones_like(data)
+    shape = data.shape
+    if attrs["axes"]:
+        shape = tuple(1 if i in attrs["axes"] else s
+                      for i, s in enumerate(data.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask, jnp.broadcast_to(mask, data.shape)
+
+
+@register("UpSampling", nin=-1, aliases=("upsampling",),
+          params={"scale": param(int, 1, required=True),
+                  "num_filter": param(int, 0),
+                  "sample_type": param(["nearest", "bilinear"], "nearest"),
+                  "multi_input_mode": param(["concat", "sum"], "concat"),
+                  "num_args": param(int, 1),
+                  "workspace": param(int, 512)})
+def _upsampling(attrs, *inputs):
+    s = attrs["scale"]
+    outs = []
+    for x in inputs:
+        if attrs["sample_type"] == "nearest":
+            y = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+        else:
+            n, c, h, w = x.shape
+            y = jax.image.resize(x, (n, c, h * s, w * s), method="bilinear")
+        outs.append(y)
+    if len(outs) == 1:
+        return outs[0]
+    if attrs["multi_input_mode"] == "sum":
+        out = outs[0]
+        for y in outs[1:]:
+            out = out + y
+        return out
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("Crop", nin=-1, aliases=("crop_like",),
+          params={"offset": param("shape", (0, 0)),
+                  "h_w": param("shape", (0, 0)),
+                  "num_args": param(int, 1),
+                  "center_crop": param(bool, False)})
+def _crop_op(attrs, data, *maybe_like):
+    if maybe_like:
+        th, tw = maybe_like[0].shape[2:4]
+    else:
+        th, tw = attrs["h_w"]
+    h, w = data.shape[2:4]
+    if attrs["center_crop"]:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = attrs["offset"]
+    return data[:, :, oy:oy + th, ox:ox + tw]

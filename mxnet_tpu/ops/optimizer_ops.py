@@ -1,0 +1,178 @@
+"""Fused optimizer update operators.
+
+Reference analog: ``src/operator/optimizer_op.cc`` — SGD(+momentum,
+multi-precision), Adam, RMSProp(+alex), FTRL, FTML, Signum/SignSGD, NAG —
+each a single fused kernel so the update never materializes intermediates in
+HBM.  On TPU each update is one jitted elementwise fusion (XLA fuses the whole
+chain); states are returned as extra outputs and written back by the dispatch
+layer (``aux_writeback``), the functional analog of the reference's in-place
+state mutation.
+
+Convention (matches reference): ``rescale_grad`` scales raw grads, then
+``clip_gradient`` clips, then weight decay ``wd`` is added as ``wd*weight``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, param, OPS
+
+_COMMON = {
+    "lr": param(float, 0.0, required=True),
+    "wd": param(float, 0.0),
+    "rescale_grad": param(float, 1.0),
+    "clip_gradient": param(float, -1.0),
+}
+
+
+def _prep_grad(attrs, weight, grad):
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    return g + attrs["wd"] * weight
+
+
+def _opt(name, nin, nout=1, extra=None, writeback=None, hidden=0, aliases=()):
+    def deco(fn):
+        register(name, nin=nin, nout=nout,
+                 params={**_COMMON, **(extra or {})},
+                 aux_writeback=writeback, visible=nout - hidden,
+                 aliases=aliases)(fn)
+        return fn
+    return deco
+
+
+@_opt("sgd_update", nin=2)
+def _sgd_update(attrs, weight, grad):
+    return weight - attrs["lr"] * _prep_grad(attrs, weight, grad)
+
+
+@_opt("sgd_mom_update", nin=3, nout=2, writeback={1: 2}, hidden=1,
+      extra={"momentum": param(float, 0.0)})
+def _sgd_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(attrs, weight, grad)
+    new_mom = attrs["momentum"] * mom - attrs["lr"] * g
+    return weight + new_mom, new_mom
+
+
+@_opt("nag_mom_update", nin=3, nout=2, writeback={1: 2}, hidden=1,
+      extra={"momentum": param(float, 0.0)})
+def _nag_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(attrs, weight, grad)
+    new_mom = attrs["momentum"] * mom + g
+    return weight - attrs["lr"] * (g + attrs["momentum"] * new_mom), new_mom
+
+
+@_opt("mp_sgd_update", nin=3, nout=2, writeback={1: 2}, hidden=1)
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    """Multi-precision SGD: fp32 master weights for fp16/bf16 params
+    (ref: optimizer_op.cc MP_SGD)."""
+    g = grad.astype(jnp.float32) * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    g = g + attrs["wd"] * weight32
+    new_w32 = weight32 - attrs["lr"] * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@_opt("mp_sgd_mom_update", nin=4, nout=3, writeback={1: 2, 2: 3}, hidden=2,
+      extra={"momentum": param(float, 0.0)})
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    g = grad.astype(jnp.float32) * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    g = g + attrs["wd"] * weight32
+    new_mom = attrs["momentum"] * mom - attrs["lr"] * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@_opt("adam_update", nin=4, nout=3, writeback={1: 2, 2: 3}, hidden=2,
+      extra={"beta1": param(float, 0.9), "beta2": param(float, 0.999),
+             "epsilon": param(float, 1e-8), "lazy_update": param(bool, True)})
+def _adam_update(attrs, weight, grad, mean, var):
+    g = _prep_grad(attrs, weight, grad)
+    new_mean = attrs["beta1"] * mean + (1 - attrs["beta1"]) * g
+    new_var = attrs["beta2"] * var + (1 - attrs["beta2"]) * jnp.square(g)
+    w = weight - attrs["lr"] * new_mean / (jnp.sqrt(new_var) + attrs["epsilon"])
+    return w, new_mean, new_var
+
+
+@_opt("rmsprop_update", nin=3, nout=2, writeback={1: 2}, hidden=1,
+      extra={"gamma1": param(float, 0.95), "epsilon": param(float, 1e-8),
+             "clip_weights": param(float, -1.0)})
+def _rmsprop_update(attrs, weight, grad, n):
+    g = _prep_grad(attrs, weight, grad)
+    new_n = (1 - attrs["gamma1"]) * jnp.square(g) + attrs["gamma1"] * n
+    w = weight - attrs["lr"] * g / jnp.sqrt(new_n + attrs["epsilon"])
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return w, new_n
+
+
+@_opt("rmspropalex_update", nin=5, nout=4, writeback={1: 2, 2: 3, 3: 4},
+      hidden=3,
+      extra={"gamma1": param(float, 0.95), "gamma2": param(float, 0.9),
+             "epsilon": param(float, 1e-8), "clip_weights": param(float, -1.0)})
+def _rmspropalex_update(attrs, weight, grad, n, g_, delta):
+    g = _prep_grad(attrs, weight, grad)
+    new_n = (1 - attrs["gamma1"]) * jnp.square(g) + attrs["gamma1"] * n
+    new_g = (1 - attrs["gamma1"]) * g + attrs["gamma1"] * g_
+    new_delta = attrs["gamma2"] * delta - attrs["lr"] * g / \
+        jnp.sqrt(new_n - jnp.square(new_g) + attrs["epsilon"])
+    w = weight + new_delta
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return w, new_n, new_g, new_delta
+
+
+@_opt("ftrl_update", nin=4, nout=3, writeback={1: 2, 2: 3}, hidden=2,
+      extra={"lamda1": param(float, 0.01), "beta": param(float, 1.0)})
+def _ftrl_update(attrs, weight, grad, z, n):
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / attrs["lr"]
+    new_z = z + g - sigma * weight
+    denom = (attrs["beta"] + jnp.sqrt(new_n)) / attrs["lr"] + attrs["wd"]
+    w = jnp.where(jnp.abs(new_z) > attrs["lamda1"],
+                  (jnp.sign(new_z) * attrs["lamda1"] - new_z) / denom,
+                  0.0)
+    return w, new_z, new_n
+
+
+@_opt("ftml_update", nin=5, nout=4, writeback={1: 2, 2: 3, 3: 4}, hidden=3,
+      extra={"beta1": param(float, 0.6), "beta2": param(float, 0.999),
+             "epsilon": param(float, 1e-8), "t": param(int, 1)})
+def _ftml_update(attrs, weight, grad, d, v, z):
+    g = _prep_grad(attrs, weight, grad)
+    t = attrs["t"]
+    b1, b2 = attrs["beta1"], attrs["beta2"]
+    new_v = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** t) / attrs["lr"] * \
+        (jnp.sqrt(new_v / (1 - b2 ** t)) + attrs["epsilon"])
+    sigma = d_t - b1 * d
+    new_z = b1 * z + (1 - b1) * g - sigma * weight
+    new_d = d_t
+    w = -new_z / new_d
+    return w, new_d, new_v, new_z
+
+
+@_opt("signsgd_update", nin=2)
+def _signsgd_update(attrs, weight, grad):
+    g = _prep_grad(attrs, weight, grad)
+    return weight - attrs["lr"] * jnp.sign(g)
+
+
+@_opt("signum_update", nin=3, nout=2, writeback={1: 2}, hidden=1,
+      extra={"momentum": param(float, 0.0), "wd_lh": param(float, 0.0)})
+def _signum_update(attrs, weight, grad, mom):
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    g = g + attrs["wd"] * weight
+    new_mom = attrs["momentum"] * mom - (1 - attrs["momentum"]) * g
+    w = (1 - attrs["lr"] * attrs["wd_lh"]) * weight \
+        + attrs["lr"] * jnp.sign(new_mom)
+    return w, new_mom

@@ -1,0 +1,155 @@
+"""Reduction / broadcast-shape / ordering operators.
+
+Reference analog: ``src/operator/tensor/broadcast_reduce_op*.{cc,cu}`` and
+``ordering_op.cc`` (topk/sort/argsort).  XLA lowers reductions onto the VPU
+with tree reductions; no hand kernels needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, param
+from ..base import MXNetError
+
+_REDUCE_PARAMS = {
+    "axis": param("shape", None),
+    "keepdims": param(bool, False),
+    "exclude": param(bool, False),
+}
+
+
+def _resolve_axes(attrs, ndim):
+    axis = attrs["axis"]
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if attrs.get("exclude"):
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(jfn):
+    def fn(attrs, x):
+        axes = _resolve_axes(attrs, x.ndim)
+        return jfn(x, axis=axes, keepdims=attrs["keepdims"])
+    return fn
+
+
+for _name, _jf in {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+    "max": jnp.max, "min": jnp.min,
+}.items():
+    register(_name, params=dict(_REDUCE_PARAMS), nin=1,
+             aliases=(_name + "_axis",) if _name in ("sum", "max", "min")
+                     else ())(
+        _make_reduce(_jf))
+
+
+@register("norm", nin=1, params={"ord": param(int, 2),
+                                 "axis": param("shape", None),
+                                 "keepdims": param(bool, False)})
+def _norm(attrs, x):
+    axis = attrs["axis"]
+    axes = tuple(a % x.ndim for a in axis) if axis else None
+    if attrs["ord"] == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=attrs["keepdims"])
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=attrs["keepdims"]))
+
+
+def _make_arg_reduce(jfn):
+    def fn(attrs, x):
+        axis = attrs["axis"]
+        if axis is None:
+            # reference semantics: flatten, return float index
+            r = jfn(x.reshape(-1), axis=0)
+            out = r.astype(jnp.float32)
+            return out.reshape((1,)) if attrs["keepdims"] else out
+        return jfn(x, axis=int(axis[0]),
+                   keepdims=attrs["keepdims"]).astype(jnp.float32)
+    return fn
+
+
+for _name, _jf in {"argmax": jnp.argmax, "argmin": jnp.argmin}.items():
+    register(_name, nin=1, params={"axis": param("shape", None),
+                                   "keepdims": param(bool, False)})(
+        _make_arg_reduce(_jf))
+
+register("argmax_channel", nin=1)(
+    lambda attrs, x: jnp.argmax(x, axis=1).astype(jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# broadcast-shape ops
+# --------------------------------------------------------------------------
+@register("broadcast_to", nin=1, params={"shape": param("shape", ())})
+def _broadcast_to(attrs, x):
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, attrs["shape"]))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", nin=1, aliases=("broadcast_axes",),
+          params={"axis": param("shape", ()), "size": param("shape", ())})
+def _broadcast_axis(attrs, x):
+    tgt = list(x.shape)
+    for a, s in zip(attrs["axis"], attrs["size"]):
+        tgt[a % x.ndim] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like", nin=2)
+def _broadcast_like(attrs, x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+# --------------------------------------------------------------------------
+# ordering ops (ref: src/operator/tensor/ordering_op.cc)
+# --------------------------------------------------------------------------
+_TOPK_PARAMS = {
+    "axis": param("shape", (-1,)),
+    "k": param(int, 1),
+    "ret_typ": param(["value", "indices", "mask", "both"], "indices"),
+    "is_ascend": param(bool, False),
+    "dtype": param("dtype", "float32"),
+}
+
+
+@register("topk", nin=1, params=dict(_TOPK_PARAMS),
+          nout=lambda attrs: 2 if attrs["ret_typ"] == "both" else 1)
+def _topk(attrs, x):
+    axis = int(attrs["axis"][0]) % x.ndim if attrs["axis"] else x.ndim - 1
+    k = attrs["k"] if attrs["k"] > 0 else x.shape[axis]
+    xs = -x if not attrs["is_ascend"] else x
+    idx = jnp.argsort(xs, axis=axis)
+    idx = jax.lax.slice_in_dim(idx, 0, k, axis=axis)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    rt = attrs["ret_typ"]
+    idt = np.dtype(attrs["dtype"] or "float32")
+    if rt == "value":
+        return vals
+    if rt == "indices":
+        return idx.astype(idt)
+    if rt == "mask":
+        mask = jnp.zeros_like(x)
+        return jnp.put_along_axis(mask, idx, 1.0, axis=axis, inplace=False)
+    return vals, idx.astype(idt)
+
+
+@register("sort", nin=1, params={"axis": param("shape", (-1,)),
+                                 "is_ascend": param(bool, True)})
+def _sort(attrs, x):
+    axis = int(attrs["axis"][0]) if attrs["axis"] else -1
+    s = jnp.sort(x, axis=axis)
+    return s if attrs["is_ascend"] else jnp.flip(s, axis=axis)
+
+
+@register("argsort", nin=1, params={"axis": param("shape", (-1,)),
+                                    "is_ascend": param(bool, True),
+                                    "dtype": param("dtype", "float32")})
+def _argsort(attrs, x):
+    axis = int(attrs["axis"][0]) if attrs["axis"] else -1
+    xs = x if attrs["is_ascend"] else -x
+    return jnp.argsort(xs, axis=axis).astype(np.dtype(attrs["dtype"] or "float32"))

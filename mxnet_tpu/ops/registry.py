@@ -1,0 +1,207 @@
+"""Operator registry: the TPU-native replacement for the NNVM op registry.
+
+Reference analog: ``NNVM_REGISTER_OP`` sites across ``src/operator/**`` with
+typed attributes (``include/mxnet/op_attr_types.h``): ``FCompute``,
+``FInferShape/Type``, ``FGradient``, resource requests.  TPU-native design:
+
+- Each op is ONE pure, jittable JAX function ``fn(attrs, *inputs) -> outputs``.
+  Forward AND backward come from this single definition: gradients are derived
+  with ``jax.vjp`` (the analog of FGradient), and shape/type inference is
+  ``jax.eval_shape`` (the analog of FInferShape/FInferType) — one source of
+  truth instead of four hand-written attribute functions per op.
+- ``attrs`` is a hashable :class:`~mxnet_tpu.base.AttrDict` parsed by a typed
+  parameter spec (the ``dmlc::Parameter`` analog), so compiled executables can
+  be cached on ``(op, attrs)`` — XLA then caches per input shape under `jit`.
+- Ops needing randomness declare ``needs_rng``; the dispatch layer threads an
+  explicit threefry key (SURVEY.md §7.3 "RNG parity").
+
+Eager dispatch cost (SURVEY.md §7.3): every op call goes through a
+``jax.jit``-wrapped callable cached on ``(name, attrs)``; XLA executable reuse
+across calls with equal shapes makes the imperative path cheap, and fused
+multi-op regions come from CachedOp/Executor jitting whole graphs.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..base import AttrDict, MXNetError
+
+__all__ = ["Operator", "register", "get_op", "list_ops", "apply_op",
+           "param", "OPS"]
+
+OPS: Dict[str, "Operator"] = {}
+
+
+# --------------------------------------------------------------------------
+# typed parameter spec — the dmlc::Parameter analog
+# --------------------------------------------------------------------------
+class param:
+    """One typed op parameter: ``param(type, default)``.
+
+    type is one of: int, float, bool, str, 'shape' (tuple of ints),
+    'dtype' (numpy dtype name).  Values arriving as strings (reference C-API
+    convention; also what Symbol JSON stores) are coerced.
+    """
+
+    def __init__(self, ptype, default=None, required=False):
+        self.ptype = ptype
+        self.default = default
+        self.required = required
+
+    def coerce(self, v):
+        t = self.ptype
+        if v is None:
+            return None
+        if t == "shape":
+            if isinstance(v, str):
+                v = ast.literal_eval(v)
+            if isinstance(v, (int, np.integer)):
+                return (int(v),)
+            return tuple(int(x) for x in v)
+        if t == "dtype":
+            if v in (None, "None"):
+                return None
+            return np.dtype(v).name
+        if t is bool:
+            if isinstance(v, str):
+                return v.lower() in ("1", "true", "yes", "on")
+            return bool(v)
+        if t is int:
+            return int(v)
+        if t is float:
+            return float(v)
+        if t is str:
+            return str(v)
+        if isinstance(t, (list, tuple)):  # enum
+            v = str(v)
+            if v not in t:
+                raise MXNetError("invalid enum value %r (expected one of %s)" % (v, t))
+            return v
+        return v
+
+
+class Operator:
+    """A registered operator."""
+
+    def __init__(self, name: str, fn: Callable, *,
+                 params: Optional[Dict[str, param]] = None,
+                 nin: Optional[int] = None, nout: Any = 1,
+                 needs_rng: bool = False,
+                 train_aware: bool = False,
+                 aux_writeback: Optional[Dict[int, int]] = None,
+                 arg_names: Optional[Sequence[str]] = None,
+                 aliases: Sequence[str] = (),
+                 mutate_inputs: Sequence[int] = (),
+                 doc: str = ""):
+        self.name = name
+        self.fn = fn
+        self.params = params or {}
+        self.nin = nin          # None = from arg_names; -1 = variadic
+        self.nout = nout        # int or callable(attrs)->int
+        self.needs_rng = needs_rng
+        # train_aware ops receive attrs['__train__'] from the dispatch layer
+        # (the analog of the reference's OpContext.is_train, op_attr_types.h).
+        self.train_aware = train_aware
+        # {output_idx: input_idx}: the dispatch layer writes these outputs
+        # back into the given inputs — how BatchNorm's moving-stat mutation
+        # and optimizer-state updates are expressed functionally on TPU.
+        self.aux_writeback = aux_writeback or {}
+        # user-visible output count (reference FNumVisibleOutputs): int,
+        # callable(attrs)->int, or None = all outputs visible.
+        self.visible = None
+        self.arg_names = list(arg_names) if arg_names else None
+        self.aliases = tuple(aliases)
+        self.mutate_inputs = tuple(mutate_inputs)  # e.g. optimizer update ops
+        self.doc = doc
+        self._jit_cache: Dict[AttrDict, Callable] = {}
+
+    # ---- attrs ----------------------------------------------------------
+    def parse_attrs(self, kwargs: Dict[str, Any]) -> AttrDict:
+        out = {}
+        for k, spec in self.params.items():
+            if k in kwargs:
+                out[k] = spec.coerce(kwargs.pop(k))
+            elif spec.required:
+                raise MXNetError("op %s: required param %r missing" % (self.name, k))
+            else:
+                out[k] = spec.default
+        # pass through unknown attrs untouched (reference tolerates extra
+        # attrs like __layout__ on symbols); keep only hashable ones
+        for k, v in list(kwargs.items()):
+            if k.startswith("__") or k in ("name", "ctx", "out"):
+                continue
+            out[k] = tuple(v) if isinstance(v, list) else v
+        return AttrDict(out)
+
+    def num_outputs(self, attrs: AttrDict) -> int:
+        return self.nout(attrs) if callable(self.nout) else self.nout
+
+    def num_visible_outputs(self, attrs: AttrDict) -> int:
+        if self.visible is None:
+            return self.num_outputs(attrs)
+        return self.visible(attrs) if callable(self.visible) else self.visible
+
+    # ---- execution ------------------------------------------------------
+    def compiled(self, attrs: AttrDict) -> Callable:
+        """jit-compiled entry for these attrs (shape-specialized by XLA)."""
+        c = self._jit_cache.get(attrs)
+        if c is None:
+            fn = self.fn
+            c = jax.jit(lambda *arrays: fn(attrs, *arrays))
+            self._jit_cache[attrs] = c
+        return c
+
+    def __call__(self, attrs: AttrDict, *arrays):
+        return self.compiled(attrs)(*arrays)
+
+    def abstract_eval(self, attrs: AttrDict, *avals):
+        """Shape/dtype inference = jax.eval_shape (replaces FInferShape/Type)."""
+        fn = self.fn
+        return jax.eval_shape(lambda *xs: fn(attrs, *xs), *avals)
+
+    def __repr__(self):
+        return "<Operator %s>" % self.name
+
+
+def register(name: str, *, params=None, nin=None, nout=1, needs_rng=False,
+             train_aware=False, aux_writeback=None, visible=None,
+             arg_names=None, aliases=(), mutate_inputs=(), doc=""):
+    """Decorator: register a pure JAX function as an operator."""
+
+    def deco(fn):
+        op = Operator(name, fn, params=params, nin=nin, nout=nout,
+                      needs_rng=needs_rng, train_aware=train_aware,
+                      aux_writeback=aux_writeback, arg_names=arg_names,
+                      aliases=aliases, mutate_inputs=mutate_inputs,
+                      doc=doc or (fn.__doc__ or ""))
+        op.visible = visible
+        OPS[name] = op
+        for a in aliases:
+            OPS[a] = op
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> Operator:
+    op = OPS.get(name)
+    if op is None:
+        raise MXNetError("Operator %r is not registered (have %d ops)"
+                         % (name, len(OPS)))
+    return op
+
+
+def list_ops():
+    return sorted(OPS)
+
+
+def apply_op(name: str, *arrays, **kwargs):
+    """Low-level functional invoke: parse attrs, run, return raw jax arrays."""
+    op = get_op(name)
+    attrs = op.parse_attrs(dict(kwargs))
+    return op(attrs, *arrays)

@@ -1,0 +1,45 @@
+"""Global RNG state preserving the ``mx.random.seed`` UX over threefry keys.
+
+Reference analog: per-device RNG resources (``src/common/random_generator.h:
+45-97``, ``src/resource.cc``) seeded by ``mx.random.seed``.  TPU-native: one
+global threefry key; every random op call splits a fresh subkey (functional,
+reproducible, parallel-safe — SURVEY.md §7.3 "RNG parity").
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_lock = threading.Lock()
+_key = None
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (parity: mxnet.random.seed)."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
+
+
+def next_key():
+    """Split and return a fresh subkey for one random-op call."""
+    global _key
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(0)
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def current_key():
+    global _key
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(0)
+        return _key
+
+
+# re-exported sampling functions are generated into mxnet_tpu.ndarray.random

@@ -1,0 +1,35 @@
+"""Test config: run on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): one op/suite
+parameterized by backend; multi-device tests run on virtual host devices
+(``--xla_force_host_platform_device_count=8``), the analog of the reference's
+process-level fake cluster (tests/nightly/test_all.sh).
+"""
+import os
+
+_platform = os.environ.get("MXNET_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax may already be imported (sitecustomize registers accelerator plugins at
+# interpreter start and captures JAX_PLATFORMS from the outer env), so update
+# the live config too — this must happen before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", _platform)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Analog of the reference @with_seed() fixture (tests/python/unittest/
+    common.py:97-130): deterministic per-test seeds."""
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

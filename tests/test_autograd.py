@@ -1,0 +1,159 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2.0
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2.0)  # = x^2
+        z = y.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [4, 5])
+    assert np.allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3.0 * x
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g], "add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2.0 * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_not_recording_outside_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2.0  # not recorded
+    assert getattr(y, "_ag_entry") is None
+    with autograd.record():
+        assert autograd.is_recording()
+        z = x * 2.0
+    assert getattr(z, "_ag_entry") is not None
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * 2.0
+        z = x * 3.0
+    assert getattr(y, "_ag_entry") is None
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_train_mode_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    out_eval = nd.Dropout(x, p=0.5)
+    assert np.allclose(out_eval.asnumpy(), 1.0)
+    with autograd.record():
+        out_train = nd.Dropout(x, p=0.5)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_grad_function():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    g = autograd.grad([y], [x])[0]
+    assert np.allclose(g.asnumpy(), [4.0, 6.0])
+    # .grad buffer untouched by functional grad API
+    assert np.allclose(x.grad.asnumpy(), 0.0)
+
+
+def test_retain_graph():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 5.0
+    y.backward(retain_graph=True)
+    assert np.allclose(x.grad.asnumpy(), [5.0])
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_backward_through_conv():
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    w = nd.array(np.random.rand(4, 3, 3, 3).astype(np.float32))
+    b = nd.zeros((4,))
+    for v in (x, w, b):
+        v.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+        loss = (y * y).sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert w.grad.shape == w.shape
+    assert b.grad.shape == b.shape
+    assert float(nd.abs(w.grad).sum().asscalar()) > 0

@@ -1,0 +1,183 @@
+"""NDArray core tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+    b = nd.ones((2, 2), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 3), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    assert np.allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    assert np.allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    assert np.allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    assert np.allclose((8 / a).asnumpy(), [[8, 4], [8 / 3, 2]])
+    assert np.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert np.allclose(a.asnumpy(), 2)
+    a *= 3
+    assert np.allclose(a.asnumpy(), 6)
+    a /= 2
+    assert np.allclose(a.asnumpy(), 3)
+    a -= 1
+    assert np.allclose(a.asnumpy(), 2)
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a >= b).asnumpy(), [0, 1, 1])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a != 2).asnumpy(), [1, 0, 1])
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = nd.array([1.0, 2.0])
+    assert c.broadcast_to((3, 2)).shape == (3, 2)
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.allclose(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    assert np.allclose(a[:, 2].asnumpy(), [2, 6, 10])
+    a[0] = 100.0
+    assert np.allclose(a[0].asnumpy(), 100)
+    a[:] = 0.0
+    assert np.allclose(a.asnumpy(), 0)
+    a[1, 2] = 5.0
+    assert a.asnumpy()[1, 2] == 5.0
+
+
+def test_reshape_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((24,)).shape == (24,)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert a.reshape((6, 4)).shape == (6, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    assert np.allclose(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    assert np.allclose(a.sum(axis=1, keepdims=True).asnumpy(), [[3], [12]])
+    assert a.max().asscalar() == 5
+    assert a.min().asscalar() == 0
+    assert np.isclose(a.mean().asscalar(), 2.5)
+    assert np.allclose(a.argmax(axis=1).asnumpy(), [2, 2])
+    n = a.norm().asscalar()
+    assert np.isclose(n, np.sqrt((np.arange(6) ** 2).sum()), rtol=1e-5)
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(4, 5))
+    c = nd.dot(a, b)
+    assert c.shape == (3, 5)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    # transpose flags
+    d = nd.dot(a, b.T, transpose_b=True)
+    assert np.allclose(d.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_copy_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert np.allclose(a.asnumpy(), 1)
+    assert np.allclose(b.asnumpy(), 2)
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+
+
+def test_astype_scalar():
+    a = nd.array([3.7])
+    assert a.astype("int32").dtype == np.int32
+    assert np.isclose(a.asscalar(), 3.7)
+    assert float(a) == pytest.approx(3.7)
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    a, b = nd.ones((2, 2)), nd.zeros((3,))
+    nd.save(f, [a, b])
+    loaded = nd.load(f)
+    assert len(loaded) == 2
+    assert np.allclose(loaded[0].asnumpy(), 1)
+    nd.save(f, {"w": a, "b": b})
+    d = nd.load(f)
+    assert set(d) == {"w", "b"}
+
+
+def test_take_one_hot_pick():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(w, idx)
+    assert np.allclose(t.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(idx, 4)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    p = nd.pick(x, nd.array([0, 1]), axis=1)
+    assert np.allclose(p.asnumpy(), [1, 4])
+
+
+def test_elemwise_math():
+    a = nd.array([1.0, 4.0, 9.0])
+    assert np.allclose(nd.sqrt(a).asnumpy(), [1, 2, 3])
+    assert np.allclose(nd.square(a).asnumpy(), [1, 16, 81])
+    assert np.allclose(nd.exp(nd.zeros((2,))).asnumpy(), 1)
+    assert np.allclose(nd.relu(nd.array([-1.0, 2.0])).asnumpy(), [0, 2])
+    assert np.allclose(nd.clip(a, 2.0, 5.0).asnumpy(), [2, 4, 5])
+    assert np.allclose(nd.add_n(a, a, a).asnumpy(), 3 * a.asnumpy())
+
+
+def test_wait_sync():
+    a = nd.ones((4, 4))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert np.allclose(b.asnumpy(), 2)
