@@ -18,3 +18,33 @@ from . import random
 from . import autograd
 
 from .ndarray import NDArray
+
+from . import name
+from . import attribute
+from .name import NameManager
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import recordio
+from . import kvstore as kv
+from .kvstore import KVStore
+from . import parallel
+from . import module
+from . import module as mod
+from . import model
+from .model import FeedForward
+
+
+def kvstore_create(name="local"):
+    from .kvstore import create as _c
+    return _c(name)

@@ -1,0 +1,39 @@
+"""Attribute scope (parity: python/mxnet/attribute.py AttrScope): attaches
+default attrs (e.g. ctx_group for coarse model parallelism, __lr_mult__) to
+symbols created inside the scope."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_local = threading.local()
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+        self._old = None
+
+    def get(self, attr):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        self._old = getattr(_local, "scope", None)
+        if self._old is not None:
+            merged = dict(self._old._attr)
+            merged.update(self._attr)
+            self._attr = merged
+        _local.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.scope = self._old
+
+
+def current_attrs():
+    scope = getattr(_local, "scope", None)
+    return dict(scope._attr) if scope is not None else {}

@@ -1,0 +1,341 @@
+"""Executor: a bound symbolic graph, compiled whole by XLA.
+
+Reference analog: ``include/mxnet/executor.h`` + ``src/executor/
+graph_executor.cc`` (GraphExecutor::Init/Forward/Backward, SURVEY.md N6).
+
+TPU-native design: binding builds ONE pure function over the graph and
+``jax.jit``s it — XLA takes over everything GraphExecutor did by hand:
+memory planning (PlanMemory pass → XLA buffer assignment), op fusion (bulk
+exec segments → XLA fusion), layout, and stream scheduling.  The backward
+graph is ``jax.vjp`` of that function (the nnvm Gradient pass analog); the
+fused ``forward_backward`` entry used by Module.fit compiles forward+backward
+into a single XLA program so training steps are one device launch.
+Monitor callbacks (GraphExecutor::SetMonitorCallback, graph_executor.cc:123)
+run through an un-jitted eager replay of the same plan.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, AttrDict
+from .context import Context
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+class _Plan:
+    """Precomputed execution plan for a symbol graph."""
+
+    def __init__(self, symbol, train: bool):
+        from .symbol.symbol import _Node  # noqa: F401
+
+        self.topo = symbol._topo()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.out_entries = [(id(n), i) for n, i in symbol._outputs]
+        aux_ids = {}
+        for node in self.topo:
+            if node.is_var and node.name in self.aux_names:
+                aux_ids[id(node)] = node.name
+        self.steps = []
+        self.n_rng = 0
+        for node in self.topo:
+            if node.is_var:
+                continue
+            attrs = node.parsed_attrs()
+            if node.op.train_aware:
+                attrs = AttrDict({**attrs, "__train__": train})
+            if node.op.nin == -1 and "num_args" in node.op.params:
+                attrs = AttrDict({**attrs, "num_args": len(node.inputs)})
+            rng_slot = None
+            if node.op.needs_rng:
+                rng_slot = self.n_rng
+                self.n_rng += 1
+            # aux writeback: map op output index -> aux name
+            wb = {}
+            if train:
+                for oi, ii in node.op.aux_writeback.items():
+                    if ii < len(node.inputs):
+                        src = node.inputs[ii][0]
+                        if id(src) in aux_ids:
+                            wb[oi] = aux_ids[id(src)]
+            self.steps.append((node, attrs, rng_slot, wb))
+
+    def execute(self, arg_vals: Dict[str, Any], aux_vals: Dict[str, Any],
+                keys, monitor=None):
+        """Run the plan on jax values (traceable under jit)."""
+        env: Dict[Tuple[int, int], Any] = {}
+        for node in self.topo:
+            if node.is_var:
+                if node.name in arg_vals:
+                    env[(id(node), 0)] = arg_vals[node.name]
+                elif node.name in aux_vals:
+                    env[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+        new_aux = dict(aux_vals)
+        for node, attrs, rng_slot, wb in self.steps:
+            ins = [env[(id(p), i)] for p, i in node.inputs]
+            if rng_slot is not None:
+                ins = [keys[rng_slot]] + ins
+            res = node.op.fn(attrs, *ins)
+            outs = res if isinstance(res, tuple) else (res,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+            for oi, aux_name in wb.items():
+                new_aux[aux_name] = outs[oi]
+            if monitor is not None:
+                for i in range(node.num_visible()):
+                    monitor(node.name + "_output", outs[i])
+        outputs = [env[e] for e in self.out_entries]
+        return outputs, new_aux
+
+
+class Executor:
+    """A bound executor (parity: mxnet.executor.Executor)."""
+
+    def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
+                 args_grad: Dict[str, Any], grad_req: Dict[str, str],
+                 aux_states: Dict[str, Any]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = args_grad
+        self.aux_dict = aux_states
+        self._grad_req = grad_req
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        missing = [n for n in self.arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self._plans: Dict[bool, _Plan] = {}
+        self._jitted: Dict[Any, Any] = {}
+        self.outputs_nd: List[Any] = []
+        self._last_keys = None
+        self._monitor = None
+        self._grad_args = [n for n in self.arg_names
+                           if grad_req.get(n, "null") != "null"]
+
+    # -- helpers ----------------------------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def _plan(self, train: bool) -> _Plan:
+        if train not in self._plans:
+            self._plans[train] = _Plan(self._symbol, train)
+        return self._plans[train]
+
+    def _keys(self, plan: _Plan):
+        if plan.n_rng == 0:
+            return jnp.zeros((0, 2), np.uint32)
+        ks = [_random.next_key() for _ in range(plan.n_rng)]
+        return jnp.stack(ks)
+
+    def _fwd_fn(self, train: bool):
+        key = ("fwd", train)
+        if key not in self._jitted:
+            plan = self._plan(train)
+            arg_names, aux_names = plan.arg_names, plan.aux_names
+
+            def fn(arg_list, aux_list, keys):
+                outs, new_aux = plan.execute(
+                    dict(zip(arg_names, arg_list)),
+                    dict(zip(aux_names, aux_list)), keys)
+                return outs, [new_aux[n] for n in aux_names]
+
+            self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def _fwd_bwd_fn(self):
+        """Single compiled program: forward + vjp-backward (+aux update)."""
+        if ("fwdbwd",) not in self._jitted:
+            plan = self._plan(True)
+            arg_names, aux_names = plan.arg_names, plan.aux_names
+            grad_args = self._grad_args
+
+            def fn(arg_list, aux_list, keys, ograds):
+                base = dict(zip(arg_names, arg_list))
+
+                def pure(gvals):
+                    av = dict(base)
+                    av.update(dict(zip(grad_args, gvals)))
+                    outs, new_aux = plan.execute(
+                        av, dict(zip(aux_names, aux_list)), keys)
+                    return outs, [new_aux[n] for n in aux_names]
+
+                gvals = [base[n] for n in grad_args]
+                (outs, new_aux), vjp = jax.vjp(
+                    lambda *g: pure(list(g)), *gvals)
+                cots = (list(ograds),
+                        [jnp.zeros_like(a) for a in new_aux])
+                grads = vjp(cots)
+                return outs, new_aux, list(grads)
+
+            self._jitted[("fwdbwd",)] = jax.jit(fn)
+        return self._jitted[("fwdbwd",)]
+
+    def _gather(self):
+        args = [self.arg_dict[n]._data for n in self.arg_names]
+        auxs = [self.aux_dict[n]._data for n in self.aux_names]
+        return args, auxs
+
+    def _wrap_outputs(self, outs):
+        from .ndarray.ndarray import NDArray
+        self.outputs_nd = [NDArray(o, self._ctx) for o in outs]
+        return self.outputs_nd
+
+    def _writeback_aux(self, new_aux):
+        for n, v in zip(self.aux_names, new_aux):
+            self.aux_dict[n]._data = v
+
+    # -- public API -------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs):
+        from .ndarray.ndarray import NDArray
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown input %r" % k)
+            dst = self.arg_dict[k]
+            dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
+                else jnp.asarray(v, dst.dtype)
+        plan = self._plan(bool(is_train))
+        keys = self._keys(plan)
+        self._last_keys = keys
+        if self._monitor is not None:
+            args, auxs = self._gather()
+            outs, new_aux = plan.execute(
+                dict(zip(self.arg_names, args)),
+                dict(zip(self.aux_names, auxs)), keys,
+                monitor=self._monitor)
+            new_aux = [new_aux[n] for n in self.aux_names]
+        else:
+            outs, new_aux = self._fwd_fn(bool(is_train))(*self._gather(), keys)
+        if is_train:
+            self._writeback_aux(new_aux)
+        return self._wrap_outputs(outs)
+
+    def backward(self, out_grads=None, is_train=True):
+        """Gradients w.r.t. args with grad_req != null.  Recomputes the
+        forward inside one fused XLA program (rematerialization — the TPU
+        analog of MXNET_BACKWARD_DO_MIRROR, trading FLOPs for HBM)."""
+        from .ndarray.ndarray import NDArray
+        plan = self._plan(True)
+        if out_grads is None:
+            ogs = [jnp.ones(self.outputs_nd[i].shape,
+                            self.outputs_nd[i].dtype)
+                   for i in range(len(plan.out_entries))]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        keys = self._last_keys if self._last_keys is not None \
+            else self._keys(plan)
+        args, auxs = self._gather()
+        outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+        self._apply_grads(grads)
+        return
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused train step: one XLA program for fwd+bwd (+aux update)."""
+        from .ndarray.ndarray import NDArray
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                dst = self.arg_dict[k]
+                dst._data = v._data.astype(dst.dtype) \
+                    if isinstance(v, NDArray) else jnp.asarray(v, dst.dtype)
+        plan = self._plan(True)
+        keys = self._keys(plan)
+        self._last_keys = keys
+        args, auxs = self._gather()
+        if out_grads is None:
+            shape_key = tuple(self.arg_dict[n].shape for n in self.arg_names)
+            cached = self._jitted.get(("oshapes", shape_key))
+            if cached is None:
+                _, cached, _ = self._symbol.infer_shape(
+                    **{n: self.arg_dict[n].shape for n in self.arg_names})
+                self._jitted[("oshapes", shape_key)] = cached
+            ogs = [jnp.ones(s, np.float32) for s in cached]
+        else:
+            ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+        self._writeback_aux(new_aux)
+        self._apply_grads(grads)
+        return self._wrap_outputs(outs)
+
+    def _apply_grads(self, grads):
+        for n, g in zip(self._grad_args, grads):
+            if n not in self.grad_dict:
+                continue
+            dst = self.grad_dict[n]
+            if self._grad_req.get(n) == "add":
+                dst._data = dst._data + g.astype(dst.dtype)
+            else:
+                dst._data = g.astype(dst.dtype)
+
+    @property
+    def outputs(self):
+        return self.outputs_nd
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k][:] = v
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k][:] = v
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %r" % k)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-node-output callback (runs the un-jitted plan)."""
+        if callback is None:
+            self._monitor = None
+            return
+
+        def mon(name, arr):
+            from .ndarray.ndarray import NDArray
+            callback(name, NDArray(arr, self._ctx))
+
+        self._monitor = mon
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (compile cache keyed on shapes by jit)."""
+        from . import ndarray as nd
+        new_shapes, _, new_aux_shapes = self._symbol.infer_shape(**kwargs)
+        args = {}
+        for n, s in zip(self.arg_names, new_shapes):
+            cur = self.arg_dict[n]
+            args[n] = cur if cur.shape == s else nd.zeros(s, ctx=self._ctx,
+                                                          dtype=cur.dtype)
+        auxs = {}
+        for n, s in zip(self.aux_names, new_aux_shapes):
+            cur = self.aux_dict[n]
+            auxs[n] = cur if cur.shape == s else nd.zeros(s, ctx=self._ctx,
+                                                          dtype=cur.dtype)
+        grads = {n: nd.zeros(a.shape, ctx=self._ctx, dtype=a.dtype)
+                 for n, a in args.items() if n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self._grad_req, auxs)

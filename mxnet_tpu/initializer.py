@@ -1,0 +1,272 @@
+"""Weight initializers (parity: python/mxnet/initializer.py — Zero/One/
+Constant/Uniform/Normal/Orthogonal/Xavier/MSRAPrelu/Bilinear/LSTMBias/Mixed,
+registry + InitDesc attribute protocol, initializer.py:377-678)."""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as np
+
+from .base import Registry, MXNetError
+from . import ndarray as nd
+
+__all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register"]
+
+_registry = Registry("initializer")
+
+
+def register(klass):
+    _registry.register(klass.__name__, klass)
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (ref InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        s = super().__new__(cls, name)
+        s.attrs = attrs or {}
+        s.global_init = global_init
+        return s
+
+
+class Initializer:
+    """Base initializer; callable on (InitDesc/name, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init_attr = desc.attrs.get("__init__", "")
+        if init_attr:
+            klass, kwargs = json.loads(init_attr) if init_attr.startswith("[") \
+                else (init_attr, {})
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif "moving_mean" in name or "running_mean" in name:
+            self._init_zero(desc, arr)
+        elif ("moving_var" in name or "running_var" in name or
+              "moving_inv_var" in name):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr._data = nd.random.uniform(-self.scale, self.scale,
+                                      shape=arr.shape)._data.astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr._data = nd.random.normal(0, self.sigma,
+                                     shape=arr.shape)._data.astype(arr.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1, 1, (nout, nin))
+        else:
+            tmp = np.random.normal(0, 1, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(np.float32)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._data = nd.random.uniform(
+                -scale, scale, shape=shape)._data.astype(arr.dtype)
+        else:
+            arr._data = nd.random.normal(
+                0, scale, shape=shape)._data.astype(arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for UpSampling/Deconvolution)."""
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = custom value, rest 0 (ref initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+class Load:
+    """Init from a dict of arrays, fall back to default (ref Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            arr[:] = self.param[name]
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError("cannot init %r: not found and no default" % name)
+
+
+@register
+class Mixed:
+    """Pattern-dispatch initializer (ref Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("no initializer pattern matches %r" % name)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _registry.get(name)(**kwargs)

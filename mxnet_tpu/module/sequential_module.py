@@ -1,0 +1,81 @@
+"""SequentialModule: chain of modules (parity:
+python/mxnet/module/sequential_module.py).  Rarely used; provided for API
+completeness with forward/backward chaining."""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules = []
+        self._metas = []
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        shapes = data_shapes
+        for i, mod in enumerate(self._modules):
+            take_labels = self._metas[i].get(self.META_TAKE_LABELS, False)
+            mod.bind(shapes, label_shapes if take_labels else None,
+                     for_training, inputs_need_grad or i > 0,
+                     force_rebind, grad_req=grad_req)
+            shapes = [type(shapes[0])(n, s, "float32", "NCHW") if not
+                      hasattr(shapes[0], "_fields") else shapes[0]
+                      for n, s in mod.output_shapes]
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, **kwargs):
+        for mod in self._modules:
+            mod.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        for mod in self._modules:
+            mod.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        from ..io import DataBatch
+        batch = data_batch
+        for mod in self._modules:
+            mod.forward(batch, is_train)
+            outs = mod.get_outputs()
+            batch = DataBatch(outs, data_batch.label)
+
+    def backward(self, out_grads=None):
+        for mod in reversed(self._modules):
+            mod.backward(out_grads)
+            out_grads = mod.get_input_grads()
+
+    def update(self):
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for mod in self._modules:
+            a, x = mod.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def update_metric(self, eval_metric, labels):
+        self._modules[-1].update_metric(eval_metric, labels)

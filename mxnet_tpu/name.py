@@ -1,0 +1,42 @@
+"""Name manager (parity: python/mxnet/name.py NameManager/Prefix)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current_scope"]
+
+_local = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        i = self._counter.get(hint, 0)
+        self._counter[hint] = i + 1
+        return "%s%d" % (hint, i)
+
+    def __enter__(self):
+        self._old = getattr(_local, "scope", None)
+        _local.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.scope = self._old
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)
+
+
+def current_scope():
+    return getattr(_local, "scope", None)

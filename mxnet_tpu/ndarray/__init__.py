@@ -12,3 +12,24 @@ from . import random  # noqa: F401
 _register.populate(globals())
 
 from .utils import *  # noqa: F401,F403
+
+
+def maximum(lhs, rhs):
+    """mx.nd.maximum with scalar/array dispatch (parity: ndarray.py)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke("broadcast_maximum", lhs, rhs)
+    if isinstance(lhs, NDArray):
+        return imperative_invoke("_maximum_scalar", lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return imperative_invoke("_maximum_scalar", rhs, scalar=float(lhs))
+    return max(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke("broadcast_minimum", lhs, rhs)
+    if isinstance(lhs, NDArray):
+        return imperative_invoke("_minimum_scalar", lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return imperative_invoke("_minimum_scalar", rhs, scalar=float(lhs))
+    return min(lhs, rhs)

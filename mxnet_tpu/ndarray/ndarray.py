@@ -448,17 +448,19 @@ class NDArray:
             v = value
         else:
             v = jnp.asarray(value)
+        dev = next(iter(self._data.devices()))
         if isinstance(key, slice) and key == slice(None):
             if isinstance(v, (int, float)):
                 self._data = jnp.full_like(self._data, v)
             else:
-                self._data = jnp.broadcast_to(
-                    jnp.asarray(v, self._data.dtype), self.shape) + \
-                    jnp.zeros_like(self._data)
+                val = jnp.broadcast_to(jnp.asarray(v, self._data.dtype),
+                                       self.shape)
+                self._data = jax.device_put(val, dev)
             return
         key = self._canon_index(key)
         # cast to the array dtype (reference semantics: assignment casts)
-        v = jnp.asarray(v, self._data.dtype)
+        # and pin to this array's device (cross-device assignment copies)
+        v = jax.device_put(jnp.asarray(v, self._data.dtype), dev)
         self._data = self._data.at[key].set(v)
 
     def __iter__(self):

@@ -14,3 +14,4 @@ from . import matrix        # noqa: F401
 from . import nn            # noqa: F401
 from . import init_random   # noqa: F401
 from . import optimizer_ops # noqa: F401
+from . import shape_hints   # noqa: F401  (installs arg names + infer hints)

@@ -124,7 +124,7 @@ _UNARY = {
     "ceil": jnp.ceil,
     "floor": jnp.floor,
     "trunc": jnp.trunc,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,  # round toward zero (jnp.fix deprecated in jax 0.9)
     "square": jnp.square,
     "sqrt": jnp.sqrt,
     "rsqrt": lambda x: jax.lax.rsqrt(x),

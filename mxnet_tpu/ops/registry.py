@@ -114,6 +114,13 @@ class Operator:
         # user-visible output count (reference FNumVisibleOutputs): int,
         # callable(attrs)->int, or None = all outputs visible.
         self.visible = None
+        # indices of auxiliary inputs (reference FListAuxiliaryStates —
+        # BatchNorm's moving stats): not gradient targets, not arguments.
+        self.aux_inputs: Tuple[int, ...] = ()
+        # partial shape inference hook: fn(attrs, in_shapes) -> in_shapes
+        # with None entries filled (the FInferShape analog for inferring
+        # parameter shapes from data shape, e.g. conv weights).
+        self.shape_hint = None
         self.arg_names = list(arg_names) if arg_names else None
         self.aliases = tuple(aliases)
         self.mutate_inputs = tuple(mutate_inputs)  # e.g. optimizer update ops

@@ -1,0 +1,143 @@
+"""Partial shape inference hints + input names for parameter-bearing ops.
+
+Reference analog: per-op ``FInferShape`` functions (e.g. ``ConvolutionShape``
+in src/operator/nn/convolution.cc) which *fill in* weight/bias shapes from the
+data shape so ``simple_bind`` can allocate parameters automatically, and
+``FListInputNames`` which names them (data/weight/bias...) for
+``list_arguments``.  TPU-native: full-output inference is jax.eval_shape; only
+the backward "fill the unknown param shapes" step needs these hints.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import OPS
+
+
+def _conv_hint(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = attrs["kernel"]
+    nf, g = attrs["num_filter"], attrs["num_group"]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (nf, data[1] // g) + tuple(k)
+    if len(out) > 2 and out[2] is None and not attrs["no_bias"]:
+        out[2] = (nf,)
+    return out
+
+
+def _deconv_hint(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = attrs["kernel"]
+    nf, g = attrs["num_filter"], attrs["num_group"]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (data[1], nf // g) + tuple(k)
+    if len(out) > 2 and out[2] is None and not attrs["no_bias"]:
+        out[2] = (nf,)
+    return out
+
+
+def _fc_hint(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    nh = attrs["num_hidden"]
+    in_dim = int(np.prod(data[1:])) if attrs.get("flatten", True) else data[-1]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (nh, in_dim)
+    if len(out) > 2 and out[2] is None and not attrs["no_bias"]:
+        out[2] = (nh,)
+    return out
+
+
+def _channel_hint(axis_attr=None, default_axis=1, n_params=None):
+    def hint(attrs, shapes):
+        data = shapes[0]
+        if data is None:
+            return shapes
+        ax = attrs.get(axis_attr, default_axis) if axis_attr else default_axis
+        c = data[ax % len(data)]
+        out = list(shapes)
+        for i in range(1, len(out)):
+            if out[i] is None:
+                out[i] = (c,)
+        return out
+    return hint
+
+
+def _embedding_hint(attrs, shapes):
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (attrs["input_dim"], attrs["output_dim"])
+    return out
+
+
+def _softmax_label_hint(attrs, shapes):
+    """SoftmaxOutput: label = data shape minus the class dim."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        if attrs.get("multi_output"):
+            out[1] = (data[0],) + tuple(data[2:])
+        else:
+            out[1] = (data[0],)
+    return out
+
+
+def _label_like_hint(attrs, shapes):
+    """Regression outputs: label shape defaults to data shape."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = data
+    return out
+
+
+def install():
+    cfg = {
+        "Convolution": (("data", "weight", "bias"), (), _conv_hint),
+        "Deconvolution": (("data", "weight", "bias"), (), _deconv_hint),
+        "FullyConnected": (("data", "weight", "bias"), (), _fc_hint),
+        "BatchNorm": (("data", "gamma", "beta", "moving_mean", "moving_var"),
+                      (3, 4), _channel_hint("axis", 1)),
+        "LayerNorm": (("data", "gamma", "beta"), (),
+                      _channel_hint("axis", -1)),
+        "InstanceNorm": (("data", "gamma", "beta"), (), _channel_hint()),
+        "Embedding": (("data", "weight"), (), _embedding_hint),
+        "LeakyReLU": (("data", "gamma"), (), _channel_hint()),
+        "SoftmaxOutput": (("data", "label"), (), _softmax_label_hint),
+        "LinearRegressionOutput": (("data", "label"), (), _label_like_hint),
+        "LogisticRegressionOutput": (("data", "label"), (), _label_like_hint),
+        "MAERegressionOutput": (("data", "label"), (), _label_like_hint),
+        "softmax_cross_entropy": (("data", "label"), (), _label_like_hint),
+        "SequenceMask": (("data", "sequence_length"), (), None),
+        "SequenceLast": (("data", "sequence_length"), (), None),
+        "SequenceReverse": (("data", "sequence_length"), (), None),
+        "dot": (("lhs", "rhs"), (), None),
+        "batch_dot": (("lhs", "rhs"), (), None),
+        "broadcast_add": (("lhs", "rhs"), (), None),
+        "broadcast_sub": (("lhs", "rhs"), (), None),
+        "broadcast_mul": (("lhs", "rhs"), (), None),
+        "broadcast_div": (("lhs", "rhs"), (), None),
+    }
+    for name, (arg_names, aux, hint) in cfg.items():
+        op = OPS.get(name)
+        if op is None:
+            continue
+        op.arg_names = list(arg_names)
+        op.aux_inputs = tuple(aux)
+        if hint is not None:
+            op.shape_hint = hint
+
+
+install()

@@ -1,0 +1,13 @@
+"""Parallelism & distribution: meshes, shardings, collectives, train steps.
+
+This is the TPU-native replacement for the reference's distribution stack
+(SURVEY.md §2.2, §5.8): instead of NCCL reduce trees + a ps-lite parameter
+server, everything is XLA collectives over an ICI/DCN device mesh driven by
+``pjit``/``shard_map``.
+"""
+from .mesh import (make_mesh, data_parallel_sharding, replicated_sharding,
+                   ShardingRules)
+from .comm import ProcessGroup, process_group, init_distributed
+from .data_parallel import DataParallelTrainer, dp_train_step
+from . import tensor_parallel
+from . import ring_attention

@@ -1,0 +1,113 @@
+"""Distributed communication backend: process group over jax.distributed.
+
+Reference analog: ps-lite worker/server/scheduler roles over ZMQ
+(SURVEY.md N12) + the dmlc_tracker launcher env (DMLC_ROLE, DMLC_PS_ROOT_URI).
+TPU-native: a flat process group on the JAX distributed runtime — rank/size
+from the coordinator, collectives as XLA ops over DCN/ICI.  The reference's
+launcher env vars are honored so ``tools/launch.py``-style scripts keep
+working: DMLC_NUM_WORKER → num processes, DMLC_WORKER_ID → rank,
+DMLC_PS_ROOT_URI/PORT → coordinator address.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..base import get_env
+
+__all__ = ["ProcessGroup", "process_group", "init_distributed"]
+
+_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Initialize the multi-host runtime (idempotent).
+
+    Maps the reference launcher env (DMLC_*) onto jax.distributed; also
+    accepts native JAX env (JAX_COORDINATOR_ADDRESS etc.).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        coordinator = "%s:%s" % (os.environ["DMLC_PS_ROOT_URI"],
+                                 os.environ.get("DMLC_PS_ROOT_PORT", "9000"))
+    num_processes = num_processes or get_env("DMLC_NUM_WORKER", None, int)
+    process_id = process_id if process_id is not None \
+        else get_env("DMLC_WORKER_ID", None, int)
+    if coordinator is not None and num_processes and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+class ProcessGroup:
+    """Flat all-reduce group across JAX processes."""
+
+    def __init__(self):
+        init_distributed()
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+        self._mesh = None
+
+    def _global_mesh(self):
+        if self._mesh is None:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(np.asarray(jax.devices()), ("all",))
+        return self._mesh
+
+    def allreduce(self, arr):
+        """Cross-process sum.  Single-process: identity (local reduce
+        already happened).  Multi-process: psum over the global mesh via
+        shard_map (XLA collective over DCN/ICI)."""
+        if self.size == 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from ..ndarray.ndarray import NDArray
+        mesh = self._global_mesh()
+        data = arr._data if isinstance(arr, NDArray) else arr
+
+        @jax.jit
+        def _psum(x):
+            f = shard_map(lambda v: jax.lax.psum(v, "all"), mesh=mesh,
+                          in_specs=P(), out_specs=P())
+            return f(x)
+
+        out = _psum(data)
+        return NDArray(out, arr._ctx) if isinstance(arr, NDArray) else out
+
+    def broadcast(self, arr, root=0):
+        if self.size == 1:
+            return arr
+        # psum of (x if rank==root else 0) — one collective
+        from ..ndarray.ndarray import NDArray
+        data = arr._data if isinstance(arr, NDArray) else arr
+        scaled = data if self.rank == root else data * 0
+        out = self.allreduce(NDArray(scaled, getattr(arr, "_ctx", None))
+                             if isinstance(arr, NDArray) else scaled)
+        return out
+
+    def barrier(self):
+        if self.size == 1:
+            return
+        from ..ndarray import ndarray as _nd
+        one = _nd.ones((1,))
+        self.allreduce(one).wait_to_read()
+
+
+_group: Optional[ProcessGroup] = None
+
+
+def process_group() -> ProcessGroup:
+    global _group
+    if _group is None:
+        _group = ProcessGroup()
+    return _group

@@ -1,0 +1,94 @@
+"""Device mesh + sharding-rule helpers.
+
+The scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives.  Axis conventions: ``dp`` (data/batch), ``tp`` (tensor/model),
+``sp`` (sequence/context), ``pp`` (pipeline stage), ``ep`` (expert).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding",
+           "ShardingRules", "P"]
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
+
+    Axis sizes must multiply to the device count; an axis size of -1 takes
+    the remainder (like reshape).  Device order follows jax.devices(), which
+    on TPU pods matches ICI adjacency for contiguous inner axes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes)
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError("mesh %s does not fit %d devices" % (axes, n))
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Batch-dim sharding for inputs."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+class ShardingRules:
+    """Name-pattern → PartitionSpec rules for parameter pytrees.
+
+    Megatron-style TP defaults: FC/conv weights split on the output-feature
+    axis, paired projections split on input; biases and norms replicated.
+    Users override per-pattern (regex on parameter name).
+    """
+
+    def __init__(self, mesh: Mesh, rules: Optional[Sequence] = None,
+                 default: P = P()):
+        import re
+        self.mesh = mesh
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def spec_for(self, name: str, shape: Tuple[int, ...]) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if self._fits(spec, shape):
+                    return spec
+        return self.default
+
+    def sharding_for(self, name: str, shape) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec_for(name, tuple(shape)))
+
+    def _fits(self, spec: P, shape) -> bool:
+        if len(spec) > len(shape):
+            return False
+        for dim, ax in zip(shape, spec):
+            if ax is None:
+                continue
+            size = self.mesh.shape[ax] if isinstance(ax, str) else \
+                int(np.prod([self.mesh.shape[a] for a in ax]))
+            if dim % size != 0:
+                return False
+        return True
+
+
+def megatron_rules(mesh: Mesh, tp_axis: str = "tp") -> ShardingRules:
+    """Default TP rules for our model zoo's parameter naming."""
+    t = tp_axis
+    return ShardingRules(mesh, rules=[
+        (r"(fc|dense|proj|query|key|value)\d*_weight$", P(t, None)),
+        (r"(out_proj|fc2|down)\w*_weight$", P(None, t)),
+        (r"conv\w*_weight$", P(t, None, None, None)),
+        (r"embedding\w*_weight$", P(None, t)),
+    ])

@@ -1,0 +1,161 @@
+"""Ring attention: sequence-parallel exact attention over the ICI ring.
+
+Beyond-parity requirement (SURVEY.md §5.7): the reference (2018) has only
+bucketing/fused-RNN for long sequences; long-context LM workloads need the
+sequence dimension sharded across chips.  Design: K/V blocks rotate around
+the mesh ring via ``ppermute`` while each chip holds its Q shard; softmax is
+accumulated blockwise with the running-max rescaling trick (flash-attention
+style), so attention over sequence length S costs O(S/n) memory per chip and
+the K/V transfers ride the ICI ring concurrently with compute.
+
+This module provides:
+- ``blockwise_attention``: single-device flash-style blockwise kernel
+  building block (jax.lax.scan over K/V blocks; XLA fuses into MXU matmuls).
+- ``ring_attention``: shard_map'd ring over a named mesh axis.
+- ``ulysses_attention``: all-to-all head-scatter alternative (attention-heavy
+  models with many heads: seq-gather/head-scatter costs one all_to_all each
+  way instead of (n-1) ring hops).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["blockwise_attention", "ring_attention", "ulysses_attention"]
+
+
+def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One (Q-block × K-block) update with running softmax rescaling.
+
+    q: [B,H,Tq,D], k/v: [B,H,Tk,D]; m/l/o carry the running max / sum /
+    output accumulator.  fp32 accumulation regardless of input dtype.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Flash-style attention via lax.scan over K/V blocks.  [B,H,T,D]."""
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    bs = min(block_size, Tk)
+    nblocks = (Tk + bs - 1) // bs
+    pad = nblocks * bs - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nblocks, bs, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nblocks, bs, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = jnp.arange(T)
+
+    def body(carry, inp):
+        m, l, o = carry
+        kblk, vblk, blk_idx = inp
+        k_pos = blk_idx * bs + jnp.arange(bs)
+        bias = None
+        mask_pad = k_pos < Tk
+        bias = jnp.where(mask_pad, 0.0, -jnp.inf)[None, None, None, :]
+        if causal:
+            causal_mask = q_pos[:, None] >= k_pos[None, :]
+            bias = bias + jnp.where(causal_mask, 0.0,
+                                    -jnp.inf)[None, None, :, :]
+        m, l, o = _attn_block(q, kblk, vblk, bias, m, l, o, scale)
+        return (m, l, o), None
+
+    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0),
+                                (kb, vb, jnp.arange(nblocks)))
+    out = o / jnp.maximum(l[..., None], 1e-37)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = False, block_size: int = 512,
+                   scale: Optional[float] = None):
+    """Exact attention with sequence sharded on `axis`.
+
+    Inputs [B,H,T,D] with T = full sequence; returns same sharding.  Each of
+    the n ring steps overlaps a K/V ``ppermute`` with blockwise attention on
+    the already-held shard.
+    """
+    n = mesh.shape[axis]
+    D = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def per_shard(qs, ks, vs):
+        idx = jax.lax.axis_index(axis)
+        T_loc = qs.shape[2]
+        B, H = qs.shape[0], qs.shape[1]
+        q_pos = idx * T_loc + jnp.arange(T_loc)
+
+        def body(carry, step):
+            m, l, o, kcur, vcur = carry
+            src_block = (idx - step) % n
+            k_pos = src_block * T_loc + jnp.arange(T_loc)
+            bias = None
+            if causal:
+                bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                                 -jnp.inf)[None, None, :, :]
+            m, l, o = _attn_block(qs, kcur, vcur, bias, m, l, o, sc)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            knext = jax.lax.ppermute(kcur, axis, perm)
+            vnext = jax.lax.ppermute(vcur, axis, perm)
+            return (m, l, o, knext, vnext), None
+
+        m0 = jnp.full((B, H, T_loc), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, T_loc), jnp.float32)
+        o0 = jnp.zeros((B, H, T_loc, qs.shape[-1]), jnp.float32)
+        (m, l, o, _, _), _ = jax.lax.scan(body, (m0, l0, o0, ks, vs),
+                                          jnp.arange(n))
+        out = o / jnp.maximum(l[..., None], 1e-37)
+        return out.astype(qs.dtype)
+
+    from jax.experimental.shard_map import shard_map
+    spec = P(None, None, axis, None)
+    f = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec)
+    return f(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None):
+    """Ulysses/DeepSpeed-style: all-to-all so each chip gets ALL sequence for
+    a subset of heads, runs full attention locally, then all-to-alls back."""
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+
+    def per_shard(qs, ks, vs):
+        # [B, H, T/n, D] -> all_to_all over heads -> [B, H/n, T, D]
+        def a2a(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+        qh, kh, vh = a2a(qs), a2a(ks), a2a(vs)
+        out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+        return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    spec = P(None, None, axis, None)
+    f = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec)
+    return f(q, k, v)

@@ -1,0 +1,215 @@
+"""RecordIO file format (parity: python/mxnet/recordio.py + dmlc-core
+RecordIO).  Binary-compatible with the reference format so .rec datasets
+interchange: records framed by magic 0xced7230a + length word, 4-byte
+aligned; IRHeader (flag, label, id, id2) prefix for image records."""
+from __future__ import annotations
+
+import ctypes  # noqa: F401  (kept for API-shape parity)
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_CFLAG_MASK = ((1 << (32 - _LFLAG_BITS)) - 1) << _LFLAG_BITS
+_LEN_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (ref recordio.py:MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %r" % self.flag)
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        n = len(buf)
+        self.handle.write(struct.pack("<II", _MAGIC, n & _LEN_MASK))
+        self.handle.write(buf)
+        pad = (4 - (n & 3)) & 3
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise MXNetError("invalid RecordIO magic in %s" % self.uri)
+        n = lrec & _LEN_MASK
+        data = self.handle.read(n)
+        pad = (4 - (n & 3)) & 3
+        if pad:
+            self.handle.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with a .idx sidecar (ref MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            if os.path.exists(self.idx_path):
+                with open(self.idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) >= 2:
+                            key = self.key_type(parts[0])
+                            self.idx[key] = int(parts[1])
+                            self.keys.append(key)
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack (header, payload) into one record (ref recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        head = struct.pack(_IR_FORMAT, header.flag, header.label,
+                           header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        head = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+        head += label.tobytes()
+    return head + s
+
+
+def unpack(s: bytes):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        s = s[header.flag * 4:]
+        header = header._replace(label=label, flag=0)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + pack (requires cv2 or PIL; gated)."""
+    buf = _encode_img(img, quality, img_fmt)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    header, img_bytes = unpack(s)
+    return header, _decode_img(img_bytes, iscolor)
+
+
+def _encode_img(img, quality, img_fmt):
+    try:
+        import cv2
+        ext = img_fmt if img_fmt.startswith(".") else "." + img_fmt
+        params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] \
+            if "jp" in ext else []
+        ok, buf = cv2.imencode(ext, img, params)
+        if not ok:
+            raise MXNetError("image encode failed")
+        return buf.tobytes()
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        im = Image.fromarray(img[..., ::-1] if img.ndim == 3 else img)
+        bio = _io.BytesIO()
+        im.save(bio, format="JPEG", quality=quality)
+        return bio.getvalue()
+
+
+def _decode_img(img_bytes, iscolor=-1):
+    try:
+        import cv2
+        arr = np.frombuffer(img_bytes, dtype=np.uint8)
+        return cv2.imdecode(arr, iscolor)
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        im = Image.open(_io.BytesIO(img_bytes))
+        a = np.asarray(im)
+        return a[..., ::-1] if a.ndim == 3 else a

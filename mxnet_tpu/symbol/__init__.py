@@ -1,0 +1,8 @@
+"""Symbol package (parity: python/mxnet/symbol/)."""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones, arange)
+from . import register as _register
+
+_register.populate(globals())
+
+from . import random  # noqa: F401

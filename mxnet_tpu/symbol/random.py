@@ -1,0 +1,22 @@
+"""sym.random namespace (parity: python/mxnet/symbol/random.py)."""
+from __future__ import annotations
+
+from .symbol import _create
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype=None, name=None, **kw):
+    return _create("_random_uniform", [],
+                   {"low": low, "high": high, "shape": shape, "dtype": dtype},
+                   name)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype=None, name=None, **kw):
+    return _create("_random_normal", [],
+                   {"loc": loc, "scale": scale, "shape": shape, "dtype": dtype},
+                   name)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype=None, name=None, **kw):
+    return _create("_random_gamma", [],
+                   {"alpha": alpha, "beta": beta, "shape": shape,
+                    "dtype": dtype}, name)

@@ -1,0 +1,84 @@
+"""Auto-generate ``sym.<op>`` construction functions from the op registry.
+
+Reference analog: ``python/mxnet/symbol/register.py`` code-gen from C-API
+introspection.  Symbol-valued arguments (positional or keyword) become graph
+inputs; everything else becomes node attrs.
+"""
+from __future__ import annotations
+
+from ..ops.registry import OPS
+from ..attribute import current_attrs
+from .symbol import Symbol, _create
+
+
+def _make_fn(op_name):
+    op = OPS[op_name]
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                sym_inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and \
+                    isinstance(a[0], Symbol):
+                sym_inputs.extend(a)
+            else:
+                # positional scalar params fill declared params in order
+                for k in op.params:
+                    if k not in kwargs and not k.startswith("__"):
+                        kwargs[k] = a
+                        break
+        # keyword symbol inputs are placed by declared arg name
+        kw_syms = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        for k in kw_syms:
+            kwargs.pop(k)
+        if kw_syms:
+            if op.arg_names:
+                slots = {n: i for i, n in enumerate(op.arg_names)}
+                total = max((slots.get(k, -1) for k in kw_syms), default=-1)
+                ins = list(sym_inputs) + [None] * (
+                    max(0, total + 1 - len(sym_inputs)))
+                for k, v in kw_syms.items():
+                    i = slots.get(k)
+                    if i is None:
+                        ins.append(v)
+                    else:
+                        while len(ins) <= i:
+                            ins.append(None)
+                        ins[i] = v
+                # fill gaps with auto-created variables later in _create;
+                # drop trailing Nones, replace interior Nones by auto-vars
+                from .symbol import Variable, _auto_name
+                nm = name or _auto_name(op.name.lower())
+                name = nm
+                for i, v in enumerate(ins):
+                    if v is None:
+                        argname = op.arg_names[i] if i < len(op.arg_names) \
+                            else "arg%d" % i
+                        ins[i] = Variable("%s_%s" % (nm, argname))
+                sym_inputs = ins
+            else:
+                sym_inputs.extend(kw_syms.values())
+        scope_attrs = current_attrs()
+        if attr:
+            scope_attrs.update(attr)
+        out = _create(op_name, sym_inputs, kwargs, name)
+        if scope_attrs:
+            for node, _ in out._outputs:
+                merged = dict(scope_attrs)
+                merged.update(node.attrs)
+                node.attrs = merged
+        return out
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def populate(module_dict):
+    for name in list(OPS):
+        if name not in module_dict:
+            module_dict[name] = _make_fn(name)

@@ -1,0 +1,231 @@
+"""IO iterator + metric + initializer tests (parity:
+tests/python/unittest/test_io.py, test_metric.py, test_init.py,
+test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, metric, initializer
+from mxnet_tpu.io import (NDArrayIter, CSVIter, PrefetchingIter, ResizeIter,
+                          DataBatch)
+from mxnet_tpu import recordio
+
+
+# ---- NDArrayIter ----------------------------------------------------------
+def test_ndarrayiter_basic():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_discard_shuffle():
+    X = np.random.rand(10, 3).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(10), batch_size=4, shuffle=True,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_csviter(tmp_path):
+    data = np.random.rand(8, 3).astype(np.float32)
+    f = str(tmp_path / "d.csv")
+    np.savetxt(f, data, delimiter=",")
+    it = CSVIter(data_csv=f, data_shape=(3,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    assert np.allclose(batches[0].data[0].asnumpy(), data[:4], rtol=1e-5)
+
+
+def test_prefetching_iter():
+    X = np.random.rand(20, 2).astype(np.float32)
+    base = NDArrayIter(X, np.zeros(20), batch_size=5)
+    pf = PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 4
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_resize_iter():
+    X = np.random.rand(12, 2).astype(np.float32)
+    it = ResizeIter(NDArrayIter(X, np.zeros(12), batch_size=4), size=5)
+    assert len(list(it)) == 5
+
+
+# ---- RecordIO -------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for i in range(5):
+        w.write(b"record%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == [b"record%d" % i for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    f = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, f, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, f, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 2.0, 7, 0)
+    packed = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(packed)
+    assert h2.label == 2.0 and h2.id == 7 and payload == b"payload"
+    # array label
+    h3 = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 1, 0)
+    packed = recordio.pack(h3, b"x")
+    h4, payload = recordio.unpack(packed)
+    assert np.allclose(h4.label, [1, 2, 3]) and payload == b"x"
+
+
+# ---- metrics --------------------------------------------------------------
+def test_accuracy():
+    m = metric.Accuracy()
+    m.update([nd.array([0, 1, 1])],
+             [nd.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])])
+    assert np.isclose(m.get()[1], 2.0 / 3)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    m.update([nd.array([2, 2])], [pred])
+    assert np.isclose(m.get()[1], 0.5)
+
+
+def test_mse_mae_rmse():
+    label = nd.array([1.0, 2.0])
+    pred = nd.array([1.5, 2.5])
+    for name, expect in (("mse", 0.25), ("mae", 0.5), ("rmse", 0.5)):
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert np.isclose(m.get()[1], expect), name
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    m.update([nd.array([0, 0])], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert np.isclose(m.get()[1], expected, rtol=1e-5)
+
+
+def test_composite_and_custom():
+    c = metric.CompositeEvalMetric()
+    c.add(metric.Accuracy())
+    c.add(metric.create(lambda l, p: np.abs(l - p.argmax(1)).mean()))
+    c.update([nd.array([1.0])], [nd.array([[0.2, 0.8]])])
+    names, values = c.get()
+    assert len(names) == 2
+
+
+# ---- initializers ---------------------------------------------------------
+def test_initializers():
+    shape = (64, 32)
+    for init, check in [
+        (initializer.Zero(), lambda a: np.allclose(a, 0)),
+        (initializer.One(), lambda a: np.allclose(a, 1)),
+        (initializer.Constant(2.5), lambda a: np.allclose(a, 2.5)),
+        (initializer.Uniform(0.1), lambda a: np.abs(a).max() <= 0.1),
+        (initializer.Normal(0.01), lambda a: np.abs(a).std() < 0.05),
+        (initializer.Xavier(), lambda a: a.std() > 0),
+    ]:
+        arr = nd.zeros(shape) if not isinstance(init, initializer.One) \
+            else nd.zeros(shape)
+        init(initializer.InitDesc("test_weight"), arr)
+        assert check(arr.asnumpy()), type(init).__name__
+
+
+def test_init_dispatch_by_name():
+    init = initializer.Uniform(1.0)
+    bias = nd.ones((4,))
+    init(initializer.InitDesc("fc1_bias"), bias)
+    assert np.allclose(bias.asnumpy(), 0)  # bias → zero
+    gamma = nd.zeros((4,))
+    init(initializer.InitDesc("bn_gamma"), gamma)
+    assert np.allclose(gamma.asnumpy(), 1)
+
+
+def test_orthogonal():
+    init = initializer.Orthogonal()
+    arr = nd.zeros((16, 16))
+    init(initializer.InitDesc("q_weight"), arr)
+    a = arr.asnumpy()
+    eye = a @ a.T / (init.scale ** 2)
+    assert np.allclose(eye, np.eye(16), atol=1e-4)
+
+
+def test_mixed():
+    m = initializer.Mixed([".*bias", ".*"],
+                          [initializer.Zero(), initializer.One()])
+    b, w = nd.ones((2,)), nd.zeros((2,))
+    m("fc_bias", b)
+    m("fc_weight", w)
+    assert np.allclose(b.asnumpy(), 0) and np.allclose(w.asnumpy(), 1)
+
+
+# ---- kvstore --------------------------------------------------------------
+def test_kvstore_push_pull():
+    kv = mx.kvstore_create("local")
+    kv.init("w", nd.ones((2, 2)) * 2)
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 2)
+    kv.push("w", nd.ones((2, 2)) * 8)
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 8)
+
+
+def test_kvstore_multi_device_reduce():
+    kv = mx.kvstore_create("device")
+    kv.init("g", nd.zeros((3,)))
+    vals = [nd.ones((3,), ctx=mx.cpu(i)) * (i + 1) for i in range(4)]
+    kv.push("g", vals)
+    out = nd.zeros((3,))
+    kv.pull("g", out=out)
+    assert np.allclose(out.asnumpy(), 1 + 2 + 3 + 4)
+
+
+def test_kvstore_optimizer():
+    kv = mx.kvstore_create("local")
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    kv.init("w", nd.ones((2,)))
+    kv.push("w", nd.ones((2,)))  # grad=1 → w -= 0.1
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 0.9)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore_create("local")
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kv.init("emb", w)
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+    got = out.asnumpy()
+    assert np.allclose(got[1], [3, 4, 5])
+    assert np.allclose(got[3], [9, 10, 11])
+    assert np.allclose(got[0], 0)

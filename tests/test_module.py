@@ -1,0 +1,147 @@
+"""Module + end-to-end training tests (parity: tests/python/unittest/
+test_module.py, tests/python/train/test_mlp.py — the MNIST convergence
+slice of SURVEY.md §7.2 step 5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+
+def _synthetic_classification(n=800, dim=20, classes=4, seed=0):
+    """Linearly separable-ish blobs."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+    X = np.zeros((n, dim), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = i % classes
+        X[i] = centers[c] + rng.randn(dim) * 0.5
+        y[i] = c
+    return X, y
+
+
+def _mlp(classes=4):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_module_fit_converges():
+    X, y = _synthetic_classification()
+    train = NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    train.reset()
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.95, "expected >95%% accuracy, got %s" % score
+
+
+def test_module_predict_shapes():
+    X, y = _synthetic_classification(n=100)
+    it = NDArrayIter(X, y, batch_size=25)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 4)
+
+
+def test_module_multi_device_kvstore():
+    """DP across 2 virtual devices with local kvstore (ref test_kvstore +
+    test_multi_device_exec)."""
+    X, y = _synthetic_classification(n=400)
+    train = NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=4, kvstore="device",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    train.reset()
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_adam():
+    X, y = _synthetic_classification(n=300)
+    train = NDArrayIter(X, y, batch_size=30)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=4, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.01),))
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_lenet_conv_net():
+    """Small conv net end-to-end (the LeNet slice)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 1, 16, 16).astype(np.float32)
+    y = (np.arange(120) % 2).astype(np.float32)
+    X[y == 1, :, :8, :8] += 1.5  # class 1: bright top-left quadrant
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    a1 = sym.Activation(c1, act_type="relu")
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = sym.Flatten(p1)
+    fc = sym.FullyConnected(f1, num_hidden=2, name="fc")
+    net = sym.SoftmaxOutput(fc, name="softmax")
+    it = NDArrayIter(X, y, batch_size=20, shuffle=True)
+    mod = Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6,
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.85
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    X, y = _synthetic_classification(n=200)
+    it = NDArrayIter(X, y, batch_size=20)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    assert sym2.list_arguments() == mod.symbol.list_arguments()
+    mod2 = Module(sym2, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=args, aux_params=auxs)
+    it.reset()
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    assert np.allclose(p1, p2, atol=1e-5)
+
+
+def test_batchnorm_module_updates_aux():
+    X, y = _synthetic_classification(n=200, dim=10)
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = sym.BatchNorm(h, name="bn")
+    h = sym.FullyConnected(h, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(h, name="softmax")
+    it = NDArrayIter(X, y, batch_size=20)
+    mod = Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2,
+            optimizer_params=(("learning_rate", 0.05),))
+    _, aux = mod.get_params()
+    # moving stats must have moved away from init (0 mean / 1 var)
+    assert abs(float(aux["bn_moving_mean"].asnumpy().mean())) > 1e-4
+
+
+def test_fixed_params():
+    X, y = _synthetic_classification(n=100)
+    it = NDArrayIter(X, y, batch_size=20)
+    mod = Module(_mlp(), context=mx.cpu(), fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.5),))
+    w_before = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(it)
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    assert np.allclose(w_before, w_after)

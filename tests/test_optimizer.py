@@ -1,0 +1,93 @@
+"""Optimizer tests (parity: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer as opt
+
+
+def _rosenbrock_step_test(optimizer, steps=200, tol=0.3):
+    """Minimize a quadratic bowl: all optimizers must make progress."""
+    w = nd.array([5.0, -3.0])
+    state = optimizer.create_state(0, w)
+    for _ in range(steps):
+        grad = 2.0 * w  # d/dw (w^2)
+        optimizer.update(0, w, grad, state)
+    return float(nd.norm(w).asscalar())
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.3}),
+    ("rmsprop", {"learning_rate": 0.1}),
+    ("rmsprop", {"learning_rate": 0.1, "centered": True}),
+    ("adagrad", {"learning_rate": 0.5}),
+    ("adadelta", {"rho": 0.9, "epsilon": 1e-2}),
+    ("adamax", {"learning_rate": 0.3}),
+    ("nadam", {"learning_rate": 0.3}),
+    ("ftml", {"learning_rate": 0.3}),
+    ("ftrl", {"learning_rate": 0.3}),
+    ("signum", {"learning_rate": 0.05, "momentum": 0.9}),
+])
+def test_optimizers_converge(name, kwargs):
+    o = opt.create(name, **kwargs)
+    final = _rosenbrock_step_test(o)
+    assert final < 1.0, "%s did not reduce ||w||: %.3f" % (name, final)
+
+
+def test_sgd_matches_manual():
+    o = opt.create("sgd", learning_rate=0.1)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([0.5]), None)
+    assert np.isclose(w.asscalar(), 1.0 - 0.1 * 0.5)
+
+
+def test_rescale_and_clip():
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5,
+                   clip_gradient=0.1)
+    w = nd.array([0.0])
+    o.update(0, w, nd.array([10.0]), None)  # 10*0.5=5 → clip 0.1
+    assert np.isclose(w.asscalar(), -0.1)
+
+
+def test_wd():
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([0.0]), None)
+    assert np.isclose(w.asscalar(), 1.0 - 0.1 * 0.1)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(3) == 1.0
+    assert np.isclose(m(7), 0.1)
+    assert np.isclose(m(20), 0.01)
+
+
+def test_updater_state_serialization():
+    o = opt.create("adam", learning_rate=0.1)
+    u = opt.get_updater(o)
+    w = nd.array([1.0, 2.0])
+    u(0, nd.array([0.1, 0.1]), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.create("adam", learning_rate=0.1))
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_lr_mult_from_attrs():
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight", lr_mult=0.0)
+    out = sym.FullyConnected(data, weight=w, num_hidden=4, name="fc")
+    o = opt.create("sgd", learning_rate=0.5, sym=out,
+                   param_idx2name={0: "fc_weight"})
+    o.set_lr_mult({})
+    assert o._get_lr(0) == 0.0

@@ -1,0 +1,167 @@
+"""Symbol + Executor tests (parity: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert d["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    p = sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = p.infer_shape(data=(4, 3, 32, 32))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert out_shapes == [(4, 8, 16, 16)]
+
+
+def test_batchnorm_aux():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "bn_gamma" in bn.list_arguments()
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 4, 8, 8))
+    assert aux_shapes == [(4,), (4,)]
+    assert out_shapes == [(2, 4, 8, 8)]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert "nodes" in parsed and "heads" in parsed
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # inference still works after roundtrip
+    _, out_shapes, _ = net2.infer_shape(data=(8, 50))
+    assert out_shapes == [(8, 10)]
+
+
+def test_simple_bind_forward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 20))
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name][:] = nd.random.normal(0, 0.1,
+                                                shape=ex.arg_dict[name].shape)
+    out = ex.forward(is_train=False, data=nd.ones((4, 20)))[0]
+    assert out.shape == (4, 10)
+    s = out.asnumpy().sum(axis=1)
+    assert np.allclose(s, 1.0, atol=1e-5)  # softmax rows sum to 1
+
+
+def test_executor_backward():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = (x * y).sum()
+    xv = nd.array([1.0, 2.0, 3.0])
+    yv = nd.array([4.0, 5.0, 6.0])
+    gx = nd.zeros((3,))
+    gy = nd.zeros((3,))
+    ex = z.bind(ctx=mx.cpu(), args={"x": xv, "y": yv},
+                args_grad={"x": gx, "y": gy})
+    out = ex.forward(is_train=True)[0]
+    assert np.isclose(out.asscalar(), 32.0)
+    ex.backward()
+    assert np.allclose(gx.asnumpy(), [4, 5, 6])
+    assert np.allclose(gy.asnumpy(), [1, 2, 3])
+
+
+def test_softmax_output_backward():
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(data, name="sm")
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="write", data=(2, 3))
+    dat = nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    lab = nd.array([2.0, 0.0])
+    ex.forward(is_train=True, data=dat, sm_label=lab)
+    ex.backward()
+    p = ex.outputs[0].asnumpy()
+    g = ex.grad_dict["data"].asnumpy()
+    oh = np.eye(3)[[2, 0]]
+    assert np.allclose(g, p - oh, atol=1e-5)
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    b = a * 2.0
+    c = a + 1.0
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    _, out_shapes, _ = feat.infer_shape(data=(2, 8))
+    assert out_shapes == [(2, 16)]
+
+
+def test_eval():
+    a = sym.Variable("a")
+    b = a * 3.0
+    out = b.eval(ctx=mx.cpu(), a=nd.array([1.0, 2.0]))[0]
+    assert np.allclose(out.asnumpy(), [3, 6])
+
+
+def test_grad_req_add_executor():
+    x = sym.Variable("x")
+    y = (x * x).sum()
+    xv = nd.array([2.0])
+    gx = nd.zeros((1,))
+    ex = y.bind(ctx=mx.cpu(), args={"x": xv}, args_grad={"x": gx},
+                grad_req="add")
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert np.allclose(gx.asnumpy(), [8.0])
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = sym.FullyConnected(a, num_hidden=4, name="fc")
+    assert b.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_attr():
+    v = sym.Variable("x", shape=(3, 4))
+    assert v.attr("__shape__") == (3, 4)
