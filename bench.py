@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 synthetic-ImageNet training throughput (img/s/chip).
+
+Primary BASELINE metric (BASELINE.json / SURVEY.md §6): the reference's
+published ResNet-50 training number is 363.69 img/s on 1xV100 at batch 128
+(docs/faq/perf.md:208-218); ``vs_baseline`` is measured img/s / 363.69.
+
+Runs the hybridized Gluon ResNet-50 v1 forward+backward+SGD step as ONE
+fused XLA program per batch (CachedOp fwd + fused fwd/bwd; bf16 matmuls via
+jax default on TPU).  Prints exactly one JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.tpu(0) if mx.context.num_tpus() else mx.cpu(0)
+    if ctx.device_type == "cpu":
+        # CPU fallback (no TPU visible): smaller shape so the bench finishes
+        batch_size = min(batch_size, 8)
+        image_size = min(image_size, 64)
+        iters = min(iters, 3)
+
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+
+    x = mx.nd.random.uniform(shape=(batch_size, 3, image_size, image_size),
+                             ctx=ctx)
+    y = mx.nd.array(np.random.randint(0, 1000, (batch_size,)), ctx=ctx)
+
+    def step():
+        with mx.autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch_size)
+        return loss
+
+    for _ in range(warmup):
+        step().wait_to_read()
+    mx.nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    loss.wait_to_read()
+    mx.nd.waitall()
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch_size * iters / dt
+    baseline = 363.69  # V100 batch-128 training img/s, docs/faq/perf.md
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_per_sec / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""CachedOp: compiled execution of a symbolic subgraph for imperative calls.
+
+Reference analog: ``src/imperative/cached_op.{h,cc}`` (graph caching keyed on
+shapes/types, dynamic vs static modes) invoked through
+``MXCreateCachedOpEx/MXInvokeCachedOpEx``.
+
+TPU-native design: the subgraph is compiled WHOLE by XLA — ``jax.jit`` over
+the symbol's execution plan (see :class:`mxnet_tpu.executor._Plan`), cached per
+(train-mode, differentiable-input-set); XLA's shape-keyed executable cache
+replaces the reference's shape-keyed graph cache.  The backward pass is a
+single fused forward+vjp XLA program (rematerialization: trades FLOPs for HBM,
+the TPU analog of ``MXNET_BACKWARD_DO_MIRROR``), recorded on the autograd tape
+like any other op.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from . import autograd as _autograd
+from . import random as _random
+from .executor import _Plan
+
+__all__ = ["CachedOp"]
+
+
+class CachedOp:
+    """A compiled callable over a Symbol graph (parity: mx.nd.CachedOp)."""
+
+    def __init__(self, sym, flags=()):
+        self._sym = sym
+        self._flags = dict(flags) if flags else {}
+        self.input_names = sym.list_inputs()
+        self.n_outputs = len(sym.list_outputs())
+        self._plans: Dict[bool, _Plan] = {}
+        self._jitted: Dict[Tuple, object] = {}
+
+    def _plan(self, train: bool) -> _Plan:
+        if train not in self._plans:
+            self._plans[train] = _Plan(self._sym, train)
+        return self._plans[train]
+
+    def _keys(self, plan: _Plan):
+        if plan.n_rng == 0:
+            return jnp.zeros((0, 2), np.uint32)
+        return jnp.stack([_random.next_key() for _ in range(plan.n_rng)])
+
+    def _fwd(self, train: bool):
+        key = ("fwd", train)
+        if key not in self._jitted:
+            plan = self._plan(train)
+            arg_names, aux_names = plan.arg_names, plan.aux_names
+
+            def fn(arg_list, aux_list, keys):
+                outs, new_aux = plan.execute(
+                    dict(zip(arg_names, arg_list)),
+                    dict(zip(aux_names, aux_list)), keys)
+                return outs, [new_aux[n] for n in aux_names]
+
+            self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def _bwd(self, train: bool, diff_idx: Tuple[int, ...]):
+        """Fused recompute-forward + vjp program for the given diff inputs."""
+        key = ("bwd", train, diff_idx)
+        if key not in self._jitted:
+            plan = self._plan(train)
+            arg_names, aux_names = plan.arg_names, plan.aux_names
+            diff_names = [arg_names[i] for i in diff_idx]
+
+            def fn(arg_list, aux_list, keys, ograds):
+                base = dict(zip(arg_names, arg_list))
+
+                def pure(*gvals):
+                    av = dict(base)
+                    av.update(dict(zip(diff_names, gvals)))
+                    outs, _ = plan.execute(
+                        av, dict(zip(aux_names, aux_list)), keys)
+                    return outs
+
+                _, vjp = jax.vjp(pure, *[base[n] for n in diff_names])
+                return list(vjp(list(ograds)))
+
+            self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def __call__(self, *args):
+        """Execute on NDArrays given in ``self.input_names`` order."""
+        from .ndarray.ndarray import NDArray
+        if len(args) != len(self.input_names):
+            raise MXNetError(
+                "CachedOp expects %d inputs (%s), got %d" % (
+                    len(self.input_names), self.input_names, len(args)))
+        train = _autograd.is_training()
+        recording = _autograd.is_recording()
+        plan = self._plan(train)
+        by_name = dict(zip(self.input_names, args))
+        arg_arrays = [by_name[n] for n in plan.arg_names]
+        aux_arrays = [by_name[n] for n in plan.aux_names]
+        arg_vals = [a._data for a in arg_arrays]
+        aux_vals = [a._data for a in aux_arrays]
+        keys = self._keys(plan)
+
+        outs, new_aux = self._fwd(train)(arg_vals, aux_vals, keys)
+        if train:
+            for dst, v in zip(aux_arrays, new_aux):
+                dst._data = v
+        ctx = args[0].context if args else None
+        out_arrays = [NDArray(o, ctx) for o in outs]
+
+        if recording:
+            diff_idx = tuple(
+                i for i, a in enumerate(arg_arrays)
+                if getattr(a, "_ag_entry", None) is not None
+                or getattr(a, "_ag_leaf", False))
+            if diff_idx:
+                bwd = self._bwd(train, diff_idx)
+
+                def vjp_fn(cots, _arg_vals=arg_vals, _aux_vals=aux_vals,
+                           _keys=keys):
+                    ogs = [c if c is not None else jnp.zeros(o.shape, o.dtype)
+                           for c, o in zip(cots, outs)]
+                    return bwd(_arg_vals, _aux_vals, _keys, ogs)
+
+                _autograd.record_op(
+                    "CachedOp", vjp_fn,
+                    [arg_arrays[i] for i in diff_idx], out_arrays)
+        return out_arrays if len(out_arrays) > 1 else out_arrays[0]

@@ -1,0 +1,15 @@
+"""Gluon: the define-by-run API with hybridization to XLA-compiled graphs.
+
+Reference analog: ``python/mxnet/gluon/`` (SURVEY.md §2.3).
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib
+from .utils import split_data, split_and_load, clip_global_norm

@@ -1,0 +1,632 @@
+"""Gluon Block / HybridBlock / SymbolBlock — define-by-run with hybridization.
+
+Reference analog: ``python/mxnet/gluon/block.py`` (``Block:126``,
+``HybridBlock:669``, ``_build_cache``/CachedOp at ``:746-795``,
+``SymbolBlock:950``).
+
+TPU-native notes: ``hybridize()`` traces ``hybrid_forward`` once with Symbols
+and compiles the whole subgraph with XLA via :class:`mxnet_tpu.cached_op.
+CachedOp` — the analog of the reference's NNVM-graph CachedOp, except memory
+planning/fusion are XLA's job.  Un-hybridized imperative calls dispatch per-op
+through shape-cached XLA executables.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import ndarray
+from .. import symbol as _symbol
+from ..symbol import Symbol
+from ..ndarray import NDArray
+from ..name import NameManager, Prefix as _PrefixScope, current_scope
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Scope for child block naming + parameter sharing (ref block.py:33)."""
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def _current():
+        return getattr(_naming, "scope", None)
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix and params for a new Block."""
+        current = _BlockScope._current()
+        if current is None:
+            if prefix is None:
+                nm = current_scope() or NameManager()
+                prefix = nm.get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = _BlockScope._current()
+        _naming.scope = self
+        self._name_scope = _PrefixScope(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(*exc)
+        self._name_scope = None
+        _naming.scope = self._old_scope
+
+
+def _flatten(args, inout_str):
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if isinstance(args, Symbol):
+        length = len(args.list_outputs())
+        length = length if length > 1 else 0
+        return [args], int(length)
+    if not isinstance(args, (list, tuple)):
+        raise ValueError(
+            "When hybridized, the input of HybridBlock %s must be (nested) "
+            "list of Symbol or NDArray, but got %s of type %s" % (
+                inout_str, str(args), str(type(args))))
+    flat, fmts = [], []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base class for all neural network layers and models
+    (parity: gluon/block.py:126)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=key, block=re.sub("(?m)^", "  ", repr(block)).strip())
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Registers parameters and children."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise TypeError(
+                    "Changing attribute type for %s from %s to %s is not "
+                    "allowed." % (self.name, type(existing), type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            if name in self.__dict__.get("_reg_params", {}):
+                pass
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _check_container_with_block(self):
+        pass
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """Name scope managing child naming/params (use in __init__)."""
+        return self._scope
+
+    @property
+    def params(self):
+        """ParameterDict of this Block only (not children)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """ParameterDict of this Block AND all children.
+
+        ``select`` regex filters by name, e.g. ``'.*weight'``.
+        """
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename):
+        """Save parameters to file (structure-based names; ref block.py)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        ndarray.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        loaded = ndarray.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in i for i in loaded.keys()):
+            # legacy loading: collect_params().load
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise AssertionError(
+                        "Parameter '%s' is missing in file '%s'" % (
+                            name, filename))
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise AssertionError(
+                    "Parameter '%s' loaded from file '%s' is not present in "
+                    "this Block" % (name, filename))
+            if name in params:
+                params[name]._load_init(loaded[name], ctx)
+
+    # legacy aliases (ref block.py save_params/load_params)
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def register_child(self, block, name=None):
+        """Register a child block for parameter collection / cascading."""
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle.id] = hook
+        return handle
+
+    def apply(self, fn):
+        """Apply ``fn`` recursively to self and children."""
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Initialize all Parameters of this Block and children."""
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """Activate HybridBlocks recursively (no-op on plain Blocks)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        """Cast parameters + computation of this Block to dtype."""
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        """Override to define the computation."""
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a summary of the Block (layer names, shapes, #params)."""
+        from numpy import prod as np_prod
+        summary = OrderedDict()
+        hooks = []
+
+        def _get_shape_str(args):
+            flat_args, _ = _flatten(args, "output") \
+                if isinstance(args, (list, tuple, NDArray)) else ([args], 0)
+            shapes = [x.shape if isinstance(x, NDArray) else None
+                      for x in flat_args]
+            return str(shapes[0] if len(shapes) == 1 else shapes)
+
+        def _register_summary_hook(block):
+            def _summary_hook(block, _, outputs):
+                class_name = block.__class__.__name__
+                m_key = "%s-%i" % (class_name, len(summary))
+                summary[m_key] = OrderedDict()
+                summary[m_key]["output_shape"] = _get_shape_str(outputs)
+                params = 0
+                summary[m_key]["trainable"] = 0
+                for p in block.params.values():
+                    n = int(np_prod(p.shape)) if p.shape else 0
+                    params += n
+                    if p.grad_req != "null":
+                        summary[m_key]["trainable"] += n
+                summary[m_key]["n_params"] = params
+            hooks.append(block.register_forward_hook(_summary_hook))
+
+        summary["Input"] = OrderedDict()
+        summary["Input"]["output_shape"] = _get_shape_str(inputs)
+        summary["Input"]["n_params"] = 0
+        summary["Input"]["trainable"] = 0
+        summary["Input"]["shared"] = 0
+        try:
+            self.apply(_register_summary_hook)
+            self(*inputs)
+            line_format = "{:>20}  {:>42} {:>15}"
+            print("-" * 80)
+            print(line_format.format("Layer (type)", "Output Shape", "Param #"))
+            print("=" * 80)
+            total_params = 0
+            for layer in summary:
+                print(line_format.format(
+                    layer, str(summary[layer]["output_shape"]),
+                    summary[layer]["n_params"]))
+                total_params += summary[layer]["n_params"]
+            print("=" * 80)
+            print("Total params: " + str(total_params))
+            print("-" * 80)
+        finally:
+            for h in hooks:
+                h.detach()
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._hooks = hooks_dict
+
+    def detach(self):
+        self._hooks.pop(self.id, None)
+
+
+class HybridBlock(Block):
+    """A Block with support for hybridization (parity: gluon/block.py:669).
+
+    Forward must be expressed as ``hybrid_forward(self, F, x, *args,
+    **params)`` where ``F`` is :mod:`mxnet_tpu.ndarray` or
+    :mod:`mxnet_tpu.symbol`; ``hybridize()`` switches execution to a
+    whole-graph XLA-compiled :class:`CachedOp`.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cached_graph = ()
+        self._cached_op = None
+        self._out_format = None
+        self._in_format = None
+        self._active = False
+        self._flags = []
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args, "input")
+            inputs = [_symbol.var("data%d" % i) for i in
+                      range(len(flat_args))] if len(flat_args) > 1 \
+                else [_symbol.var("data")]
+            grouped_inputs = _regroup(inputs, self._in_format)[0]
+            if not isinstance(grouped_inputs, list):
+                grouped_inputs = [grouped_inputs]
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(_symbol, *grouped_inputs, **params)
+            out, self._out_format = _flatten(out, "output")
+            self._cached_graph = inputs, _symbol.Group(out)
+        return self._cached_graph
+
+    def _build_cache(self, *args):
+        from ..cached_op import CachedOp
+        data, out = self._get_graph(*args)
+        data_names = {d.name: i for i, d in enumerate(data)}
+        params = self.collect_params()
+        input_names = out.list_inputs()
+        param_names = set(params.keys())
+        expected_names = set(input_names)
+        for name in expected_names:
+            if name not in param_names and name not in data_names:
+                raise MXNetError(
+                    "Unknown input to HybridBlock: %s" % name)
+        # warn-free: unused inputs simply dropped
+        self._cached_op_args = []
+        for name in input_names:
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                self._cached_op_args.append((False, params[name]))
+        self._cached_op = CachedOp(out, self._flags)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred: %s" % e)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._build_cache(*args)
+        flat_args, fmt = _flatten(args, "input")
+        if fmt != self._in_format:
+            raise ValueError("Invalid input format")
+        try:
+            cargs = []
+            for is_arg, item in self._cached_op_args:
+                if is_arg:
+                    cargs.append(flat_args[item])
+                else:
+                    cargs.append(item.data())
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args)
+            cargs = []
+            for is_arg, item in self._cached_op_args:
+                if is_arg:
+                    cargs.append(flat_args[item])
+                else:
+                    item._finish_deferred_init()
+                    cargs.append(item.data())
+        out = self._cached_op(*cargs)
+        if isinstance(out, NDArray):
+            out = [out]
+        return _regroup(list(out), self._out_format)[0]
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_op = None
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s." % (str(block), str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = list(kwargs.items())
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Infer parameter shapes from inputs (deferred-init resolution)."""
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args, "input")
+        kwargs = {i.name: j.shape for i, j in zip(inputs, flat_args)}
+        arg_shapes, _, aux_shapes = out.infer_shape(**kwargs)
+        sdict = {i: j for i, j in zip(out.list_arguments(), arg_shapes)}
+        sdict.update({i: j for i, j in zip(
+            out.list_auxiliary_states(), aux_shapes)})
+        for i in self.collect_params().values():
+            if i.name in sdict:
+                i.shape = sdict[i.name]
+
+    def infer_type(self, *args):
+        pass
+
+    def export(self, path, epoch=0):
+        """Export model graph JSON + params in reference checkpoint format
+        (``path-symbol.json`` + ``path-%04d.params``)."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save("%s-symbol.json" % path)
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                arg_dict["arg:%s" % name] = param._reduce()
+            elif name in aux_names:
+                arg_dict["aux:%s" % name] = param._reduce()
+        ndarray.save("%s-%04d.params" % (path, epoch), arg_dict)
+
+    def forward(self, x, *args):
+        """Dispatch: NDArray → imperative/cached; Symbol → symbolic."""
+        if isinstance(x, NDArray):
+            if self._active:
+                return self._call_cached_op(x, *args)
+            try:
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, i in self.params.items():
+                    i._finish_deferred_init()
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            return self.hybrid_forward(ndarray, x, *args, **params)
+        if not isinstance(x, Symbol):
+            raise ValueError(
+                "In HybridBlock, there must be one NDArray or one Symbol in "
+                "the input. Please check the type of the args.\n")
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(_symbol, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Override to define the computation; use ``F`` for ops."""
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol (for loading exported models).
+
+    Parity: gluon/block.py:950.
+    """
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = _symbol.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_symbol.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx,
+                                      allow_missing=False, ignore_extra=True,
+                                      restore_prefix="")
+        elif ctx is not None:
+            ret.collect_params().reset_ctx(ctx)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, (Symbol,)) and len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1 and \
+                isinstance(outputs[0], list):
+            outputs = outputs[0]
+        syms, self._in_format = _flatten(inputs, "input")
+        out, self._out_format = _flatten(outputs, "output")
+        out = _symbol.Group(out)
+
+        input_names = set()
+        for i in syms:
+            if len(i.get_internals().list_outputs()) != 1:
+                raise AssertionError(
+                    "Input symbols must be variable, but %s is an output of "
+                    "operators" % str(i))
+            input_names.add(i.name)
+
+        for i in out.list_arguments():
+            if i not in input_names:
+                self.params.get(i, allow_deferred_init=True)
+        for i in out.list_auxiliary_states():
+            if i not in input_names:
+                self.params.get(i, grad_req="null",
+                                allow_deferred_init=True)
+        self._cached_graph = syms, out
+        self._build_cache()
+
+    def _build_cache(self, *args):
+        from ..cached_op import CachedOp
+        data, out = self._cached_graph
+        data_names = {d.name: i for i, d in enumerate(data)}
+        params = self.collect_params()
+        self._cached_op_args = []
+        for name in out.list_inputs():
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                self._cached_op_args.append((False, params[name]))
+        self._cached_op = CachedOp(out, self._flags)
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            return self._call_cached_op(x, *args)
+        if not isinstance(x, Symbol):
+            raise ValueError(
+                "In SymbolBlock, there must be one NDArray or one Symbol in "
+                "the input. Please check the type of the args.\n")
+        args, in_fmt = _flatten([x] + list(args), "input")
+        if in_fmt != self._in_format:
+            raise ValueError("Invalid input format")
+        ret = copy.copy(self._cached_graph[1])
+        composed = {k.name: v for k, v in zip(self._cached_graph[0], args)}
+        ret._compose(**composed)
+        return _regroup(list(ret), self._out_format)[0]
+
+    def _clear_cached_op(self):
+        tmp = self._cached_graph
+        super()._clear_cached_op()
+        self._cached_graph = tmp
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

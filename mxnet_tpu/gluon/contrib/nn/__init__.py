@@ -1,0 +1,2 @@
+"""Contrib nn layers."""
+from .basic_layers import *

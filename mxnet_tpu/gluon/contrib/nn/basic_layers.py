@@ -1,0 +1,94 @@
+"""Gluon contrib nn layers (parity: python/mxnet/gluon/contrib/nn/).
+
+Concurrent/HybridConcurrent (parallel branch + concat), Identity,
+SparseEmbedding (dense-gather on TPU), SyncBatchNorm placeholder.
+"""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential, Embedding, BatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Feeds input to all children, concatenating their outputs on
+    ``axis``."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray
+        out = []
+        for block in self._children.values():
+            out.append(block(x))
+        return ndarray.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = []
+        for block in self._children.values():
+            out.append(block(x))
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity block, useful in Concurrent branches."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row_sparse gradient semantics (ref contrib
+    SparseEmbedding).  TPU note: compute is a dense XLA gather; the sparse
+    grad_stype survives for the KVStore row_sparse_pull path."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim),
+            init=weight_initializer, dtype=dtype,
+            grad_stype="row_sparse", stype="row_sparse")
+
+    def forward(self, x):
+        from .... import ndarray
+        weight = self.weight.data(x.context)
+        return ndarray.Embedding(x, weight,
+                                 input_dim=self._kwargs["input_dim"],
+                                 output_dim=self._kwargs["output_dim"],
+                                 dtype=self._kwargs["dtype"])
+
+    def __repr__(self):
+        return "{name}({input_dim} -> {output_dim}, {dtype})".format(
+            name=self.__class__.__name__, **self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm.
+
+    TPU note: under pjit/shard_map the batch axis is a mesh axis and the
+    moment reduction is a ``psum`` over ICI, so plain BatchNorm inside a
+    sharded program IS sync-BN; this class is API parity for explicit use.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)

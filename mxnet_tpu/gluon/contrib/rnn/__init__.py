@@ -1,0 +1,2 @@
+"""Contrib rnn cells."""
+from .rnn_cell import *

@@ -1,0 +1,73 @@
+"""Contrib RNN cells (parity: python/mxnet/gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import (HybridRecurrentCell, ModifierCell,
+                             BidirectionalCell, SequentialRNNCell,
+                             _format_sequence, _get_begin_state)
+
+__all__ = ["VariationalDropoutCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies Variational Dropout (Gal & Ghahramani 2016): the same
+    dropout mask reused at every timestep for inputs/states/outputs."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        assert not drop_states or not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support variational state dropout. " \
+            "Please add VariationalDropoutCell to the cells underneath " \
+            "instead."
+        assert not drop_states or not isinstance(base_cell, SequentialRNNCell), \
+            "Bidirectional SequentialRNNCell doesn't support variational " \
+            "state dropout. Please add VariationalDropoutCell to the cells " \
+            "underneath instead."
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_input_masks(self, F, inputs, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = F.Dropout(
+                F.ones_like(states[0]), p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = F.Dropout(
+                F.ones_like(inputs), p=self.drop_inputs)
+
+    def _initialize_output_mask(self, F, output):
+        if self.drop_outputs and self.drop_outputs_mask is None:
+            self.drop_outputs_mask = F.Dropout(
+                F.ones_like(output), p=self.drop_outputs)
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        self._initialize_input_masks(F, inputs, states)
+        if self.drop_states:
+            states = list(states)
+            states[0] = states[0] * self.drop_states_mask
+        if self.drop_inputs:
+            inputs = inputs * self.drop_inputs_mask
+        next_output, next_states = cell(inputs, states)
+        self._initialize_output_mask(F, next_output)
+        if self.drop_outputs:
+            next_output = next_output * self.drop_outputs_mask
+        return next_output, next_states
+
+    def __repr__(self):
+        return ("{name}(p_out={drop_outputs}, p_state={drop_states}, "
+                "{base_cell})").format(
+            name=self.__class__.__name__, base_cell=repr(self.base_cell),
+            drop_outputs=self.drop_outputs, drop_states=self.drop_states)

@@ -1,0 +1,158 @@
+"""Gluon DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native design: the reference forks worker processes and ships batches
+through POSIX shared memory (``dataloader.py:26-102``, ``storage.cc:94``
+kCPUShared) because Python decode holds the GIL.  Here decode/augment is
+numpy/C work that releases the GIL, so workers are THREADS feeding a
+bounded prefetch queue — no fork, no engine-restart-at-fork hazard
+(reference ``initialize.cc:49``), and batches land directly in host memory
+ready for ``device_put``.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ... import ndarray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Collate samples into a batch (ref dataloader.py:default_batchify_fn)."""
+    if isinstance(data[0], ndarray.NDArray):
+        return ndarray.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return ndarray.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    """Loads data from a Dataset, returns mini-batches.
+
+    ``num_workers > 0`` uses a thread pool with a bounded prefetch queue
+    (double buffering, the PrefetcherIter analog).
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(
+            0, int(prefetch) if prefetch is not None else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    yield self._batchify_fn(
+                        [self._dataset[idx] for idx in batch])
+            return same_process_iter()
+        return _MultiWorkerIter(self)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+
+class _MultiWorkerIter:
+    """Thread-pool iterator with in-order result delivery."""
+
+    def __init__(self, loader):
+        self._dataset = loader._dataset
+        self._batchify_fn = loader._batchify_fn
+        self._batch_iter = iter(loader._batch_sampler)
+        self._num_workers = loader._num_workers
+        self._depth = loader._prefetch or 2 * loader._num_workers
+        self._results = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._work_q = queue.Queue()
+        self._sent = 0
+        self._rcvd = 0
+        self._exhausted = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(self._num_workers)]
+        for t in self._threads:
+            t.start()
+        for _ in range(self._depth):
+            self._push_next()
+
+    def _push_next(self):
+        batch = next(self._batch_iter, None)
+        if batch is None:
+            return
+        self._work_q.put((self._sent, batch))
+        self._sent += 1
+
+    def _worker(self):
+        while True:
+            item = self._work_q.get()
+            if item is None:
+                return
+            idx, batch = item
+            try:
+                result = self._batchify_fn(
+                    [self._dataset[i] for i in batch])
+            except Exception as e:  # propagate to consumer
+                result = e
+            with self._cond:
+                self._results[idx] = result
+                self._cond.notify_all()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._push_next()
+        if self._rcvd == self._sent:
+            self._shutdown()
+            raise StopIteration
+        with self._cond:
+            while self._rcvd not in self._results:
+                self._cond.wait()
+            result = self._results.pop(self._rcvd)
+        self._rcvd += 1
+        if isinstance(result, Exception):
+            self._shutdown()
+            raise result
+        return result
+
+    def _shutdown(self):
+        if not self._exhausted:
+            for _ in self._threads:
+                self._work_q.put(None)
+            self._exhausted = True
+
+    next = __next__

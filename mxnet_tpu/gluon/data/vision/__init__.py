@@ -1,0 +1,4 @@
+"""Gluon vision datasets + transforms."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset)
+from . import transforms

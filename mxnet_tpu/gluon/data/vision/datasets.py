@@ -1,0 +1,213 @@
+"""Gluon vision datasets (parity: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST parse the IDX format; CIFAR10/100 the binary batches.
+Zero-egress environment: files must already exist under ``root`` (no
+auto-download); a clear error names the expected files.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import warnings
+
+import numpy as np
+
+from .... import ndarray
+from ....recordio import unpack_img
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    """Base for on-disk datasets."""
+
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _open_maybe_gz(path):
+    if os.path.exists(path):
+        return open(path, "rb")
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    raise IOError(
+        "dataset file %s (or %s.gz) not found; downloads are disabled in "
+        "this environment — place the file there manually" % (path, path))
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST handwritten digits (IDX format files under root)."""
+
+    _train_data = "train-images-idx3-ubyte"
+    _train_label = "train-labels-idx1-ubyte"
+    _test_data = "t10k-images-idx3-ubyte"
+    _test_label = "t10k-labels-idx1-ubyte"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        data_file = self._train_data if self._train else self._test_data
+        label_file = self._train_label if self._train else self._test_label
+        with _open_maybe_gz(os.path.join(self._root, label_file)) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8) \
+                .astype(np.int32)
+        with _open_maybe_gz(os.path.join(self._root, data_file)) as fin:
+            struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), 28, 28, 1)
+        self._label = label
+        self._data = ndarray.array(data, dtype=np.uint8)
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST clothing-article images (same IDX layout as MNIST)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 32x32 color images (binary batch files under root)."""
+
+    _train_files = ["data_batch_%d.bin" % i for i in range(1, 6)]
+    _test_files = ["test_batch.bin"]
+    _label_bytes = 1
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with _open_maybe_gz(filename) as fin:
+            raw = np.frombuffer(fin.read(), dtype=np.uint8)
+        record = raw.reshape(-1, 3072 + self._label_bytes)
+        data = record[:, self._label_bytes:].reshape(-1, 3, 32, 32)
+        label = record[:, self._label_bytes - 1].astype(np.int32)
+        return data.transpose(0, 2, 3, 1), label
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        data, label = zip(*[
+            self._read_batch(os.path.join(self._root, f)) for f in files])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = ndarray.array(data, dtype=np.uint8)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (fine_label=True selects the 100-class labels)."""
+
+    _train_files = ["train.bin"]
+    _test_files = ["test.bin"]
+    _label_bytes = 2
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._label_bytes = 2
+        self._label_idx = 1 if fine_label else 0
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with _open_maybe_gz(filename) as fin:
+            raw = np.frombuffer(fin.read(), dtype=np.uint8)
+        record = raw.reshape(-1, 3072 + self._label_bytes)
+        data = record[:, self._label_bytes:].reshape(-1, 3, 32, 32)
+        label = record[:, self._label_idx].astype(np.int32)
+        return data.transpose(0, 2, 3, 1), label
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over a RecordIO file containing packed images
+    (im2rec output; ref datasets.py:ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, iscolor=self._flag)
+        if self._transform is not None:
+            return self._transform(ndarray.array(img), header.label)
+        return ndarray.array(img), header.label
+
+
+class ImageFolderDataset(Dataset):
+    """A dataset over 'root/category/image.jpg' folder layouts."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                warnings.warn(
+                    "Ignoring %s, which is not a directory." % path,
+                    stacklevel=3)
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    warnings.warn(
+                        "Ignoring %s of type %s. Only support %s" % (
+                            filename, ext, ", ".join(self._exts)))
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        import cv2
+        flag = cv2.IMREAD_COLOR if self._flag else cv2.IMREAD_GRAYSCALE
+        img = cv2.imread(self.items[idx][0], flag)
+        if self._flag:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        else:
+            img = img[..., None]
+        img = ndarray.array(img)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,247 @@
+"""Gluon vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py).
+
+Pixel transforms run on uint8 HWC numpy/NDArray data on the host (they're
+part of the input pipeline, not the XLA program); ToTensor/Normalize produce
+the float CHW tensors the models consume.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from .... import ndarray
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from .... import image as _image
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Sequentially composes multiple transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            elif len(hybrid) == 1:
+                self.add(hybrid[0])
+                hybrid = []
+            elif len(hybrid) > 1:
+                hblock = HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                hblock.hybridize()
+                self.add(hblock)
+                hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    """Casts input to a specific dtype."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """uint8 HWC [0,255] image → float32 CHW [0,1) tensor."""
+
+    def __init__(self):
+        super().__init__()
+
+    def hybrid_forward(self, F, x):
+        return F.transpose(F.Cast(x, dtype="float32"),
+                           axes=(2, 0, 1)) / 255.0
+
+
+class Normalize(HybridBlock):
+    """Normalizes a CHW tensor with mean and std per channel."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean_c = self.params.get_constant("mean", mean)
+        self._std_c = self.params.get_constant("std", std)
+        self._mean_c.initialize()
+        self._std_c.initialize()
+
+    def hybrid_forward(self, F, x, _mean_c, _std_c):
+        return F.broadcast_div(F.broadcast_sub(x, _mean_c), _std_c)
+
+
+class Resize(Block):
+    """Resize to the given size (int = shorter side, keeping aspect when
+    keep_ratio)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if isinstance(self._size, int):
+            if not self._keep:
+                wsize = hsize = self._size
+            else:
+                h, w = x.shape[:2]
+                if h > w:
+                    wsize = self._size
+                    hsize = int(h * wsize / w)
+                else:
+                    hsize = self._size
+                    wsize = int(w * hsize / h)
+        else:
+            wsize, hsize = self._size
+        return _image.imresize(x, wsize, hsize, self._interpolation)
+
+
+class CenterCrop(Block):
+    """Crops the center region of the given size (pads/resizes up if
+    needed)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return _image.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    """Random crop with random area/aspect, resized to ``size``."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._args = (size, scale, ratio, interpolation)
+
+    def forward(self, x):
+        size, scale, ratio, interp = self._args
+        return _image.random_size_crop(
+            x, size, scale[0], ratio, interp=interp)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if random.random() < 0.5:
+            x = ndarray.array(np.ascontiguousarray(x.asnumpy()[:, ::-1, :]))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if random.random() < 0.5:
+            x = ndarray.array(np.ascontiguousarray(x.asnumpy()[::-1, :, :]))
+        return x
+
+
+class _RandomJitterBase(Block):
+    def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+
+class RandomBrightness(_RandomJitterBase):
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._value, self._value)
+        return (x.astype("float32") * alpha).clip(0, 255)
+
+
+class RandomContrast(_RandomJitterBase):
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._value, self._value)
+        f = x.astype("float32")
+        gray = f.mean()
+        return ((f - gray) * alpha + gray).clip(0, 255)
+
+
+class RandomSaturation(_RandomJitterBase):
+    def forward(self, x):
+        alpha = 1.0 + random.uniform(-self._value, self._value)
+        f = x.astype("float32")
+        coef = ndarray.array(np.array([0.299, 0.587, 0.114], np.float32))
+        gray = (f * coef.reshape((1, 1, 3))).sum(axis=2, keepdims=True)
+        return (f * alpha + gray * (1.0 - alpha)).clip(0, 255)
+
+
+class RandomHue(_RandomJitterBase):
+    def forward(self, x):
+        alpha = random.uniform(-self._value, self._value)
+        f = x.asnumpy().astype(np.float32)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        t = ityiq @ bt @ tyiq
+        return ndarray.array(np.clip(f @ t.T, 0, 255))
+
+
+class RandomColorJitter(Block):
+    """Random brightness+contrast+saturation+hue jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._transforms)
+        random.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.random.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
+        return (x.astype("float32")
+                + ndarray.array(rgb.reshape(1, 1, 3))).clip(0, 255)

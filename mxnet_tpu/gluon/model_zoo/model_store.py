@@ -1,0 +1,34 @@
+"""Pretrained model store (parity: python/mxnet/gluon/model_zoo/model_store.py).
+
+Zero-egress environment: no downloads — pretrained weights must be staged
+locally under ``root`` (default ``~/.mxnet/models``); a missing file raises
+with the expected filename.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+_model_sha1 = {}
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Return the local path of a pretrained parameter file."""
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    for fname in os.listdir(root) if os.path.isdir(root) else []:
+        if fname.startswith(name) and fname.endswith(".params"):
+            return os.path.join(root, fname)
+    raise FileNotFoundError(
+        "Pretrained model file for %r not found under %s. Downloads are "
+        "disabled in this environment; place '%s-<hash>.params' there "
+        "manually." % (name, root, name))
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    """Remove all cached model files."""
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

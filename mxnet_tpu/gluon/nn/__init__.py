@@ -1,0 +1,4 @@
+"""Gluon neural network layers (parity: python/mxnet/gluon/nn/)."""
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *

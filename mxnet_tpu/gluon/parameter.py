@@ -1,0 +1,526 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference analog: ``python/mxnet/gluon/parameter.py`` (``Parameter:43`` with
+deferred init, ``_reduce:312``, grad_req handling, per-context replicas).
+
+TPU-native notes: a parameter replica per :class:`~mxnet_tpu.context.Context`
+is kept as an independent NDArray (jax.Array buffer); for sharded training the
+idiomatic path is a single array laid out over a `jax.sharding.Mesh` — see
+:mod:`mxnet_tpu.parallel` — but the reference's list-of-contexts API is
+preserved so Trainer/KVStore code carries over unchanged.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context, cpu
+from .. import ndarray as nd
+from .. import initializer
+from ..initializer import InitDesc
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    """A Container holding parameters (weights) of Blocks.
+
+    :class:`Parameter` holds a copy of the parameter on each
+    :class:`Context` after it is initialized with ``initialize(...)``.
+    If ``grad_req`` is not ``'null'``, it will also hold a gradient array on
+    each Context.
+
+    Parity: python/mxnet/gluon/parameter.py:43.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None          # OrderedDict ctx -> NDArray
+        self._grad = None
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = None
+        self.grad_req = grad_req
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError("invalid stype %r" % stype)
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self.shape, np.dtype(self.dtype).name)
+
+    # ---- properties -----------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("grad_req must be write/add/null, got %r" % req)
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                for arr in self._data.values():
+                    arr._grad = None
+                    arr._grad_req = "null"
+                    arr._ag_leaf = False
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape) if new_shape is not None else None
+            return
+        if new_shape is None:
+            return
+        unknown_ok = all(
+            s1 in (0, -1) or s1 == s2
+            for s1, s2 in zip(self._shape, new_shape)) \
+            and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise AssertionError(
+                "Expected shape %s is incompatible with given shape %s for "
+                "Parameter %s" % (str(new_shape), str(self._shape), self.name))
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # ---- init machinery -------------------------------------------------
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            raise RuntimeError(
+                "Parameter '%s' was not initialized on context %s. It was "
+                "only initialized on %s." % (
+                    self.name, str(ctx), str(list(arr_dict.keys()))))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters."
+                % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with Block.collect_"
+            "params() instead of Block.params because the later does not "
+            "include Parameters of nested child Blocks" % self.name)
+
+    def _load_init(self, data, ctx):
+        """Override init with data from load (ref parameter.py:_load_init)."""
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                if self_dim not in (0, -1) and self_dim != data_dim:
+                    raise AssertionError(
+                        "Failed loading Parameter '%s' from saved params: "
+                        "shape incompatible expected %s vs saved %s" % (
+                            self.name, str(self.shape), str(data.shape)))
+            self._shape = tuple(data.shape)
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                if ctx is not None and set(ctx) != set(self._deferred_init[1]):
+                    raise AssertionError(
+                        "Failed to load Parameter '%s' on %s because it was "
+                        "previous initialized on %s." % (
+                            self.name, str(ctx), str(self.list_ctx())))
+                ctx = self._deferred_init[1]
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        else:
+            if ctx is not None and set(ctx) != set(self._data.keys()):
+                raise AssertionError(
+                    "Failed to load Parameter '%s' on %s because it was "
+                    "previous initialized on %s." % (
+                        self.name, str(ctx), str(self.list_ctx())))
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if self.shape is None or np.prod(self.shape) <= 0:
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        if data is None:
+            data = nd.zeros(self.shape, dtype=self.dtype, ctx=cpu())
+            init_obj = init if init is not None else (
+                self.init if self.init is not None else default_init)
+            if isinstance(init_obj, str):
+                init_obj = initializer.create(init_obj)
+            init_obj(InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = OrderedDict()
+        for ctx in ctx_list:
+            self._data[ctx] = nd.array(
+                data.asnumpy() if isinstance(data, nd.NDArray) else data,
+                dtype=self.dtype, ctx=ctx)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        from .. import autograd
+        self._grad = OrderedDict()
+        for ctx, arr in self._data.items():
+            self._grad[ctx] = nd.zeros(arr.shape, dtype=arr.dtype, ctx=ctx)
+            autograd.mark_variables(arr, self._grad[ctx], self._grad_req)
+
+    def _reduce(self):
+        """Reduce data from multiple contexts to cpu (ref parameter.py:312)."""
+        data = self.list_data()
+        if len(data) == 1:
+            return data[0].copyto(cpu())
+        out = sum(d.asnumpy() for d in data) / len(data)
+        return nd.array(out, dtype=self.dtype, ctx=cpu())
+
+    # ---- public API -----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter + gradient arrays; deferred if shape unknown."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or np.prod([s if s > 0 else 0
+                                          for s in self.shape]) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s. Please specify in_units/in_channels/etc for "
+                "`Block`s." % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        """Re-assign Parameter to other contexts."""
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError(
+                "Cannot reset context for Parameter '%s' because it has not "
+                "been initialized." % self.name)
+
+    def set_data(self, data):
+        """Set this parameter's value on all contexts."""
+        self.shape = data.shape
+        if self._data is None:
+            if not self._deferred_init:
+                raise AssertionError(
+                    "Parameter '%s' has not been initialized" % self.name)
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        npdata = data.asnumpy() if isinstance(data, nd.NDArray) else np.asarray(data)
+        for ctx, arr in self._data.items():
+            arr[:] = nd.array(npdata, dtype=arr.dtype, ctx=ctx)
+
+    def data(self, ctx=None):
+        """Return a copy of this parameter on one context."""
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self) -> List[nd.NDArray]:
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError(
+                "Parameter '%s' has not been initialized" % self.name)
+        return list(self._data.keys())
+
+    def zero_grad(self):
+        """Set gradient buffer on all contexts to 0."""
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g[:] = 0
+
+    def var(self):
+        """Symbol representing this parameter."""
+        from .. import symbol
+        if self._var is None:
+            self._var = symbol.var(
+                self.name, shape=self.shape, dtype=self.dtype,
+                lr_mult=self.lr_mult, wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        """Cast data and gradient of this Parameter to a new dtype."""
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with_grad = self._grad is not None
+        data = {ctx: arr.astype(dtype) for ctx, arr in self._data.items()}
+        self._data = OrderedDict(data)
+        if with_grad:
+            self._init_grad()
+
+
+class Constant(Parameter):
+    """A constant parameter (never updated by the trainer).
+
+    Parity: gluon/parameter.py Constant.
+    """
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self2, _, arr):
+                arr[:] = value
+
+        super().__init__(
+            name, grad_req="null", shape=value.shape, dtype=value.dtype,
+            init=Init(), differentiable=False)
+
+
+class ParameterDict:
+    """A dictionary managing a set of parameters.
+
+    Parity: gluon/parameter.py ParameterDict.
+    """
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        return "%s(\n%s\n)" % (
+            name, "\n".join("  " + repr(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a :class:`Parameter` named ``prefix+name``."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge unknown dims
+                        if len(v) == len(existing):
+                            merged = tuple(
+                                ev if sv in (0, -1) else sv
+                                for sv, ev in zip(v, existing))
+                            param._shape = tuple(
+                                mv if ev in (0, -1) else ev
+                                for mv, ev in zip(merged, existing))
+                            continue
+                    if k in ("lr_mult", "wd_mult", "grad_req") or v is None \
+                            or v == existing:
+                        if v is not None and v != existing:
+                            setattr(param, k, v)
+                        continue
+                    raise AssertionError(
+                        "Cannot retrieve Parameter '%s' because desired "
+                        "attribute does not match with stored for attribute "
+                        "'%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k))))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    "No constant named '%s'. Please specify value if you "
+                    "want to create a new constant." % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            if not isinstance(param, Constant):
+                raise AssertionError(
+                    "Parameter '%s' already exists but is not a constant"
+                    % name)
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in ``other`` to self."""
+        for k, v in other.items():
+            if k in self._params:
+                if self._params[k] is not v:
+                    raise ValueError(
+                        "Cannot update self with other because they have "
+                        "different Parameters with the same name '%s'" % k)
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        """Set an attribute on all Parameters (e.g. grad_req, lr_mult)."""
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with it." % (
+                        strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        if restore_prefix:
+            for name in self.keys():
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is '%s' but Parameter name '%s' does "
+                        "not start with it" % (restore_prefix, name))
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {}
+        for k, v in loaded.items():
+            k = k[4:] if k.startswith("arg:") or k.startswith("aux:") else k
+            arg_dict[restore_prefix + k] = v
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise AssertionError(
+                        "Parameter '%s' is missing in file '%s'" % (
+                            name[lprefix:], filename))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter '%s' loaded from file '%s' is not present "
+                        "in ParameterDict" % (name[lprefix:], filename))
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

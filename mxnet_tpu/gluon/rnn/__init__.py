@@ -1,0 +1,3 @@
+"""Gluon recurrent layers + cells (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *

@@ -1,0 +1,270 @@
+"""Gluon fused recurrent layers: RNN / LSTM / GRU.
+
+Reference analog: ``python/mxnet/gluon/rnn/rnn_layer.py:241,335,440`` —
+wrappers over the fused RNN op (``src/operator/rnn-inl.h``).  On TPU the
+fused op is a ``lax.scan`` whose input projection is hoisted into one MXU
+matmul per layer (see :mod:`mxnet_tpu.ops.rnn`).
+"""
+from __future__ import annotations
+
+from ... import ndarray
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from . import rnn_cell
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """Implementation of recurrent layers (ref rnn_layer.py:_RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(
+                    "{}{}_i2h_weight".format(j, i), (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    "{}{}_h2h_weight".format(j, i), (ng * nh, nh),
+                    h2h_weight_initializer)
+                self._register_param(
+                    "{}{}_i2h_bias".format(j, i), (ng * nh,),
+                    i2h_bias_initializer)
+                self._register_param(
+                    "{}{}_h2h_bias".format(j, i), (ng * nh,),
+                    h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        # match reference checkpoint layout (flat per-layer names)
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _unfuse(self):
+        """Unfuse into an explicit stack of cells (ref rnn_layer.py:139)."""
+        get_cell = {
+            "rnn_relu": lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation="relu", **kw),
+            "rnn_tanh": lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation="tanh", **kw),
+            "lstm": lambda **kw: rnn_cell.LSTMCell(self._hidden_size, **kw),
+            "gru": lambda **kw: rnn_cell.GRUCell(self._hidden_size, **kw),
+        }[self._mode]
+        stack = rnn_cell.SequentialRNNCell(prefix=self.prefix,
+                                           params=self.params)
+        with stack.name_scope():
+            ni = self._input_size
+            for i in range(self._num_layers):
+                kwargs = {
+                    "input_size": ni,
+                    "i2h_weight_initializer": self._i2h_weight_initializer,
+                    "h2h_weight_initializer": self._h2h_weight_initializer,
+                    "i2h_bias_initializer": self._i2h_bias_initializer,
+                    "h2h_bias_initializer": self._h2h_bias_initializer}
+                if self._dir == 2:
+                    stack.add(rnn_cell.BidirectionalCell(
+                        get_cell(prefix="l%d_" % i, **kwargs),
+                        get_cell(prefix="r%d_" % i, **kwargs)))
+                else:
+                    stack.add(get_cell(prefix="l%d_" % i, **kwargs))
+                if self._dropout > 0 and i != self._num_layers - 1:
+                    stack.add(rnn_cell.DropoutCell(self._dropout))
+                ni = self._hidden_size * self._dir
+        return stack
+
+    def begin_state(self, batch_size=0, func=ndarray.zeros, **kwargs):
+        """Initial recurrent state values."""
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(name="%sh0_%d" % (self.prefix, i), **info))
+        return states
+
+    def __call__(self, inputs, *states):
+        if self._input_size == 0:
+            for i in range(self._dir):
+                self.params.get("l0_i2h_weight").shape = (
+                    self._gates * self._hidden_size, inputs.shape[2])
+                if self._dir == 2:
+                    self.params.get("r0_i2h_weight").shape = (
+                        self._gates * self._hidden_size, inputs.shape[2])
+            self._input_size = inputs.shape[2]
+        skip_states = states == (None,)
+        if skip_states:
+            states = []
+        if isinstance(states, tuple) and len(states) == 1 and \
+                isinstance(states[0], (list, tuple)):
+            states = states[0]
+        states = list(states)
+        if isinstance(inputs, NDArray) and not states:
+            batch_size = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch_size, ctx=inputs.context)
+        if isinstance(states, NDArray):
+            states = [states]
+        return super().__call__(inputs, states)
+
+    def forward(self, inputs, states=None):
+        if isinstance(states, NDArray):
+            states = [states]
+        batch_size = inputs.shape[self._layout.find("N")]
+        if states is None or len(states) == 0:
+            states = self.begin_state(batch_size, ctx=inputs.context)
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(inputs, states)
+        # out is (output, state_list)
+        return out
+
+    def _forward_kernel(self, inputs, states):
+        """Forward using the fused RNN operator."""
+        if self._layout == "NTC":
+            inputs = ndarray.swapaxes(inputs, 0, 1)
+        # pack parameters in the fused-op layout: all (W, R) then all biases
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(
+                    self, "{}{}_i2h_weight".format(j, i)).data(
+                        inputs.context).reshape((-1,)))
+                ws.append(getattr(
+                    self, "{}{}_h2h_weight".format(j, i)).data(
+                        inputs.context).reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(getattr(
+                    self, "{}{}_i2h_bias".format(j, i)).data(
+                        inputs.context).reshape((-1,)))
+                bs.append(getattr(
+                    self, "{}{}_h2h_bias".format(j, i)).data(
+                        inputs.context).reshape((-1,)))
+        params = ndarray.concat(*(ws + bs), dim=0)
+
+        rnn_args = [inputs, params] + states
+        outputs = ndarray.RNN(
+            *rnn_args, state_size=self._hidden_size,
+            num_layers=self._num_layers, bidirectional=self._dir == 2,
+            p=self._dropout, state_outputs=True, mode=self._mode)
+        if self._mode == "lstm":
+            outputs, states = outputs[0], [outputs[1], outputs[2]]
+        else:
+            outputs, states = outputs[0], [outputs[1]]
+        if self._layout == "NTC":
+            outputs = ndarray.swapaxes(outputs, 0, 1)
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (ref rnn_layer.py:241)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size),
+                 "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (ref rnn_layer.py:335)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size),
+                 "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size),
+                 "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (cuDNN gate variant; ref rnn_layer.py:440)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size),
+                 "__layout__": "LNC"}]

@@ -1,0 +1,96 @@
+"""Gluon utility functions (parity: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+
+from .. import ndarray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into ``num_slice`` slices along ``batch_axis``
+    (the gluon analog of executor_group.py:_split_input_slice)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step]
+                  if i < num_slice - 1 else data[i * step:size]
+                  for i in range(num_slice)]
+    else:
+        slices = [
+            ndarray.slice_axis(data, batch_axis, i * step, (i + 1) * step)
+            if i < num_slice - 1 else
+            ndarray.slice_axis(data, batch_axis, i * step, size)
+            for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data into len(ctx_list) slices and load each onto one ctx."""
+    if not isinstance(data, ndarray.NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norms <= max_norm."""
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return ndarray.dot(x, x)
+        return array.norm().square()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = ndarray.add_n(
+        *[_norm(arr).as_in_context(ctx) for arr in arrays])
+    total_norm = ndarray.sqrt(total_norm)
+    if check_isfinite:
+        import numpy as np
+        total_norm_val = float(total_norm.asscalar())
+        if not np.isfinite(total_norm_val):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will "
+                            "be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    scale = ndarray.minimum(scale, ndarray.ones(1, ctx=ctx))
+    for arr in arrays:
+        arr *= scale.as_in_context(arr.context)
+    if check_isfinite:
+        return total_norm_val
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check whether the sha1 hash of the file content matches."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download a file (zero-egress environments: raises with guidance)."""
+    raise RuntimeError(
+        "download() requires network egress, which is unavailable in this "
+        "environment; place the file at the target path manually")

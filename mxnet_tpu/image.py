@@ -1,0 +1,549 @@
+"""Image loading / augmentation utilities + ImageIter.
+
+Reference analog: ``python/mxnet/image/image.py`` (pure-Python ImageIter +
+augmenter zoo) and the imdecode op.  Decode/augment here is host-side
+OpenCV/numpy work (it feeds the device pipeline; it is NOT part of the XLA
+program), matching the reference's CPU-side augmentation design.
+"""
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import io as _io
+from . import recordio
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug", "RandomOrderAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read and decode an image to NDArray (HWC, RGB by default)."""
+    cv2 = _cv2()
+    img = cv2.imread(filename,
+                     cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("cannot read image %s" % filename)
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer (ref: image_io.cc imdecode op)."""
+    cv2 = _cv2()
+    raw = np.frombuffer(buf, dtype=np.uint8) \
+        if isinstance(buf, (bytes, bytearray)) else np.asarray(buf, np.uint8)
+    img = cv2.imdecode(raw, cv2.IMREAD_COLOR if flag
+                       else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to (w, h)."""
+    cv2 = _cv2()
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = cv2.resize(arr, (w, h), interpolation=_get_interp(interp))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=arr.dtype)
+
+
+def _get_interp(interp):
+    cv2 = _cv2()
+    return {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR, 2: cv2.INTER_CUBIC,
+            3: cv2.INTER_AREA, 4: cv2.INTER_LANCZOS4}.get(interp,
+                                                          cv2.INTER_LINEAR)
+
+
+def scale_down(src_size, size):
+    """Scale down crop size if it's larger than image size."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to size."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop src at fixed location, optionally resize to size."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd.array(out, dtype=arr.dtype)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop with (w, h) = size, upscaling if needed."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Crop centered area of (w, h) = size."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """Normalize with mean and optionally std."""
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2, **kwargs):
+    """Random crop with random area & aspect ratio (Inception-style)."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if random.random() < 0.5:
+            new_h, new_w = new_w, new_h
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+class Augmenter:
+    """Image augmenter base class."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if np.isscalar(v) or isinstance(v, (tuple, list, str)):
+                continue
+            self._kwargs[k] = v
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs],
+                          default=str)
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        gray = float((src.asnumpy() * self.coef).sum()) / src.size * 3.0
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy()
+        gray = (arr * self.coef).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    tyiq = np.array([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]], np.float32)
+    ityiq = np.array([[1.0, 0.956, 0.621],
+                      [1.0, -0.272, -0.647],
+                      [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = (self.ityiq @ bt @ self.tyiq).T
+        return nd.array(src.asnumpy() @ t)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src + nd.array(rgb.reshape(1, 1, 3))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=list(np.ravel(mean)) if mean is not None else None,
+                         std=list(np.ravel(std)) if std is not None else None)
+        self.mean = nd.array(np.asarray(mean, np.float32)) \
+            if mean is not None else None
+        self.std = nd.array(np.asarray(std, np.float32)) \
+            if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    coef = np.array([[0.299], [0.587], [0.114]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            arr = src.asnumpy()
+            gray = arr @ self.coef
+            src = nd.array(np.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            src = nd.array(np.ascontiguousarray(src.asnumpy()[:, ::-1]))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Create an augmenter list (ref image.py:CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(
+            crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec files or .lst/image folders, with
+    augmentation (ref image.py:ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list)) \
+            or path_root, "must provide a data source"
+        if path_imgrec:
+            if path_imgidx is None:
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = recordio.MXIndexedRecordIO(
+                path_imgidx, path_imgrec, "r")
+            self.imgidx = list(self.imgrec.keys)
+        else:
+            self.imgrec = None
+        self.imglist = {}
+        if path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(
+                        [float(i) for i in parts[1:-1]], np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+        elif isinstance(imglist, list):
+            for i, item in enumerate(imglist):
+                self.imglist[i] = (np.array(item[0], np.float32)
+                                   if isinstance(item[0], (list, tuple))
+                                   else np.array([item[0]], np.float32),
+                                   item[1])
+            self.seq = list(self.imglist.keys())
+        elif self.imgrec is not None:
+            self.seq = self.imgidx
+        else:
+            raise MXNetError("path_root-only mode requires path_imglist")
+        if num_parts > 1:
+            self.seq = self.seq[part_index::num_parts]
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._data_name = data_name
+        self._label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc(self._data_name,
+                             (self.batch_size,) + self.data_shape,
+                             np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [_io.DataDesc(self._label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            s = self.imgrec.read_idx(idx)
+            header, img = recordio.unpack(s)
+            if idx in self.imglist:
+                return self.imglist[idx][0], img
+            return header.label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root or "", fname), "rb") as fin:
+            img = fin.read()
+        return label, img
+
+    def next(self):
+        batch_data = np.zeros(
+            (self.batch_size,) + self.data_shape, np.float32)
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        batch_label = np.zeros(shape, np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                for aug in self.auglist:
+                    data = aug(data)
+                arr = data.asnumpy() if isinstance(data, NDArray) \
+                    else np.asarray(data)
+                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_label[i] = label if self.label_width > 1 \
+                    else (label[0] if hasattr(label, "__len__") else label)
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return _io.DataBatch(
+            [nd.array(batch_data)], [nd.array(batch_label)],
+            pad=self.batch_size - i)

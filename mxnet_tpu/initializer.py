@@ -20,8 +20,8 @@ __all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
 _registry = Registry("initializer")
 
 
-def register(klass):
-    _registry.register(klass.__name__, klass)
+def register(klass, aliases=()):
+    _registry.register(klass.__name__, klass, aliases=aliases)
     return klass
 
 
@@ -109,6 +109,10 @@ class Zero(Initializer):
 class One(Initializer):
     def _init_weight(self, desc, arr):
         arr[:] = 1.0
+
+
+register(Zero, aliases=("zeros",))
+register(One, aliases=("ones",))
 
 
 @register

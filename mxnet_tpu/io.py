@@ -283,6 +283,7 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         super().__init__(iters[0].batch_size)
+        self.current_batch = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
@@ -353,10 +354,16 @@ class PrefetchingIter(DataIter):
                 break
         for it in self.iters:
             it.reset()
+        self.current_batch = None
         self._stop.clear()
         self._start()
 
     def __next__(self):
+        # honor a batch already fetched by iter_next() (reference
+        # PrefetchingIter: iter_next fills current_batch, next returns it)
+        if self.current_batch is not None:
+            batch, self.current_batch = self.current_batch, None
+            return batch
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
@@ -365,11 +372,29 @@ class PrefetchingIter(DataIter):
     next = __next__
 
     def iter_next(self):
-        try:
-            self._peek = self.__next__()
+        if self.current_batch is not None:
             return True
-        except StopIteration:
+        batch = self._queue.get()
+        if batch is None:
             return False
+        self.current_batch = batch
+        return True
+
+    def getdata(self):
+        assert self.current_batch is not None, \
+            "call iter_next() before getdata()"
+        return self.current_batch.data
+
+    def getlabel(self):
+        assert self.current_batch is not None, \
+            "call iter_next() before getlabel()"
+        return self.current_batch.label
+
+    def getindex(self):
+        return getattr(self.current_batch, "index", None)
+
+    def getpad(self):
+        return getattr(self.current_batch, "pad", 0)
 
 
 class ResizeIter(DataIter):
@@ -450,12 +475,28 @@ class ImageRecordIter(DataIter):
         self.shuffle = shuffle
         self.data_name = data_name
         self.label_name = label_name
+        self._mem = None
         if path_imgidx and os.path.exists(path_imgidx):
             self.rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             self.keys = list(self.rec.keys)
         else:
             self.rec = recordio.MXRecordIO(path_imgrec, "r")
             self.keys = None
+            if shuffle:
+                # no index for random access: load raw records into memory
+                # so shuffling is real (the reference C++ iterator shuffles
+                # chunk-wise; silent sequential order would be wrong)
+                import warnings
+                warnings.warn(
+                    "ImageRecordIter: shuffle=True without path_imgidx "
+                    "loads the whole .rec into memory; provide an .idx "
+                    "file for large datasets")
+                self._mem = []
+                while True:
+                    raw = self.rec.read()
+                    if raw is None:
+                        break
+                    self._mem.append(raw)
         self._order = None
         self.reset()
 
@@ -475,6 +516,9 @@ class ImageRecordIter(DataIter):
             if self.shuffle:
                 np.random.shuffle(self._order)
             self._pos = 0
+        elif self._mem is not None:
+            self._order = np.random.permutation(len(self._mem)).tolist()
+            self._pos = 0
 
     def _read_one(self):
         from . import recordio
@@ -482,6 +526,11 @@ class ImageRecordIter(DataIter):
             if self._pos >= len(self._order):
                 return None
             raw = self.rec.read_idx(self._order[self._pos])
+            self._pos += 1
+        elif self._mem is not None:
+            if self._pos >= len(self._order):
+                return None
+            raw = self._mem[self._order[self._pos]]
             self._pos += 1
         else:
             raw = self.rec.read()

@@ -178,6 +178,11 @@ class Module(BaseModule):
             for i, name in enumerate(self._param_names):
                 kv.init(name, self._arg_params[name])
         self.optimizer_initialized = True
+        preload = getattr(self, "_preload_opt_states", None)
+        if preload is not None and self._updater is not None:
+            with open(preload, "rb") as f:
+                self._updater.set_states(f.read())
+            self._preload_opt_states = None
 
     # ---- step -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -251,6 +256,9 @@ class Module(BaseModule):
         mod = Module(sym, **kwargs)
         mod._arg_params = args
         mod._aux_params = auxs
-        mod.params_initialized = False
-        mod._preloaded_params = (args, auxs)
+        # loaded params count as initialized (reference module.py:160) —
+        # a later fit()/init_params() must NOT re-randomize them
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod

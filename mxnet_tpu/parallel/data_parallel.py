@@ -148,10 +148,12 @@ class DataParallelTrainer:
             # implicit (p - onehot) path, with a real loss value to report.
             label = batch[self.label_names[0]].astype(jnp.int32)
             logp = jnp.log(jnp.maximum(out, 1e-30))
+            # flatten all leading axes (batch, and time for sequence
+            # outputs) so every position contributes to the loss, matching
+            # the reference's per-position SoftmaxOutput gradient
+            logp2 = logp.reshape(-1, logp.shape[-1])
             picked = jnp.take_along_axis(
-                logp.reshape(label.shape[0], -1, logp.shape[-1])[:, 0, :]
-                if logp.ndim > 2 else logp,
-                label.reshape(-1, 1), axis=1)
+                logp2, label.reshape(-1, 1), axis=1)
             loss = -jnp.mean(picked)
         else:
             loss = jnp.mean(out)

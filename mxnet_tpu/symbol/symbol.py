@@ -505,8 +505,9 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
     if init is not None:
-        attrs["__init__"] = init if isinstance(init, str) else \
-            init.__class__.__name__
+        # store full init spec (class + kwargs) as the reference does
+        # (symbol.py:2484-2486 stores init.dumps() JSON)
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
     attrs.update(kwargs)
     return Symbol([(_Node(None, name, attrs, []), 0)])
 

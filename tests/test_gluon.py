@@ -1,0 +1,279 @@
+"""Gluon Block/nn/loss/Trainer tests.
+
+Reference analog: tests/python/unittest/test_gluon.py (SURVEY.md §4.1).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).context == mx.cpu(0)
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var().name == "weight"
+    p.reset_ctx(ctx=[mx.cpu(1), mx.cpu(2)])
+    assert set(p.list_ctx()) == {mx.cpu(1), mx.cpu(2)}
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]])
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with mx.autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_basic():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256))
+    model.add(nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+
+    # symbol
+    x = mx.sym.var("data")
+    y = model(x)
+    assert len(y.list_arguments()) == 7
+
+    # ndarray
+    model.initialize()
+    x = mx.nd.zeros((32, 2, 10))
+    out = model(x)
+    assert out.shape == (32, 32)
+
+    params = model.collect_params()
+    [params[k].grad() for k in params if k.endswith("weight")]
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.sym.var("data")
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == \
+        {"test_weight", "test_bias"}
+    assert outputs.list_outputs() == ["test_tanh_fwd_output"]
+    args, outs, auxs = outputs.infer_shape(data=(2, 3, 10))
+    assert outs == [(2, 3, 128)]
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.sym.var("data")
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == \
+        {"test2_weight", "test2_bias"}
+    args, outs, auxs = outputs.infer_shape(data=(17, 2, 5, 3))
+    assert outs == [(17, 128)]
+
+
+def test_hybrid_sequential_save_load(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 8))
+    y0 = net(x)
+    path = str(tmp_path / "m.params")
+    net.save_parameters(path)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(16, activation="relu"))
+        net2.add(nn.Dense(4))
+    net2.load_parameters(path)
+    y1 = net2(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.MaxPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(8))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    y0 = net(x)
+    net.hybridize()
+    y1 = net(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_hybrid_export_import(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(2, 6))
+    y0 = net(x)
+    path = str(tmp_path / "exported")
+    net.export(path)
+    net2 = gluon.SymbolBlock.imports(
+        path + "-symbol.json", ["data"], path + "-0000.params")
+    y1 = net2(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5)
+
+
+def test_conv_layers():
+    for layer, shape in [
+            (nn.Conv1D(4, 3), (1, 2, 10)),
+            (nn.Conv2D(4, 3, groups=2), (1, 2, 10, 10)),
+            (nn.Conv3D(4, 3), (1, 2, 10, 10, 10)),
+            (nn.Conv1DTranspose(4, 3), (1, 2, 10)),
+            (nn.Conv2DTranspose(4, 3, strides=2), (1, 2, 10, 10)),
+            (nn.MaxPool1D(2), (1, 2, 10)),
+            (nn.AvgPool2D((2, 2)), (1, 2, 10, 10)),
+            (nn.GlobalAvgPool2D(), (1, 2, 10, 10)),
+            (nn.GlobalMaxPool1D(), (1, 2, 10))]:
+        layer.initialize()
+        out = layer(mx.nd.random.uniform(shape=shape))
+        assert out.shape[0] == 1
+
+
+def test_norm_layers():
+    x = mx.nd.random.uniform(shape=(2, 4, 5))
+    ln = nn.LayerNorm(in_channels=5)
+    ln.initialize()
+    out = ln(x).asnumpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+
+    inorm = nn.InstanceNorm(in_channels=4)
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    with mx.autograd.record():
+        y = bn(x)
+    assert y.shape == x.shape
+
+
+def test_losses():
+    pred = mx.nd.random.uniform(shape=(4, 10))
+    label_idx = mx.nd.array([1, 2, 3, 4])
+    label_dense = mx.nd.random.uniform(shape=(4, 10))
+    losses = [
+        (gluon.loss.L2Loss(), label_dense),
+        (gluon.loss.L1Loss(), label_dense),
+        (gluon.loss.SigmoidBinaryCrossEntropyLoss(), label_dense),
+        (gluon.loss.SoftmaxCrossEntropyLoss(), label_idx),
+        (gluon.loss.KLDivLoss(from_logits=False), label_dense),
+        (gluon.loss.HuberLoss(), label_dense),
+        (gluon.loss.HingeLoss(), label_dense),
+        (gluon.loss.SquaredHingeLoss(), label_dense),
+        (gluon.loss.LogisticLoss(), label_dense),
+        (gluon.loss.PoissonNLLLoss(), label_dense),
+    ]
+    for loss_fn, label in losses:
+        L = loss_fn(pred, label)
+        assert L.shape[0] == 4 or L.ndim == 0, type(loss_fn).__name__
+        assert np.isfinite(L.asnumpy()).all(), type(loss_fn).__name__
+
+
+def test_softmax_ce_loss_value():
+    pred = mx.nd.array([[1e10, -1e10, 0], [0, 1e10, -1e10]])
+    label = mx.nd.array([0, 1])
+    L = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    np.testing.assert_allclose(L.asnumpy(), 0, atol=1e-5)
+
+
+def test_trainer_sgd_matches_manual():
+    w = gluon.Parameter("w", shape=(3,))
+    w.initialize(init="ones", ctx=mx.cpu())
+    trainer = gluon.Trainer({"w": w}, "sgd", {"learning_rate": 0.5})
+    with mx.autograd.record():
+        loss = (w.data() * mx.nd.array([1., 2., 3.])).sum()
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(
+        w.data().asnumpy(), 1 - 0.5 * np.array([1., 2., 3.]), rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    w = gluon.Parameter("w", shape=(3,))
+    w.initialize(ctx=mx.cpu())
+    tr = gluon.Trainer({"w": w}, "adam", {"learning_rate": 0.1})
+    with mx.autograd.record():
+        loss = (w.data() ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    path = str(tmp_path / "t.states")
+    tr.save_states(path)
+    tr.load_states(path)
+
+
+def test_split_and_load():
+    x = mx.nd.arange(12).reshape((4, 3))
+    parts = gluon.split_and_load(x, [mx.cpu(0), mx.cpu(1)])
+    assert [p.shape for p in parts] == [(2, 3), (2, 3)]
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+
+
+def test_lambda_blocks():
+    net = nn.HybridLambda(lambda F, x: F.relu(x))
+    out = net(mx.nd.array([-1.0, 1.0]))
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 1.0])
+    net2 = nn.Lambda("relu")
+    np.testing.assert_allclose(
+        net2(mx.nd.array([-2.0, 2.0])).asnumpy(), [0.0, 2.0])
+
+
+def test_zero_grad():
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(ctx=mx.cpu())
+    with mx.autograd.record():
+        L = (p.data() * 2).sum()
+    L.backward()
+    assert p.grad().asnumpy().sum() != 0
+    p.zero_grad()
+    assert p.grad().asnumpy().sum() == 0

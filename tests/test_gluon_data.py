@@ -1,0 +1,174 @@
+"""Gluon data API tests (ref: tests/python/unittest/test_gluon_data.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_array_dataset():
+    X = np.random.uniform(size=(10, 20))
+    Y = np.random.uniform(size=(10,))
+    dataset = gdata.ArrayDataset(X, Y)
+    assert len(dataset) == 10
+    x, y = dataset[3]
+    np.testing.assert_allclose(x, X[3])
+
+
+def test_simple_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(10))).transform(lambda x: x * 2)
+    assert ds[4] == 8
+    ds2 = gdata.ArrayDataset(np.arange(6).reshape(3, 2),
+                             np.arange(3)).transform_first(lambda x: x + 1)
+    x, y = ds2[0]
+    np.testing.assert_allclose(x, [1, 2])
+    assert y == 0
+
+
+def test_samplers():
+    assert list(gdata.SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(gdata.RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    assert len(bs) == 3
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # rolled-over element reused
+
+
+def test_dataloader():
+    X = np.random.uniform(size=(24, 5)).astype("float32")
+    Y = np.arange(24).astype("float32")
+    dataset = gdata.ArrayDataset(X, Y)
+    for workers in (0, 2):
+        loader = gdata.DataLoader(dataset, batch_size=8,
+                                  num_workers=workers)
+        batches = list(loader)
+        assert len(batches) == 3
+        xs = np.concatenate([b[0].asnumpy() for b in batches])
+        np.testing.assert_allclose(xs, X, rtol=1e-6)
+
+
+def test_dataloader_shuffle():
+    X = np.arange(20).astype("float32")
+    dataset = gdata.SimpleDataset(list(X))
+    loader = gdata.DataLoader(dataset, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == X.tolist()
+
+
+def test_dataloader_error_propagation():
+    class Bad(gdata.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            raise RuntimeError("boom")
+
+    loader = gdata.DataLoader(Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError):
+        list(loader)
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        w.write_idx(i, b"record%d" % i)
+    w.close()
+    ds = gdata.RecordFileDataset(rec)
+    assert len(ds) == 5
+    assert ds[3] == b"record3"
+
+
+def test_vision_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    im = mx.nd.array(
+        np.random.randint(0, 255, (32, 32, 3)).astype(np.uint8))
+    t = transforms.ToTensor()
+    out = t(im)
+    assert out.shape == (3, 32, 32)
+    assert out.asnumpy().max() <= 1.0
+
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.1, 0.1, 0.1))
+    out2 = norm(out)
+    assert out2.shape == (3, 32, 32)
+
+    resize = transforms.Resize(16)
+    assert resize(im).shape == (16, 16, 3)
+
+    crop = transforms.CenterCrop(20)
+    assert crop(im).shape == (20, 20, 3)
+
+    rrc = transforms.RandomResizedCrop(16, scale=(0.5, 1.0))
+    assert rrc(im).shape == (16, 16, 3)
+
+    flip = transforms.RandomFlipLeftRight()
+    assert flip(im).shape == im.shape
+
+    jitter = transforms.RandomColorJitter(0.1, 0.1, 0.1, 0.1)
+    assert jitter(im.astype("float32")).shape == im.shape
+
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.5, 0.2)])
+    assert comp(im).shape == (3, 32, 32)
+
+
+def test_image_module(tmp_path):
+    import cv2
+    from mxnet_tpu import image
+    arr = np.random.randint(0, 255, (40, 50, 3)).astype(np.uint8)
+    path = str(tmp_path / "x.jpg")
+    cv2.imwrite(path, arr)
+    im = image.imread(path)
+    assert im.shape == (40, 50, 3)
+    with open(path, "rb") as f:
+        im2 = image.imdecode(f.read())
+    assert im2.shape == (40, 50, 3)
+    assert image.imresize(im, 20, 10).shape == (10, 20, 3)
+    assert image.resize_short(im, 20).shape[1] >= 20
+    out, _ = image.center_crop(im, (30, 30))
+    assert out.shape == (30, 30, 3)
+    augs = image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    x = im
+    for aug in augs:
+        x = aug(x)
+    assert x.shape == (24, 24, 3)
+
+
+def test_image_iter(tmp_path):
+    import cv2
+    from mxnet_tpu import image, recordio
+    rec = str(tmp_path / "im.rec")
+    idx = str(tmp_path / "im.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        arr = np.random.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), arr)
+        w.write_idx(i, packed)
+    w.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=rec, path_imgidx=idx, shuffle=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    assert batch.label[0].shape == (4,)
+    it.reset()
+    n = sum(1 for _ in iter(it.next, None) if False) if False else 0
+    count = 0
+    it.reset()
+    try:
+        while True:
+            it.next()
+            count += 1
+    except StopIteration:
+        pass
+    assert count == 2

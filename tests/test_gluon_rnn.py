@@ -1,0 +1,140 @@
+"""Gluon RNN layer/cell tests (ref: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, n_states in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2),
+                               (rnn.GRUCell, 1)]:
+        cell = cell_cls(16, input_size=8, prefix="%s_" % cell_cls.__name__)
+        cell.initialize()
+        x = mx.nd.random.uniform(shape=(2, 8))
+        states = cell.begin_state(batch_size=2)
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 16)
+        assert len(new_states) == n_states
+
+
+def test_cell_unroll():
+    cell = rnn.LSTMCell(8, input_size=4, prefix="lstm_")
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 4))  # NTC
+    outputs, states = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+    assert len(states) == 2
+
+
+def test_fused_matches_unfused():
+    layer = rnn.LSTM(8, num_layers=2, input_size=5)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3, 5))  # TNC
+    out, states = layer(x)
+    assert out.shape == (4, 3, 8)
+    stack = layer._unfuse()
+    outs, _ = stack.unroll(4, mx.nd.swapaxes(x, 0, 1), layout="NTC",
+                           merge_outputs=True)
+    np.testing.assert_allclose(
+        out.asnumpy(), mx.nd.swapaxes(outs, 0, 1).asnumpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_gru_fused_matches_unfused():
+    layer = rnn.GRU(8, input_size=5)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3, 5))
+    out, _ = layer(x)
+    outs, _ = layer._unfuse().unroll(
+        4, mx.nd.swapaxes(x, 0, 1), layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(
+        out.asnumpy(), mx.nd.swapaxes(outs, 0, 1).asnumpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_fused():
+    layer = rnn.LSTM(8, num_layers=2, bidirectional=True, input_size=5)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3, 5))
+    out, states = layer(x)
+    assert out.shape == (4, 3, 16)
+    assert states[0].shape == (4, 3, 8)
+
+
+def test_rnn_layer_backward():
+    layer = rnn.GRU(8, num_layers=1, input_size=5, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4, 5))
+    with mx.autograd.record():
+        out, _ = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(
+        rnn.LSTMCell(4, input_size=3, prefix="l_"),
+        rnn.LSTMCell(4, input_size=3, prefix="r_"))
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 3))
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(4, input_size=4, prefix="gru_"))
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 4))
+    outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_sequential_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4, prefix="l0_"))
+    stack.add(rnn.LSTMCell(8, input_size=8, prefix="l1_"))
+    stack.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 4))
+    outputs, states = stack.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_zoneout_cell():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4, prefix="rnn_"),
+                           zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 4))
+    outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_dropout_cell():
+    cell = rnn.DropoutCell(0.5)
+    x = mx.nd.ones((2, 3, 4))
+    outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_vardrop_cell():
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+    cell = VariationalDropoutCell(
+        rnn.GRUCell(4, input_size=4, prefix="gru_"), drop_inputs=0.3,
+        drop_outputs=0.3)
+    cell.initialize()
+    with mx.autograd.record():
+        outputs, _ = cell.unroll(
+            3, mx.nd.ones((2, 3, 4)), layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_ntc_layout_layer():
+    layer = rnn.LSTM(6, input_size=4, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5, 4))
+    out, states = layer(x)
+    assert out.shape == (3, 5, 6)
