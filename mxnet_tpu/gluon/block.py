@@ -50,8 +50,7 @@ class _BlockScope:
         current = _BlockScope._current()
         if current is None:
             if prefix is None:
-                nm = current_scope() or NameManager()
-                prefix = nm.get(None, hint) + "_"
+                prefix = current_scope().get(None, hint) + "_"
             if params is None:
                 params = ParameterDict(prefix)
             else:

@@ -35,8 +35,18 @@ class Prefix(NameManager):
         self._prefix = prefix
 
     def get(self, name, hint):
-        return name if name else self._prefix + super().get(None, hint)
+        # reference Prefix prepends even to explicit names
+        # (python/mxnet/name.py Prefix.get)
+        return self._prefix + (name if name else super().get(None, hint))
 
 
 def current_scope():
-    return getattr(_local, "scope", None)
+    """Current NameManager, falling back to a per-thread default whose
+    counters persist (parity: python/mxnet/name.py NameManager.current)."""
+    scope = getattr(_local, "scope", None)
+    if scope is None:
+        scope = getattr(_local, "default", None)
+        if scope is None:
+            scope = NameManager()
+            _local.default = scope
+    return scope

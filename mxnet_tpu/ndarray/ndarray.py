@@ -588,6 +588,43 @@ def imperative_invoke(op_name, *args, **kwargs):
         else:
             raise MXNetError("invalid positional argument %r to op %s"
                              % (type(a), op_name))
+    # Array-valued keyword args are inputs placed by declared arg name
+    # (reference generated signatures: F.LayerNorm(data, gamma=.., beta=..))
+    kw_arrays = {}
+    for k, v in kwargs.items():
+        if k in ("out", "name", "ctx"):
+            continue
+        if isinstance(v, NDArray):
+            kw_arrays[k] = v
+        elif isinstance(v, np.ndarray):
+            kw_arrays[k] = array(v)
+    if kw_arrays:
+        for k in kw_arrays:
+            kwargs.pop(k)
+        if op.arg_names:
+            slots = {n: i for i, n in enumerate(op.arg_names)}
+            hi = max((slots.get(k, -1) for k in kw_arrays), default=-1)
+            ins = list(inputs) + [None] * max(0, hi + 1 - len(inputs))
+            for k, v in kw_arrays.items():
+                i = slots.get(k)
+                if i is None:
+                    ins.append(v)
+                elif i < len(ins) and ins[i] is not None:
+                    raise MXNetError(
+                        "op %s: input %r given both positionally and by "
+                        "keyword" % (op_name, k))
+                else:
+                    while len(ins) <= i:
+                        ins.append(None)
+                    ins[i] = v
+            if any(v is None for v in ins):
+                raise MXNetError(
+                    "op %s: missing input(s) %s" % (op_name, [
+                        op.arg_names[i] for i, v in enumerate(ins)
+                        if v is None]))
+            inputs = ins
+        else:
+            inputs.extend(kw_arrays.values())
     if scalars:
         for k in op.params:
             if not scalars:

@@ -125,6 +125,12 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         m0 = jnp.full((B, H, T_loc), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((B, H, T_loc), jnp.float32)
         o0 = jnp.zeros((B, H, T_loc, qs.shape[-1]), jnp.float32)
+        # the carry becomes device-varying after the first ppermute, so the
+        # initial value must be marked varying over the ring axis too
+        if hasattr(jax.lax, "pcast"):
+            m0, l0, o0 = jax.lax.pcast((m0, l0, o0), (axis,), to="varying")
+        else:
+            m0, l0, o0 = jax.lax.pvary((m0, l0, o0), (axis,))
         (m, l, o, _, _), _ = jax.lax.scan(body, (m0, l0, o0, ks, vs),
                                           jnp.arange(n))
         out = o / jnp.maximum(l[..., None], 1e-37)

@@ -44,20 +44,14 @@ def _make_fn(op_name):
                     i = slots.get(k)
                     if i is None:
                         ins.append(v)
+                    elif ins[i] is not None:
+                        raise TypeError(
+                            "op %s: input %r given both positionally and "
+                            "by keyword" % (op_name, k))
                     else:
-                        while len(ins) <= i:
-                            ins.append(None)
                         ins[i] = v
-                # fill gaps with auto-created variables later in _create;
-                # drop trailing Nones, replace interior Nones by auto-vars
-                from .symbol import Variable, _auto_name
-                nm = name or _auto_name(op.name.lower())
-                name = nm
-                for i, v in enumerate(ins):
-                    if v is None:
-                        argname = op.arg_names[i] if i < len(op.arg_names) \
-                            else "arg%d" % i
-                        ins[i] = Variable("%s_%s" % (nm, argname))
+                # interior None gaps become auto-created variables inside
+                # _create (named after the scope-resolved node name)
                 sym_inputs = ins
             else:
                 sym_inputs.extend(kw_syms.values())

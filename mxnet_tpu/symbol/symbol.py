@@ -52,19 +52,9 @@ class _Node:
             self.op.num_visible_outputs(self.parsed_attrs())
 
 
-_name_lock = threading.Lock()
-_name_counters: Dict[str, int] = {}
-
-
 def _auto_name(prefix: str) -> str:
     from ..name import current_scope
-    scope = current_scope()
-    if scope is not None:
-        return scope.get(None, prefix)
-    with _name_lock:
-        i = _name_counters.get(prefix, 0)
-        _name_counters[prefix] = i + 1
-        return "%s%d" % (prefix, i)
+    return current_scope().get(None, prefix)
 
 
 class Symbol:
@@ -467,11 +457,22 @@ def _create(op_name: str, sym_inputs: Sequence[Symbol],
             kwargs: Dict[str, Any], name: Optional[str] = None) -> Symbol:
     op = get_op(op_name)
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
-    name = name or kwargs.pop("name", None) or _auto_name(op.name.lower())
+    name = name or kwargs.pop("name", None)
     kwargs.pop("name", None)
+    # explicit names also route through the scope manager so a Prefix scope
+    # (gluon name_scope) prepends its prefix (reference _ctypes/symbol.py)
+    from ..name import current_scope as _cs
+    name = _cs().get(name, op.name.lower())
 
-    entries: List[Tuple[_Node, int]] = []
+    entries: List[Tuple[Optional[_Node], int]] = []
     for s in sym_inputs:
+        if s is None:
+            # interior gap from keyword placement: auto-create a variable
+            # named after the (scope-resolved) node name + arg name
+            argname = op.arg_names[len(entries)] if op.arg_names and \
+                len(entries) < len(op.arg_names) else "arg%d" % len(entries)
+            entries.append((_Node(None, "%s_%s" % (name, argname), {}, []), 0))
+            continue
         if len(s._outputs) != 1:
             raise MXNetError("op inputs must be single-output symbols")
         entries.append(s._outputs[0])

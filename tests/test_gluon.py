@@ -206,7 +206,7 @@ def test_losses():
     ]
     for loss_fn, label in losses:
         L = loss_fn(pred, label)
-        assert L.shape[0] == 4 or L.ndim == 0, type(loss_fn).__name__
+        assert L.ndim == 0 or L.shape[0] == 4, type(loss_fn).__name__
         assert np.isfinite(L.asnumpy()).all(), type(loss_fn).__name__
 
 
