@@ -16,3 +16,4 @@ from . import rnn           # noqa: F401
 from . import init_random   # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import shape_hints   # noqa: F401  (installs arg names + infer hints)
+from . import vision_fork   # noqa: F401  (yangyu12 fork custom vision ops)

@@ -1,0 +1,235 @@
+"""The yangyu12-fork custom vision ops, TPU-native.
+
+Reference analogs (the fork's additions on top of upstream MXNet 1.2,
+SURVEY.md "Version/identity"):
+
+- ``AttentionConvolution`` — src/operator/nn/attention_convolution.cc:368,
+  attention_convolution-inl.h:178-284: convolution where the im2col patch
+  matrix is elementwise-masked by a per-position attention input before the
+  weight GEMM: ``out = W @ (im2col(data) * attention)``.
+- ``DynamicConvolution`` — src/operator/nn/dynamic_convolution.cc:293,
+  dynamic_convolution.cu:172-212 (``dynconv_inprod_gpu_kernel``): convolution
+  whose filter is *predicted per output position*: an "across" weight mixes
+  input channels at the centre tap, a "within" weight applies a per-position
+  spatial kernel summed over channels.
+- ``RadiateSample`` — src/operator/nn/radiate_sample.cc:117,
+  radiate_sample.cu:14-64 (``RadSamForwardKernel``): channel groups sample
+  rings of increasing radius; group ``g`` averages the ``8g`` pixels on the
+  perimeter of a ``(2g+1)²`` square (group 0 takes the centre pixel).
+
+TPU-native design: all three are expressed as XLA-fusable tensor programs —
+``conv_general_dilated_patches`` (im2col on the MXU) + einsum for the two
+dynamic convs, and a *fixed-weight depthwise convolution* for RadiateSample
+(the ring average is a constant stencil, so XLA lowers it straight to the
+MXU instead of the reference's scalar gather loop).  Backward passes come
+from ``jax.vjp`` of these definitions; the reference's hand-written backward
+GEMMs (attention_convolution-inl.h:286-428) are exactly the VJPs of the
+forward math, so gradients match by construction.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register, param
+from .nn import _CONV_PARAMS
+
+
+def _patches(data, kernel, stride, pad, dilate):
+    """im2col: (N, C, H, W) -> (N, C*prod(k), H', W'), feature dim ordered
+    channel-major (c, kh, kw) — same layout as the reference's caffe-style
+    im2col buffer (attention_convolution-inl.h:218-222)."""
+    return jax.lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel), window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+@register("AttentionConvolution", nin=-1,
+          params=dict(_CONV_PARAMS))
+def _attention_convolution(attrs, data, attention, weight, *maybe_bias):
+    """out = weight @ (im2col(data) * attention), per group.
+
+    attention has one mask value per (input-patch element, output position):
+    shape (N, Cin*prod(kernel), H'*W') — any shape with that many elements is
+    accepted, mirroring the reference's ``get_with_shape`` reshape
+    (attention_convolution-inl.h:196).
+    """
+    k = attrs["kernel"]
+    nd = len(k)
+    if nd != 2:
+        raise MXNetError("AttentionConvolution: only 2D kernels supported "
+                         "(reference GPU path is 2D-only)")
+    stride = attrs["stride"] or (1,) * nd
+    dilate = attrs["dilate"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    g = attrs["num_group"]
+    nf = attrs["num_filter"]
+
+    n, c = data.shape[0], data.shape[1]
+    cols = _patches(data, k, stride, pad, dilate)      # (N, C*kk, H', W')
+    ho, wo = cols.shape[2], cols.shape[3]
+    kdim = (c // g) * int(np.prod(k))                  # K = Cin/g * k*k
+    cols = cols.reshape(n, g, kdim, ho * wo)
+    att = attention.reshape(n, g, kdim, ho * wo)
+    w3 = weight.reshape(g, nf // g, kdim)              # (g, M, K)
+    # masked patches then one big GEMM per group — rides the MXU
+    out = jnp.einsum("gmk,ngkp->ngmp", w3, cols * att,
+                     preferred_element_type=jnp.float32).astype(data.dtype)
+    out = out.reshape(n, nf, ho, wo)
+    if not attrs["no_bias"] and maybe_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+@register("DynamicConvolution", nin=3,
+          params={**_CONV_PARAMS,
+                  "sample": param("shape", ()),
+                  "s_stride": param("shape", ())})
+def _dynamic_convolution(attrs, data, across_weight, within_weight):
+    """Position-dependent dynamic filtering (dynamic_convolution.cu:172-212):
+
+    out[n,o,p] = sum_c across[n,o,c,p] * centre_patch[n,c,p]
+               + sum_k within[n,o,k,p] * (sum_c patches[n,c,k,p])
+
+    across_weight: (N, num_filter*Cin, H', W'); within_weight:
+    (N, num_filter*prod(kernel), H', W').  The reference supports only
+    stride 1 / num_group 1 (dynamic_convolution-inl.h:36-37 "NOT SUPPORT");
+    its ``sample`` extension writes an output layout inconsistent with the
+    op's declared shape, so only the default sample=(1,1) is provided.
+    """
+    k = attrs["kernel"]
+    nd = len(k)
+    if nd != 2:
+        raise MXNetError("DynamicConvolution: only 2D kernels supported")
+    if attrs["num_group"] != 1:
+        raise MXNetError("DynamicConvolution: num_group != 1 unsupported "
+                         "(matches reference dynamic_convolution-inl.h:37)")
+    stride = attrs["stride"] or (1,) * nd
+    if tuple(stride) != (1,) * nd:
+        raise MXNetError("DynamicConvolution: stride != 1 unsupported "
+                         "(matches reference dynamic_convolution-inl.h:36)")
+    sample = attrs["sample"] or ()
+    if any(int(s) != 1 for s in sample):
+        raise MXNetError("DynamicConvolution: sample != 1 unsupported")
+    dilate = attrs["dilate"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    nf = attrs["num_filter"]
+
+    n, c = data.shape[0], data.shape[1]
+    kk = int(np.prod(k))
+    cols = _patches(data, k, stride, pad, dilate)      # (N, C*kk, H', W')
+    ho, wo = cols.shape[2], cols.shape[3]
+    cols = cols.reshape(n, c, kk, ho * wo)
+    centre = (k[0] - 1) // 2 * k[1] + (k[1] - 1) // 2  # centre tap index
+    aw = across_weight.reshape(n, nf, c, ho * wo)
+    ww = within_weight.reshape(n, nf, kk, ho * wo)
+    out = (jnp.einsum("nocp,ncp->nop", aw, cols[:, :, centre, :],
+                      preferred_element_type=jnp.float32)
+           + jnp.einsum("nokp,nkp->nop", ww, cols.sum(axis=1),
+                        preferred_element_type=jnp.float32))
+    return out.astype(data.dtype).reshape(n, nf, ho, wo)
+
+
+def _ring_kernel(num_group, group_size, dtype):
+    """Constant depthwise stencil: channel block g gets the radius-g ring
+    average (1/(8g) on the perimeter of the centred (2g+1)² square; g=0 is
+    the identity tap).  Shape (num_group*group_size, 1, S, S), S=2G-1."""
+    radius = num_group - 1
+    size = 2 * radius + 1
+    w = np.zeros((num_group * group_size, 1, size, size), dtype=dtype)
+    for g in range(num_group):
+        if g == 0:
+            w[0:group_size, 0, radius, radius] = 1.0
+        else:
+            ring = np.zeros((size, size), dtype=dtype)
+            lo, hi = radius - g, radius + g
+            ring[lo, lo:hi + 1] = 1.0
+            ring[hi, lo:hi + 1] = 1.0
+            ring[lo:hi + 1, lo] = 1.0
+            ring[lo:hi + 1, hi] = 1.0
+            w[g * group_size:(g + 1) * group_size, 0] = ring / (8.0 * g)
+    return jnp.asarray(w)
+
+
+@register("RadiateSample", nin=1,
+          params={"pad": param("shape", (0, 0)),
+                  "num_group": param(int, 1)})
+def _radiate_sample(attrs, data):
+    """Ring-average sampling (radiate_sample.cu:14-64) as a fixed depthwise
+    conv: out spatial = in + 2*pad - 2*(num_group-1); channels not divisible
+    by num_group are dropped (radiate_sample.cc:45-49)."""
+    num_group = attrs["num_group"]
+    pad = attrs["pad"] or (0, 0)
+    n, c, h, w = data.shape
+    keep = c - c % num_group
+    group_size = c // num_group
+    data = data[:, :keep]
+    kern = _ring_kernel(num_group, group_size, np.float32).astype(data.dtype)
+    out = jax.lax.conv_general_dilated(
+        data, kern,
+        window_strides=(1, 1),
+        padding=[(int(pad[0]), int(pad[0])), (int(pad[1]), int(pad[1]))],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=keep)
+    return out.astype(data.dtype)
+
+
+def _attconv_hint(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = attrs["kernel"]
+    nf, g = attrs["num_filter"], attrs["num_group"]
+    stride = attrs["stride"] or (1,) * len(k)
+    dilate = attrs["dilate"] or (1,) * len(k)
+    pad = attrs["pad"] or (0,) * len(k)
+    sp = [(data[2 + i] + 2 * pad[i] - (dilate[i] * (k[i] - 1) + 1))
+          // stride[i] + 1 for i in range(len(k))]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (data[0], data[1] * int(np.prod(k)), sp[0], sp[1])
+    if len(out) > 2 and out[2] is None:
+        out[2] = (nf, data[1] // g) + tuple(k)
+    if len(out) > 3 and out[3] is None and not attrs["no_bias"]:
+        out[3] = (nf,)
+    return out
+
+
+def _dynconv_hint(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    k = attrs["kernel"]
+    nf = attrs["num_filter"]
+    dilate = attrs["dilate"] or (1,) * len(k)
+    pad = attrs["pad"] or (0,) * len(k)
+    sp = [data[2 + i] + 2 * pad[i] - (dilate[i] * (k[i] - 1) + 1) + 1
+          for i in range(len(k))]
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (data[0], nf * data[1], sp[0], sp[1])
+    if len(out) > 2 and out[2] is None:
+        out[2] = (data[0], nf * int(np.prod(k)), sp[0], sp[1])
+    return out
+
+
+def install_hints():
+    from .registry import OPS
+    cfg = {
+        "AttentionConvolution": (("data", "attention", "weight", "bias"),
+                                 _attconv_hint),
+        "DynamicConvolution": (("data", "across_weight", "within_weight"),
+                               _dynconv_hint),
+        "RadiateSample": (("data",), None),
+    }
+    for name, (arg_names, hint) in cfg.items():
+        op = OPS[name]
+        op.arg_names = list(arg_names)
+        if hint is not None:
+            op.shape_hint = hint
+
+
+install_hints()

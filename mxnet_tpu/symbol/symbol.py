@@ -481,7 +481,8 @@ def _create(op_name: str, sym_inputs: Sequence[Symbol],
     # sym.Convolution(data=x, name='c1') creates c1_weight / c1_bias)
     if op.arg_names:
         needed = len(op.arg_names)
-        if op.name in ("Convolution", "Deconvolution", "FullyConnected") and \
+        if op.name in ("Convolution", "Deconvolution", "FullyConnected",
+                       "AttentionConvolution") and \
                 op.parse_attrs(dict(kwargs)).get("no_bias"):
             needed -= 1
         while len(entries) < needed:

@@ -1,0 +1,195 @@
+"""Tests for the yangyu12-fork custom vision ops (AttentionConvolution,
+DynamicConvolution, RadiateSample — SURVEY.md "Version/identity").
+
+Numeric references are direct NumPy transcriptions of the op math from
+attention_convolution-inl.h:178-284, dynamic_convolution.cu:172-212, and
+radiate_sample.cu:14-64.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def _im2col(x, k, stride, pad, dilate):
+    """caffe-layout im2col: (N,C,H,W) -> (N, C*kh*kw, Ho, Wo)."""
+    n, c, h, w = x.shape
+    kh, kw = k
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    ho = (h + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    wo = (w + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+    out = np.zeros((n, c, kh, kw, ho, wo), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ii, jj = i * dilate[0], j * dilate[1]
+            out[:, :, i, j] = xp[:, :, ii:ii + ho * stride[0]:stride[0],
+                                 jj:jj + wo * stride[1]:stride[1]]
+    return out.reshape(n, c * kh * kw, ho, wo)
+
+
+def test_attention_convolution_forward():
+    rng = np.random.RandomState(0)
+    n, c, h, w = 2, 4, 6, 6
+    nf, k, pad = 3, (3, 3), (1, 1)
+    x = rng.randn(n, c, h, w).astype(np.float32)
+    wt = rng.randn(nf, c, *k).astype(np.float32)
+    b = rng.randn(nf).astype(np.float32)
+    att = rng.rand(n, c * k[0] * k[1], h, w).astype(np.float32)
+
+    out = nd.AttentionConvolution(
+        nd.array(x), nd.array(att), nd.array(wt), nd.array(b),
+        kernel=k, pad=pad, num_filter=nf).asnumpy()
+
+    cols = _im2col(x, k, (1, 1), pad, (1, 1))           # (N, C*kk, H, W)
+    masked = cols * att.reshape(n, c * 9, h, w)
+    ref = np.einsum("mk,nkp->nmp", wt.reshape(nf, -1),
+                    masked.reshape(n, c * 9, h * w))
+    ref = ref.reshape(n, nf, h, w) + b.reshape(1, nf, 1, 1)
+    assert out.shape == (n, nf, h, w)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_attention_convolution_grouped_strided():
+    rng = np.random.RandomState(1)
+    n, c, h, w, g = 1, 4, 8, 8, 2
+    nf, k, stride, pad = 4, (3, 3), (2, 2), (1, 1)
+    ho = wo = 4
+    x = rng.randn(n, c, h, w).astype(np.float32)
+    wt = rng.randn(nf, c // g, *k).astype(np.float32)
+    att = rng.rand(n, c * 9, ho, wo).astype(np.float32)
+    out = nd.AttentionConvolution(
+        nd.array(x), nd.array(att), nd.array(wt),
+        kernel=k, stride=stride, pad=pad, num_filter=nf, num_group=g,
+        no_bias=True).asnumpy()
+
+    cols = _im2col(x, k, stride, pad, (1, 1)).reshape(n, g, (c // g) * 9,
+                                                      ho * wo)
+    masked = cols * att.reshape(n, g, (c // g) * 9, ho * wo)
+    w3 = wt.reshape(g, nf // g, (c // g) * 9)
+    ref = np.einsum("gmk,ngkp->ngmp", w3, masked).reshape(n, nf, ho, wo)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_attention_convolution_grad():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    att = nd.array(rng.rand(1, 2 * 9, 5, 5).astype(np.float32))
+    wt = nd.array(rng.randn(3, 2, 3, 3).astype(np.float32))
+    for a in (x, att, wt):
+        a.attach_grad()
+    with autograd.record():
+        y = nd.AttentionConvolution(x, att, wt, kernel=(3, 3), pad=(1, 1),
+                                    num_filter=3, no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    for a in (x, att, wt):
+        assert np.isfinite(a.grad.asnumpy()).all()
+        assert np.abs(a.grad.asnumpy()).sum() > 0
+
+
+def test_dynamic_convolution_forward():
+    rng = np.random.RandomState(3)
+    n, c, h, w = 2, 3, 5, 5
+    nf, k, pad = 2, (3, 3), (1, 1)
+    x = rng.randn(n, c, h, w).astype(np.float32)
+    aw = rng.randn(n, nf * c, h, w).astype(np.float32)
+    ww = rng.randn(n, nf * 9, h, w).astype(np.float32)
+    out = nd.DynamicConvolution(nd.array(x), nd.array(aw), nd.array(ww),
+                                kernel=k, pad=pad,
+                                num_filter=nf).asnumpy()
+
+    cols = _im2col(x, k, (1, 1), pad, (1, 1)).reshape(n, c, 9, h * w)
+    centre = cols[:, :, 4, :]                              # (N, C, P)
+    ref = (np.einsum("nocp,ncp->nop", aw.reshape(n, nf, c, h * w), centre)
+           + np.einsum("nokp,nkp->nop", ww.reshape(n, nf, 9, h * w),
+                       cols.sum(axis=1)))
+    np.testing.assert_allclose(out, ref.reshape(n, nf, h, w),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_dynamic_convolution_grad_and_guards():
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.randn(1, 2, 4, 4).astype(np.float32))
+    aw = nd.array(rng.randn(1, 2 * 2, 4, 4).astype(np.float32))
+    ww = nd.array(rng.randn(1, 2 * 9, 4, 4).astype(np.float32))
+    for a in (x, aw, ww):
+        a.attach_grad()
+    with autograd.record():
+        y = nd.DynamicConvolution(x, aw, ww, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=2)
+        y.sum().backward()
+    for a in (x, aw, ww):
+        assert np.isfinite(a.grad.asnumpy()).all()
+    with pytest.raises(Exception):
+        nd.DynamicConvolution(x, aw, ww, kernel=(3, 3), pad=(1, 1),
+                              num_filter=2, stride=(2, 2))
+
+
+def _radiate_ref(x, pad, num_group):
+    n, c, h, w = x.shape
+    gs = c // num_group
+    keep = c - c % num_group
+    radius = num_group - 1
+    ho = h + 2 * pad[0] - 2 * radius
+    wo = w + 2 * pad[1] - 2 * radius
+    out = np.zeros((n, keep, ho, wo), x.dtype)
+    for ch in range(keep):
+        g = ch // gs
+        for oh in range(ho):
+            for ow in range(wo):
+                dh = oh + radius - pad[0]
+                dw = ow + radius - pad[1]
+                if g == 0:
+                    v = x[:, ch, dh, dw] if 0 <= dh < h and 0 <= dw < w else 0
+                else:
+                    v = 0.0
+                    for i in range(-g, g + 1):
+                        for j in range(-g, g + 1):
+                            if max(abs(i), abs(j)) != g:
+                                continue
+                            hh, ww2 = dh + i, dw + j
+                            if 0 <= hh < h and 0 <= ww2 < w:
+                                v = v + x[:, ch, hh, ww2]
+                    v = v / (8.0 * g)
+                out[:, ch, oh, ow] = v
+    return out
+
+
+def test_radiate_sample():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 7, 7).astype(np.float32)
+    for num_group, pad in [(1, (0, 0)), (2, (1, 1)), (3, (2, 2))]:
+        out = nd.RadiateSample(nd.array(x), pad=pad,
+                               num_group=num_group).asnumpy()
+        ref = _radiate_ref(x, pad, num_group)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_radiate_sample_channel_drop_and_grad():
+    rng = np.random.RandomState(6)
+    x = nd.array(rng.randn(1, 5, 6, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.RadiateSample(x, pad=(1, 1), num_group=2)
+        y.sum().backward()
+    assert y.shape == (1, 4, 6, 6)          # 5 % 2 -> one channel dropped
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all()
+    assert np.abs(g[:, 4]).sum() == 0       # dropped channel gets no grad
+
+
+def test_fork_ops_symbolic():
+    from mxnet_tpu import symbol as sym
+    data = sym.var("data")
+    att = sym.var("att")
+    wt = sym.var("w")
+    out = sym.AttentionConvolution(data, att, wt, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=2, no_bias=True)
+    ex = out.bind(mx.cpu(), {
+        "data": nd.ones((1, 2, 4, 4)),
+        "att": nd.ones((1, 18, 4, 4)),
+        "w": nd.ones((2, 2, 3, 3))})
+    y = ex.forward()[0]
+    assert y.shape == (1, 2, 4, 4)
