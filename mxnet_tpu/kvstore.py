@@ -51,6 +51,7 @@ class KVStore:
             self._store[k] = v0.copy()
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray, add as _sparse_add
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
@@ -58,17 +59,28 @@ class KVStore:
             vlist = v if isinstance(v, (list, tuple)) else [v]
             # reduce across devices: the CommDevice tree reduce of comm.h
             # becomes one XLA add chain (ICI all-reduce on a pod mesh)
-            agg = vlist[0]
-            if len(vlist) > 1:
-                agg = vlist[0].copy()
+            if all(isinstance(x, RowSparseNDArray) for x in vlist):
+                agg = vlist[0]
                 for x in vlist[1:]:
-                    agg += x.as_in_context(agg.context)
+                    agg = _sparse_add(agg, x)
+            else:
+                agg = vlist[0]
+                if len(vlist) > 1:
+                    agg = vlist[0].tostype("default") \
+                        if isinstance(vlist[0], RowSparseNDArray) \
+                        else vlist[0].copy()
+                    for x in vlist[1:]:
+                        agg += x.as_in_context(agg.context)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             else:
                 # default updater is ASSIGN (reference kvstore docs): the
-                # aggregate replaces the stored value
-                agg.copyto(self._store[k])
+                # aggregate replaces the stored value, cast to its stype
+                dst = self._store[k]
+                if dst.stype != agg.stype:
+                    from .ndarray.sparse import cast_storage
+                    agg = cast_storage(agg, dst.stype)
+                agg.copyto(dst)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
@@ -82,7 +94,11 @@ class KVStore:
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore_local.h:109-247);
-        dense-device TPU path gathers the rows then scatters into out."""
+        dense-device TPU path gathers the rows; a RowSparseNDArray ``out``
+        receives exactly the requested row set."""
+        from .ndarray.sparse import (RowSparseNDArray, retain,
+                                     row_sparse_array)
+        import numpy as np
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for k, o in zip(keys, outs):
@@ -90,6 +106,15 @@ class KVStore:
             olist = o if isinstance(o, (list, tuple)) else [o]
             rlist = rids if len(rids) == len(olist) else rids * len(olist)
             for dst, rid in zip(olist, rlist):
+                if isinstance(dst, RowSparseNDArray):
+                    if isinstance(src, RowSparseNDArray):
+                        retain(src, rid).copyto(dst)
+                    else:
+                        ids = np.unique(rid.asnumpy().astype(np.int64))
+                        rows = nd.take(src, nd.array(ids, dtype="int32"))
+                        row_sparse_array((rows, ids),
+                                         shape=src.shape).copyto(dst)
+                    continue
                 rows = nd.take(src, rid.astype("int32"))
                 full = nd.zeros(src.shape, ctx=dst.context, dtype=src.dtype)
                 idx = rid.astype("int32")

@@ -8,6 +8,9 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       waitall, moveaxis, onehot_encode)
 from . import register as _register
 from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
+                     cast_storage)
 
 _register.populate(globals())
 

@@ -724,27 +724,70 @@ def waitall():
 # Implementation: npz container (TPU build keeps the artifact semantics,
 # SURVEY.md §5.4, not the binary layout).
 # --------------------------------------------------------------------------
+def _save_entries(prefix, a):
+    """Flatten one array into npz entries; sparse arrays (reference
+    ndarray.cc Save handles all three stypes) store their components."""
+    from .sparse import RowSparseNDArray, CSRNDArray
+    if isinstance(a, RowSparseNDArray):
+        return {prefix + "/rsp_data": np.asarray(a._sp_values),
+                prefix + "/rsp_indices": a._sp_indices,
+                prefix + "/rsp_shape": np.asarray(a.shape, np.int64)}
+    if isinstance(a, CSRNDArray):
+        return {prefix + "/csr_data": np.asarray(a._sp_values),
+                prefix + "/csr_indices": a._sp_indices,
+                prefix + "/csr_indptr": a._sp_indptr,
+                prefix + "/csr_shape": np.asarray(a.shape, np.int64)}
+    return {prefix: a.asnumpy()}
+
+
+def _load_entry(z, prefix):
+    from .sparse import RowSparseNDArray, CSRNDArray
+    if prefix + "/rsp_data" in z:
+        return RowSparseNDArray(z[prefix + "/rsp_data"],
+                                z[prefix + "/rsp_indices"],
+                                tuple(z[prefix + "/rsp_shape"]))
+    if prefix + "/csr_data" in z:
+        return CSRNDArray(z[prefix + "/csr_data"],
+                          z[prefix + "/csr_indices"],
+                          z[prefix + "/csr_indptr"],
+                          tuple(z[prefix + "/csr_shape"]))
+    return array(z[prefix])
+
+
 def save(fname: str, data):
+    entries = {}
     if isinstance(data, NDArray):
-        np.savez(_norm(fname), **{"arr:0": data.asnumpy()})
+        entries.update(_save_entries("arr:0", data))
     elif isinstance(data, (list, tuple)):
-        np.savez(_norm(fname),
-                 **{"arr:%d" % i: a.asnumpy() for i, a in enumerate(data)})
+        for i, a in enumerate(data):
+            entries.update(_save_entries("arr:%d" % i, a))
     elif isinstance(data, dict):
-        np.savez(_norm(fname), **{"name:" + k: v.asnumpy()
-                                  for k, v in data.items()})
+        for k, v in data.items():
+            entries.update(_save_entries("name:" + k, v))
     else:
         raise MXNetError("save expects NDArray, list, or dict")
+    np.savez(_norm(fname), **entries)
+
+
+_SPARSE_SUFFIXES = ("/rsp_data", "/rsp_indices", "/rsp_shape",
+                    "/csr_data", "/csr_indices", "/csr_indptr", "/csr_shape")
 
 
 def load(fname: str):
     with np.load(_norm(fname), allow_pickle=False) as z:
-        keys = list(z.keys())
-        if all(k.startswith("arr:") for k in keys):
-            items = sorted(keys, key=lambda k: int(k.split(":")[1]))
-            arrs = [array(z[k]) for k in items]
-            return arrs
-        return {k.split(":", 1)[1]: array(z[k]) for k in keys}
+        prefixes = []
+        for k in z.keys():
+            p = k
+            for suf in _SPARSE_SUFFIXES:
+                if k.endswith(suf):
+                    p = k[:-len(suf)]
+                    break
+            if p not in prefixes:
+                prefixes.append(p)
+        if all(p.startswith("arr:") for p in prefixes):
+            items = sorted(prefixes, key=lambda k: int(k.split(":")[1]))
+            return [_load_entry(z, p) for p in items]
+        return {p.split(":", 1)[1]: _load_entry(z, p) for p in prefixes}
 
 
 def _norm(fname: str) -> str:

@@ -1,0 +1,428 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Reference analog: ``include/mxnet/ndarray.h:61-66`` (``kDefaultStorage /
+kRowSparseStorage / kCSRStorage``), ``python/mxnet/ndarray/sparse.py``
+(1,633 LoC), sparse ops in ``src/operator/tensor/cast_storage-inl.h``,
+``sparse_retain-inl.h``, ``dot-inl.h``.
+
+TPU-native design (SURVEY.md §7.3 "Sparse"): XLA wants static shapes, so
+dynamic-nnz bookkeeping (indices, indptr) lives on the HOST as numpy int64
+arrays while the values ride the device as jax arrays.  Sparse-aware kernels
+(dot, retain, elemwise add, lazy optimizer rows) are expressed as dense
+gathers/scatters/segment-sums over the value block — static-shaped XLA
+programs parameterized by the host-side index sets.  Any op without a
+sparse-aware path falls back to densification, mirroring the reference's
+storage-fallback dispatch (``FInferStorageType`` → ``kFComputeFallback``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "cast_storage", "retain", "dot", "add"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base: values on device, indices on host."""
+
+    # NDArray declares __slots__; these extend the layout.  The parent's
+    # `_data` slot stays unused — `_data` below shadows it with a property
+    # that densifies on demand (the storage-fallback path).
+    __slots__ = ("_sp_values", "_sp_indices", "_sp_indptr", "_sp_shape")
+
+    def __init__(self, values, indices, indptr, shape, ctx=None):
+        ctx = ctx or current_context()
+        # bypass NDArray.__init__ (no dense buffer)
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_leaf = False
+        self._ag_entry = None
+        self._sp_values = jnp.asarray(values)
+        self._sp_indices = np.asarray(indices, dtype=np.int64)
+        self._sp_indptr = None if indptr is None else \
+            np.asarray(indptr, dtype=np.int64)
+        self._sp_shape = tuple(int(s) for s in shape)
+
+    # ---- identity ------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._sp_values.dtype.name)
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def data(self) -> NDArray:
+        """The values array (reference: RowSparseNDArray.data / CSRNDArray.data)."""
+        return NDArray(self._sp_values, self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(jnp.asarray(self._sp_indices), self._ctx)
+
+    # ---- dense fallback ------------------------------------------------
+    @property
+    def _data(self):
+        """Densify (storage-fallback dispatch): any dense-only op touching a
+        sparse array transparently operates on its dense view."""
+        return self._to_dense_jax()
+
+    @_data.setter
+    def _data(self, value):
+        raise MXNetError("in-place dense writes are not supported on %s "
+                         "(stype %r); use tostype('default') first"
+                         % (type(self).__name__, self.stype))
+
+    def _to_dense_jax(self):
+        raise NotImplementedError
+
+    def asnumpy(self):
+        return np.asarray(self._to_dense_jax())
+
+    def wait_to_read(self):
+        self._sp_values.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def _replace(self, values, indices, indptr):
+        if indptr is None:
+            return type(self)(values, indices, self._sp_shape, self._ctx)
+        return type(self)(values, indices, indptr, self._sp_shape, self._ctx)
+
+    def astype(self, dtype):
+        return self._replace(self._sp_values.astype(np.dtype(dtype)),
+                             self._sp_indices, self._sp_indptr)
+
+    def copy(self):
+        return self._replace(jnp.array(self._sp_values),
+                             self._sp_indices.copy(),
+                             None if self._sp_indptr is None
+                             else self._sp_indptr.copy())
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            out = self.copy()
+            out._ctx = other
+            out._sp_values = jax.device_put(out._sp_values, other.jax_device)
+            return out
+        if isinstance(other, BaseSparseNDArray):
+            if other.stype != self.stype:
+                raise MXNetError("copyto: stype mismatch %s vs %s"
+                                 % (self.stype, other.stype))
+            other._sp_values = jnp.asarray(self._sp_values,
+                                           other._sp_values.dtype)
+            other._sp_indices = self._sp_indices.copy()
+            other._sp_indptr = None if self._sp_indptr is None else \
+                self._sp_indptr.copy()
+            other._sp_shape = self._sp_shape
+            return other
+        # sparse → dense
+        dense = self._to_dense_jax()
+        NDArray.__dict__["_data"].__set__(
+            other, jax.device_put(dense, other._ctx.jax_device)
+            .astype(other.dtype))
+        return other
+
+    def as_in_context(self, ctx):
+        return self if ctx == self._ctx else self.copyto(ctx)
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self._to_dense_jax(), self._ctx)
+        return cast_storage(self, stype)
+
+    def __repr__(self):
+        return "<%s %s @%s, %d stored>" % (
+            type(self).__name__, "x".join(map(str, self._sp_shape)),
+            self._ctx, len(self._sp_indices))
+
+    def __setitem__(self, key, value):
+        raise MXNetError("__setitem__ is not supported on sparse NDArrays")
+
+    def attach_grad(self, grad_req="write", stype=None):
+        raise MXNetError("autograd on sparse leaves is not supported; "
+                         "sparse gradients arrive via Embedding/dot "
+                         "sparse_grad paths")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Values for a subset of rows (reference ndarray.h kRowSparseStorage):
+    ``dense[indices[i], ...] = values[i, ...]``, indices sorted unique."""
+
+    def __init__(self, values, indices, shape, ctx=None):
+        super().__init__(values, indices, None, shape, ctx)
+        if self._sp_values.ndim != len(self._sp_shape):
+            # values must be (nnz,) + shape[1:]
+            raise MXNetError("row_sparse values ndim %d != %d"
+                             % (self._sp_values.ndim, len(self._sp_shape)))
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def _to_dense_jax(self):
+        out = jnp.zeros(self._sp_shape, self._sp_values.dtype)
+        if len(self._sp_indices) == 0:
+            return out
+        return out.at[jnp.asarray(self._sp_indices)].set(self._sp_values)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def check_format(self, full_check=True):
+        idx = self._sp_indices
+        if len(idx) and (np.any(np.diff(idx) <= 0) or idx[0] < 0 or
+                         idx[-1] >= self._sp_shape[0]):
+            raise MXNetError("row_sparse indices must be sorted unique and "
+                             "in range (ref: NDArray::CheckFormat)")
+        if self._sp_values.shape[0] != len(idx):
+            raise MXNetError("values/indices length mismatch")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed sparse row (reference ndarray.h kCSRStorage)."""
+
+    def __init__(self, values, indices, indptr, shape, ctx=None):
+        super().__init__(values, indices, indptr, shape, ctx)
+        if len(self._sp_shape) != 2:
+            raise MXNetError("csr arrays are 2-D")
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(jnp.asarray(self._sp_indptr), self._ctx)
+
+    def _row_ids(self):
+        """Per-nnz row id from indptr (host, static per array)."""
+        counts = np.diff(self._sp_indptr)
+        return np.repeat(np.arange(self._sp_shape[0]), counts)
+
+    def _to_dense_jax(self):
+        out = jnp.zeros(self._sp_shape, self._sp_values.dtype)
+        if len(self._sp_indices) == 0:
+            return out
+        rows = jnp.asarray(self._row_ids())
+        cols = jnp.asarray(self._sp_indices)
+        return out.at[rows, cols].set(self._sp_values)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sp_shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing supports step 1 only")
+            lo, hi = self._sp_indptr[start], self._sp_indptr[stop]
+            return CSRNDArray(self._sp_values[int(lo):int(hi)],
+                              self._sp_indices[lo:hi],
+                              self._sp_indptr[start:stop + 1] - lo,
+                              (stop - start, self._sp_shape[1]), self._ctx)
+        raise MXNetError("csr indexing supports row slices only")
+
+    def check_format(self, full_check=True):
+        if len(self._sp_indptr) != self._sp_shape[0] + 1:
+            raise MXNetError("indptr length must be rows+1")
+        if np.any(np.diff(self._sp_indptr) < 0):
+            raise MXNetError("indptr must be non-decreasing")
+        if len(self._sp_indices) and (self._sp_indices.min() < 0 or
+                                      self._sp_indices.max() >=
+                                      self._sp_shape[1]):
+            raise MXNetError("csr column indices out of range")
+
+
+# --------------------------------------------------------------------------
+# constructors (parity: python/mxnet/ndarray/sparse.py csr_matrix /
+# row_sparse_array / zeros / empty / array)
+# --------------------------------------------------------------------------
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    dtype = np.dtype(dtype or np.float32)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _asnp(data).astype(dtype)
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs shape")
+        return CSRNDArray(data, _asnp(indices), _asnp(indptr), shape, ctx)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        return CSRNDArray(np.zeros((0,), dtype), np.zeros((0,), np.int64),
+                          np.zeros(arg1[0] + 1, np.int64), arg1, ctx)
+    dense = _asnp(arg1)
+    return cast_storage(_dense_array(dense.astype(dtype), ctx), "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    dtype = np.dtype(dtype or np.float32)
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not \
+            isinstance(arg1[0], int):
+        data, indices = arg1
+        data = _asnp(data).astype(dtype)
+        indices = _asnp(indices)
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        order = np.argsort(indices)
+        return RowSparseNDArray(data[order], indices[order], shape, ctx)
+    if isinstance(arg1, tuple):  # shape tuple
+        return RowSparseNDArray(
+            np.zeros((0,) + tuple(arg1[1:]), dtype),
+            np.zeros((0,), np.int64), arg1, ctx)
+    dense = _asnp(arg1)
+    return cast_storage(_dense_array(dense.astype(dtype), ctx), "row_sparse")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = np.dtype(dtype or np.float32)
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:]), dtype),
+                                np.zeros((0,), np.int64), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(np.zeros((0,), dtype), np.zeros((0,), np.int64),
+                          np.zeros(shape[0] + 1, np.int64), shape, ctx)
+    if stype == "default":
+        from .ndarray import zeros as _dz
+        return _dz(shape, ctx, dtype)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def array(source, ctx=None, dtype=None):
+    """mx.nd.sparse.array: build from scipy sparse / sparse NDArray."""
+    if isinstance(source, BaseSparseNDArray):
+        out = source.copy()
+        if dtype is not None:
+            out = out.astype(dtype)
+        if ctx is not None:
+            out = out.as_in_context(ctx)
+        return out
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(source):
+            csr = source.tocsr()
+            return CSRNDArray(csr.data.astype(dtype or csr.dtype),
+                              csr.indices, csr.indptr, csr.shape, ctx)
+    except ImportError:
+        pass
+    raise MXNetError("sparse.array expects a sparse NDArray or scipy matrix")
+
+
+def _asnp(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+# --------------------------------------------------------------------------
+# sparse ops (reference: cast_storage, sparse_retain, dot FComputeEx)
+# --------------------------------------------------------------------------
+def cast_storage(arr, stype: str):
+    """Convert between storage types (ref: tensor/cast_storage-inl.h).
+    nnz discovery is host-side (dynamic shape); values stay device arrays."""
+    if arr.stype == stype:
+        return arr
+    if stype == "default":
+        return arr.tostype("default")
+    dense = arr.asnumpy()
+    if stype == "row_sparse":
+        if dense.ndim < 1:
+            raise MXNetError("row_sparse needs ndim >= 1")
+        nz = np.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(dense[nz]), nz, dense.shape,
+                                getattr(arr, "_ctx", None))
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr needs 2-D")
+        rows, cols = np.nonzero(dense)
+        indptr = np.zeros(dense.shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        return CSRNDArray(jnp.asarray(dense[rows, cols]), cols, indptr,
+                          dense.shape, getattr(arr, "_ctx", None))
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def retain(rsp: RowSparseNDArray, row_ids):
+    """Keep only rows whose index appears in row_ids
+    (ref: tensor/sparse_retain-inl.h)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    ids = np.unique(_asnp(row_ids).astype(np.int64))
+    mask = np.isin(rsp._sp_indices, ids)
+    keep = np.where(mask)[0]
+    return RowSparseNDArray(rsp._sp_values[jnp.asarray(keep)] if len(keep)
+                            else np.zeros((0,) + rsp.shape[1:],
+                                          rsp.dtype),
+                            rsp._sp_indices[keep], rsp.shape, rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: tensor/dot-inl.h FComputeEx):
+    csr · dense, csrᵀ · dense (returns dense), dense paths fall through."""
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+        rows = jnp.asarray(lhs._row_ids())
+        cols = jnp.asarray(lhs._sp_indices)
+        vals = lhs._sp_values
+        B = rhs._data
+        if not transpose_a:
+            # out[i] = Σ_nnz(i) v * B[col]   — segment-sum over row ids
+            contrib = vals[:, None] * B[cols]
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs.shape[0])
+        else:
+            # out[j] = Σ v_ij * B[i]  — scatter-add over column ids
+            contrib = vals[:, None] * B[rows]
+            out = jnp.zeros((lhs.shape[1], B.shape[1]), contrib.dtype) \
+                .at[cols].add(contrib)
+        return NDArray(out, rhs._ctx)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        # fallback: densify (reference logs a storage-fallback warning)
+        from .ndarray import invoke
+        return invoke("dot", [NDArray(lhs._data, getattr(lhs, "_ctx", None)),
+                              NDArray(rhs._data, getattr(rhs, "_ctx", None))],
+                      {"transpose_a": transpose_a,
+                       "transpose_b": transpose_b})
+    from .ndarray import invoke
+    return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,
+                                      "transpose_b": transpose_b})
+
+
+def add(lhs: RowSparseNDArray, rhs: RowSparseNDArray) -> RowSparseNDArray:
+    """row_sparse + row_sparse → row_sparse (union of rows, device add)."""
+    if not (isinstance(lhs, RowSparseNDArray) and
+            isinstance(rhs, RowSparseNDArray)):
+        raise MXNetError("sparse.add expects two RowSparseNDArrays")
+    if lhs.shape != rhs.shape:
+        raise MXNetError("shape mismatch %s vs %s" % (lhs.shape, rhs.shape))
+    union = np.union1d(lhs._sp_indices, rhs._sp_indices)
+    n = len(union)
+    out = jnp.zeros((n,) + lhs.shape[1:], lhs._sp_values.dtype)
+    if len(lhs._sp_indices):
+        li = jnp.asarray(np.searchsorted(union, lhs._sp_indices))
+        out = out.at[li].add(lhs._sp_values)
+    if len(rhs._sp_indices):
+        ri = jnp.asarray(np.searchsorted(union, rhs._sp_indices))
+        out = out.at[ri].add(rhs._sp_values)
+    return RowSparseNDArray(out, union, lhs.shape, lhs._ctx)

@@ -130,6 +130,63 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    def _update_rows(self, index, weight, grad, state):
+        """Lazy update for a row_sparse gradient (reference: the sparse
+        FComputeEx optimizer kernels, src/operator/optimizer_op.cc — only
+        rows present in ``grad.indices`` are touched): slice the occupied
+        rows, run this optimizer's *dense* update on the row block (one XLA
+        gather → fused update → scatter), write the rows back."""
+        import numpy as _np
+        import jax.numpy as jnp
+        from .ndarray.sparse import RowSparseNDArray
+        idx = grad._sp_indices
+        if len(idx) == 0:
+            self._update_count(index)
+            return
+        sparse_weight = isinstance(weight, RowSparseNDArray)
+        if sparse_weight:
+            # map grad rows to positions inside the weight's value block;
+            # every grad row must be present (reference requires the weight's
+            # occupancy to cover pushed rows — kvstore pulls them first)
+            pos = _np.searchsorted(weight._sp_indices, idx)
+            if (pos >= len(weight._sp_indices)).any() or \
+                    (weight._sp_indices[_np.minimum(
+                        pos, len(weight._sp_indices) - 1)] != idx).any():
+                raise MXNetError("row_sparse weight is missing rows present "
+                                 "in the gradient; row_sparse_pull them "
+                                 "first")
+            jidx_w = jnp.asarray(pos)
+            w_block = weight._sp_values
+        else:
+            jidx_w = jnp.asarray(idx)
+            w_block = weight._data
+        # states are dense full-shape arrays indexed by row id
+        jidx = jnp.asarray(idx)
+
+        def rows(a):
+            return NDArray(a._data[jidx], a.context) \
+                if isinstance(a, NDArray) else a
+
+        w_rows = NDArray(w_block[jidx_w], weight.context)
+        g_rows = NDArray(grad._sp_values.astype(weight.dtype), weight.context)
+        s_rows = tuple(rows(s) for s in state) if isinstance(state, tuple) \
+            else rows(state)
+        self.update(index, w_rows, g_rows, s_rows)
+        if sparse_weight:
+            weight._sp_values = weight._sp_values.at[jidx_w].set(w_rows._data)
+        else:
+            weight._data = weight._data.at[jidx_w].set(w_rows._data)
+        states = state if isinstance(state, tuple) else (state,)
+        srows = s_rows if isinstance(s_rows, tuple) else (s_rows,)
+        for s, sr in zip(states, srows):
+            if isinstance(s, NDArray):
+                s._data = s._data.at[jidx].set(sr._data)
+
+    @staticmethod
+    def _is_row_sparse(grad):
+        from .ndarray.sparse import RowSparseNDArray
+        return isinstance(grad, RowSparseNDArray)
+
 
 @register
 class SGD(Optimizer):
@@ -146,6 +203,8 @@ class SGD(Optimizer):
         return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        if self._is_row_sparse(grad):
+            return self._update_rows(index, weight, grad, state)
         self._update_count(index)
         kw = self._common_kwargs(index)
         if state is not None and isinstance(state, tuple):
@@ -222,6 +281,8 @@ class Adam(Optimizer):
                 nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        if self._is_row_sparse(grad):
+            return self._update_rows(index, weight, grad, state)
         self._update_count(index)
         t = self._index_update_count[index]
         kw = self._common_kwargs(index)
@@ -300,6 +361,8 @@ class Ftrl(Optimizer):
                 nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        if self._is_row_sparse(grad):
+            return self._update_rows(index, weight, grad, state)
         self._update_count(index)
         kw = self._common_kwargs(index)
         z, n = state
@@ -317,6 +380,8 @@ class AdaGrad(Optimizer):
         return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        if self._is_row_sparse(grad):
+            return self._update_rows(index, weight, grad, state)
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         g = grad * self.rescale_grad

@@ -1,0 +1,241 @@
+"""Sparse NDArray + ops tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py and test_sparse_operator.py)."""
+import os
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_creation_and_dense():
+    data = np.array([[1., 2.], [3., 4.]], np.float32)
+    a = sparse.row_sparse_array((data, [1, 3]), shape=(5, 2))
+    assert a.stype == "row_sparse"
+    assert a.shape == (5, 2)
+    dense = a.asnumpy()
+    ref = np.zeros((5, 2), np.float32)
+    ref[1], ref[3] = data[0], data[1]
+    np.testing.assert_array_equal(dense, ref)
+    assert np.array_equal(a.indices.asnumpy(), [1, 3])
+    np.testing.assert_array_equal(a.data.asnumpy(), data)
+    a.check_format()
+
+
+def test_csr_creation_and_dense():
+    # [[0, 1, 0], [2, 0, 3]]
+    a = sparse.csr_matrix((np.array([1., 2., 3.], np.float32),
+                           np.array([1, 0, 2]), np.array([0, 1, 3])),
+                          shape=(2, 3))
+    assert a.stype == "csr"
+    np.testing.assert_array_equal(a.asnumpy(), [[0, 1, 0], [2, 0, 3]])
+    a.check_format()
+    sl = a[1:2]
+    np.testing.assert_array_equal(sl.asnumpy(), [[2, 0, 3]])
+
+
+def test_cast_storage_roundtrip():
+    rng = np.random.RandomState(0)
+    dense = rng.randn(6, 4).astype(np.float32)
+    dense[[0, 2, 5]] = 0
+    x = nd.array(dense)
+    rsp = nd.cast_storage(x, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert np.array_equal(rsp.indices.asnumpy(), [1, 3, 4])
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+
+    dense2 = np.where(rng.rand(5, 7) > 0.7, rng.randn(5, 7), 0).astype(np.float32)
+    csr = nd.cast_storage(nd.array(dense2), "csr")
+    np.testing.assert_array_equal(csr.asnumpy(), dense2)
+    rsp2 = sparse.cast_storage(csr, "row_sparse")
+    np.testing.assert_array_equal(rsp2.asnumpy(), dense2)
+
+
+def test_sparse_retain():
+    data = np.arange(8, dtype=np.float32).reshape(4, 2)
+    a = sparse.row_sparse_array((data, [0, 2, 5, 7]), shape=(9, 2))
+    r = sparse.retain(a, [2, 3, 7])
+    assert np.array_equal(r.indices.asnumpy(), [2, 7])
+    np.testing.assert_array_equal(r.data.asnumpy(), data[[1, 3]])
+    np.testing.assert_array_equal(r.asnumpy()[2], data[1])
+    assert r.asnumpy()[5].sum() == 0
+
+
+def test_csr_dot():
+    rng = np.random.RandomState(1)
+    dense = np.where(rng.rand(5, 6) > 0.6, rng.randn(5, 6), 0).astype(np.float32)
+    B = rng.randn(6, 3).astype(np.float32)
+    csr = sparse.csr_matrix(dense)
+    out = sparse.dot(csr, nd.array(B))
+    np.testing.assert_allclose(out.asnumpy(), dense @ B, rtol=1e-5, atol=1e-5)
+    # transpose_a: (6,5)·? no — dot(csr.T, B2) with B2 (5,3)
+    B2 = rng.randn(5, 3).astype(np.float32)
+    outT = sparse.dot(csr, nd.array(B2), transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(), dense.T @ B2,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_add():
+    a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [1, 4]),
+                                shape=(6, 3))
+    b = sparse.row_sparse_array((2 * np.ones((2, 3), np.float32), [1, 2]),
+                                shape=(6, 3))
+    c = sparse.add(a, b)
+    assert np.array_equal(c.indices.asnumpy(), [1, 2, 4])
+    ref = a.asnumpy() + b.asnumpy()
+    np.testing.assert_array_equal(c.asnumpy(), ref)
+
+
+def test_dense_fallback_ops():
+    """Ops without a sparse path densify (reference storage fallback)."""
+    a = sparse.row_sparse_array((np.ones((1, 3), np.float32), [1]),
+                                shape=(3, 3))
+    out = a + nd.ones((3, 3))
+    assert out.stype == "default"
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  a.asnumpy() + np.ones((3, 3), np.float32))
+
+
+def test_sparse_sgd_lazy_update():
+    from mxnet_tpu import optimizer as opt
+    w = nd.ones((6, 2))
+    g = sparse.row_sparse_array((np.ones((2, 2), np.float32), [1, 4]),
+                                shape=(6, 2))
+    sgd = opt.SGD(learning_rate=0.5, momentum=0.9, wd=0.0, rescale_grad=1.0)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)
+    out = w.asnumpy()
+    # touched rows: w -= lr*g = 1 - 0.5 = 0.5; untouched rows unchanged
+    np.testing.assert_allclose(out[[1, 4]], 0.5)
+    np.testing.assert_allclose(out[[0, 2, 3, 5]], 1.0)
+    # momentum state only touched on those rows
+    np.testing.assert_allclose(state.asnumpy()[[1, 4]], -0.5)
+    np.testing.assert_allclose(state.asnumpy()[[0, 2, 3, 5]], 0.0)
+    # second update accumulates momentum on touched rows
+    sgd.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy()[[1, 4]], 0.5 - 0.95, rtol=1e-6)
+
+
+def test_sparse_adam_and_adagrad():
+    from mxnet_tpu import optimizer as opt
+    for make in (lambda: opt.Adam(learning_rate=0.1),
+                 lambda: opt.AdaGrad(learning_rate=0.1),
+                 lambda: opt.Ftrl(learning_rate=0.1)):
+        w = nd.ones((5, 3))
+        g = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 3]),
+                                    shape=(5, 3))
+        o = make()
+        st = o.create_state(0, w)
+        o.update(0, w, g, st)
+        out = w.asnumpy()
+        assert not np.allclose(out[[0, 3]], 1.0)      # touched
+        np.testing.assert_allclose(out[[1, 2, 4]], 1.0)  # untouched
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    kv.init("w", w)
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array([1, 4]))
+    assert np.array_equal(out.indices.asnumpy(), [1, 4])
+    np.testing.assert_array_equal(out.data.asnumpy(),
+                                  w.asnumpy()[[1, 4]])
+
+
+def test_kvstore_sparse_push():
+    kv = mx.kv.create("local")
+    kv.init("e", nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                 shape=(6, 2))
+    g2 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                 shape=(6, 2))
+    kv.push("e", [g1, g2])
+    out = nd.zeros((6, 2))
+    kv.pull("e", out=out)
+    np.testing.assert_allclose(out.asnumpy()[2], 2.0)
+    assert out.asnumpy()[[0, 1, 3, 4, 5]].sum() == 0
+
+
+def test_sparse_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    rsp = sparse.row_sparse_array((np.ones((2, 3), np.float32), [1, 5]),
+                                  shape=(7, 3))
+    csr = sparse.csr_matrix(np.array([[0, 1.], [2, 0]], np.float32))
+    dense = nd.ones((2, 2))
+    nd.save(f, {"rsp": rsp, "csr": csr, "dense": dense})
+    loaded = nd.load(f)
+    assert set(loaded) == {"rsp", "csr", "dense"}
+    assert loaded["rsp"].stype == "row_sparse"
+    assert loaded["csr"].stype == "csr"
+    np.testing.assert_array_equal(loaded["rsp"].asnumpy(), rsp.asnumpy())
+    np.testing.assert_array_equal(loaded["csr"].asnumpy(), csr.asnumpy())
+    np.testing.assert_array_equal(loaded["dense"].asnumpy(), dense.asnumpy())
+    # list form
+    nd.save(f, [rsp, dense])
+    l2 = nd.load(f)
+    assert l2[0].stype == "row_sparse" and l2[1].stype == "default"
+
+
+def test_sparse_guards():
+    a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [0]),
+                                shape=(3, 2))
+    with pytest.raises(mx.base.MXNetError):
+        a[0] = 1.0
+    with pytest.raises(mx.base.MXNetError):
+        a.attach_grad()
+    bad = sparse.row_sparse_array((np.ones((2, 2), np.float32), [3, 1]),
+                                  shape=(4, 2))
+    # constructor sorts, so this is fine
+    bad.check_format()
+    with pytest.raises(mx.base.MXNetError):
+        sparse.csr_matrix((np.ones(2, np.float32), [0, 1], [0, 1, 2]),
+                          shape=(3, 5)).check_format()  # indptr len != rows+1
+
+
+def test_sparse_weight_lazy_update():
+    """Row-sparse WEIGHT training (code-review regression): grad rows update
+    the weight's value block in place."""
+    from mxnet_tpu import optimizer as opt
+    w = sparse.row_sparse_array((np.ones((3, 2), np.float32), [0, 2, 4]),
+                                shape=(6, 2))
+    g = sparse.row_sparse_array((np.ones((2, 2), np.float32), [2, 4]),
+                                shape=(6, 2))
+    sgd = opt.SGD(learning_rate=0.5)
+    sgd.update(0, w, g, None)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[[2, 4]], 0.5)
+    np.testing.assert_allclose(out[0], 1.0)
+    with pytest.raises(mx.base.MXNetError):
+        bad_g = sparse.row_sparse_array((np.ones((1, 2), np.float32), [5]),
+                                        shape=(6, 2))
+        sgd.update(0, w, bad_g, None)   # row 5 missing from weight
+
+
+def test_save_load_slash_names(tmp_path):
+    """'/'-containing param names survive save/load (regression)."""
+    f = str(tmp_path / "slash")
+    nd.save(f, {"fc1/weight": nd.ones((2, 2)), "fc1/bias": nd.zeros((2,))})
+    loaded = nd.load(f)
+    assert set(loaded) == {"fc1/weight", "fc1/bias"}
+    np.testing.assert_array_equal(loaded["fc1/weight"].asnumpy(),
+                                  np.ones((2, 2)))
+
+
+def test_kvstore_dense_push_to_sparse_store():
+    """Dense aggregate assigned to a row_sparse store casts stype
+    (regression)."""
+    kv = mx.kv.create("local")
+    init_val = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 1]),
+                                       shape=(4, 3))
+    kv.init("w", init_val)
+    dense_g = nd.array(np.array([[0, 0, 0], [1, 1, 1],
+                                 [0, 0, 0], [2, 2, 2]], np.float32))
+    kv.push("w", dense_g)
+    out = nd.zeros((4, 3))
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), dense_g.asnumpy())
