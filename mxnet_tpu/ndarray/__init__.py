@@ -9,6 +9,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
 from . import register as _register
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import contrib  # noqa: F401
 from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
                      cast_storage)
 

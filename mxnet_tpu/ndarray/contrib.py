@@ -1,0 +1,22 @@
+"""``nd.contrib`` namespace: experimental/contrib operators.
+
+Parity target: ``python/mxnet/ndarray/contrib.py`` (generated from the
+``_contrib_`` op prefix, reference ndarray/register.py:142 convention).
+"""
+from __future__ import annotations
+
+from ..ops.registry import OPS
+from .register import _make_fn
+
+_PREFIX = "_contrib_"
+
+
+def populate(module_dict):
+    for name in list(OPS):
+        if name.startswith(_PREFIX):
+            short = name[len(_PREFIX):]
+            if short not in module_dict:
+                module_dict[short] = _make_fn(name, display_name=short)
+
+
+populate(globals())

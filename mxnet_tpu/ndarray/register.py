@@ -13,11 +13,11 @@ from ..ops.registry import OPS
 from .ndarray import imperative_invoke
 
 
-def _make_fn(op_name):
+def _make_fn(op_name, display_name=None):
     def fn(*args, **kwargs):
         return imperative_invoke(op_name, *args, **kwargs)
-    fn.__name__ = op_name
-    fn.__qualname__ = op_name
+    fn.__name__ = display_name or op_name
+    fn.__qualname__ = fn.__name__
     fn.__doc__ = OPS[op_name].doc
     return fn
 

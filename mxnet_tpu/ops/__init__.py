@@ -17,3 +17,5 @@ from . import init_random   # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import shape_hints   # noqa: F401  (installs arg names + infer hints)
 from . import vision_fork   # noqa: F401  (yangyu12 fork custom vision ops)
+from . import contrib_det   # noqa: F401  (SSD/RCNN detection contrib ops)
+from . import contrib_misc  # noqa: F401  (CTC/FFT/resize/… contrib ops)

@@ -1,0 +1,855 @@
+"""Detection contrib ops: multibox SSD trio, bounding-box ops, RCNN family.
+
+Reference analogs (`src/operator/contrib/`, SURVEY.md N7 contrib/):
+
+- ``_contrib_MultiBoxPrior`` — multibox_prior.cc:31-72 (anchor layout: per
+  pixel, ``num_sizes`` anchors at ratio 1 then ``num_ratios-1`` at size[0]).
+- ``_contrib_MultiBoxTarget`` — multibox_target.cc:80-280 (bipartite match,
+  threshold match, negative mining, variance-encoded loc targets).
+- ``_contrib_MultiBoxDetection`` — multibox_detection.cc:44-170 (decode +
+  per-class greedy NMS, output rows ``[id, score, xmin, ymin, xmax, ymax]``).
+- ``_contrib_box_nms`` / ``_contrib_box_iou`` / ``_contrib_bipartite_matching``
+  — bounding_box-inl.h:55-90,560-700.
+- ``_contrib_Proposal`` / ``_contrib_MultiProposal`` — proposal-inl.h:60-90,
+  multi_proposal-inl.h (RPN proposal generation + NMS).
+- ``ROIPooling`` — roi_pooling-inl.h:50-60; ``_contrib_ROIAlign`` —
+  roi_align-inl.h:50-60; ``_contrib_PSROIPooling`` — psroi_pooling-inl.h:55-65;
+  ``_contrib_DeformableConvolution`` — deformable_convolution-inl.h:70-90;
+  ``_contrib_DeformablePSROIPooling`` — deformable_psroi_pooling-inl.h:60-74.
+
+TPU-native design: every data-dependent-size loop of the reference (greedy
+NMS, bipartite matching, per-roi bin loops) is re-expressed as fixed-shape
+masked tensor programs — sorts + ``lax.fori_loop`` with vectorized suppression
+for NMS (padded outputs with -1 rows, the convention the reference already
+uses), one-hot/gather bilinear sampling for the ROI/deformable family so the
+inner products ride the MXU, and ``vmap`` over batch/roi instead of host
+loops.  Gradients (where defined: ROI/deformable/resize ops) come from
+``jax.vjp`` of these definitions; detection-target ops are non-differentiable
+(reference writes zero gradients) and are marked ``stop_gradient``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, param
+
+BIG_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# shared geometry helpers
+# ---------------------------------------------------------------------------
+def _corner_iou(a, b):
+    """IoU of corner-format boxes. a: (..., A, 4), b: (..., B, 4) ->
+    (..., A, B)."""
+    al, at, ar, ab = jnp.split(a[..., :, None, :], 4, axis=-1)
+    bl, bt, br, bb = jnp.split(b[..., None, :, :], 4, axis=-1)
+    iw = jnp.maximum(0.0, jnp.minimum(ar, br) - jnp.maximum(al, bl))
+    ih = jnp.maximum(0.0, jnp.minimum(ab, bb) - jnp.maximum(at, bt))
+    inter = (iw * ih)[..., 0]
+    area_a = ((ar - al) * (ab - at))[..., 0]
+    area_b = ((br - bl) * (bb - bt))[..., 0]
+    union = area_a + area_b - inter
+    return jnp.where(union <= 0, 0.0, inter / union)
+
+
+def _center_to_corner(box):
+    x, y, w, h = jnp.split(box, 4, axis=-1)
+    return jnp.concatenate(
+        [x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _corner_to_center(box):
+    l, t, r, b = jnp.split(box, 4, axis=-1)
+    return jnp.concatenate(
+        [(l + r) / 2, (t + b) / 2, r - l, b - t], axis=-1)
+
+
+def _greedy_nms_keep(boxes, order, valid, classes, thresh, force_suppress):
+    """Greedy NMS over boxes visited in ``order`` (descending score).
+
+    boxes: (A, 4) corner format; order: (A,) permutation; valid: (A,) bool
+    (in sorted order); classes: (A,) in sorted order (or None).
+    Returns keep flags (A,) aligned with the sorted order.
+
+    The reference's O(n²) greedy loop (multibox_detection.cc:170-210,
+    bounding_box-inl.h NMS kernels) becomes a ``fori_loop`` of A steps, each
+    doing one vectorized suppression row — the standard TPU-friendly NMS.
+    """
+    sboxes = boxes[order]
+    iou = _corner_iou(sboxes, sboxes)  # (A, A) in sorted order
+    if classes is not None and not force_suppress:
+        same = classes[:, None] == classes[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    n = sboxes.shape[0]
+
+    def body(i, keep):
+        k_i = keep[i]
+        sup = (iou[i] > thresh) & (jnp.arange(n) > i) & k_i
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, valid)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# MultiBox SSD trio
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", nin=1, aliases=("MultiBoxPrior",),
+          params={"sizes": param("floats", (1.0,)),
+                  "ratios": param("floats", (1.0,)),
+                  "clip": param(bool, False),
+                  "steps": param("floats", (-1.0, -1.0)),
+                  "offsets": param("floats", (0.5, 0.5))})
+def _multibox_prior(attrs, data):
+    """Anchor generation (multibox_prior.cc:31-72).  Output (1, H*W*A, 4)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes, ratios = attrs["sizes"], attrs["ratios"]
+    step_y, step_x = attrs["steps"]
+    if step_y <= 0 or step_x <= 0:
+        step_y, step_x = 1.0 / h, 1.0 / w
+    off_y, off_x = attrs["offsets"]
+    cy = (np.arange(h) + off_y) * step_y
+    cx = (np.arange(w) + off_x) * step_x
+    # anchor wh list: sizes at ratio 1 (w scaled by H/W), then ratios[1:]
+    whs = [(s * h / w / 2.0, s / 2.0) for s in sizes]
+    whs += [(sizes[0] * h / w * np.sqrt(r) / 2.0, sizes[0] / np.sqrt(r) / 2.0)
+            for r in ratios[1:]]
+    whs = np.asarray(whs, np.float32)  # (A, 2)
+    cyx = np.stack(np.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
+    centers = np.broadcast_to(cyx[:, :, None, :], (h, w, len(whs), 2))
+    half = np.broadcast_to(whs[None, None, :, :], (h, w, len(whs), 2))
+    out = np.concatenate([
+        centers[..., 1:2] - half[..., 0:1], centers[..., 0:1] - half[..., 1:2],
+        centers[..., 1:2] + half[..., 0:1], centers[..., 0:1] + half[..., 1:2],
+    ], axis=-1).reshape(1, -1, 4)
+    anchors = jnp.asarray(out, dtype=data.dtype)
+    if attrs["clip"]:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return lax.stop_gradient(anchors)
+
+
+def _encode_loc(anchor, gt, variances):
+    """Variance-encoded box regression target (multibox_target.cc:34-56)."""
+    vx, vy, vw, vh = variances
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) * 0.5
+    ay = (anchor[..., 1] + anchor[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    safe = lambda x: jnp.where(x == 0, 1.0, x)
+    return jnp.stack([
+        (gx - ax) / safe(aw) / vx,
+        (gy - ay) / safe(ah) / vy,  # reference divides y-offset by ah
+        jnp.log(jnp.maximum(gw, 1e-12) / safe(aw)) / vw,
+        jnp.log(jnp.maximum(gh, 1e-12) / safe(ah)) / vh,
+    ], axis=-1)
+
+
+@register("_contrib_MultiBoxTarget", nin=3, nout=3,
+          aliases=("MultiBoxTarget",),
+          params={"overlap_threshold": param(float, 0.5),
+                  "ignore_label": param(float, -1.0),
+                  "negative_mining_ratio": param(float, -1.0),
+                  "negative_mining_thresh": param(float, 0.5),
+                  "minimum_negative_samples": param(int, 0),
+                  "variances": param("floats", (0.1, 0.1, 0.2, 0.2))})
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """SSD training-target assignment (multibox_target.cc:80-280).
+
+    anchor (1, A, 4); label (N, L, >=5) rows [cls, xmin, ymin, xmax, ymax],
+    padded with -1; cls_pred (N, num_cls, A).  Outputs: loc_target (N, 4A),
+    loc_mask (N, 4A), cls_target (N, A).
+    """
+    ov_thresh = attrs["overlap_threshold"]
+    ignore = attrs["ignore_label"]
+    mine_ratio = attrs["negative_mining_ratio"]
+    mine_thresh = attrs["negative_mining_thresh"]
+    min_neg = attrs["minimum_negative_samples"]
+    variances = attrs["variances"]
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    def one(labels, cls_preds):
+        L = labels.shape[0]
+        valid_gt = labels[:, 0] > -0.5
+        gt_boxes = labels[:, 1:5]
+        ious = _corner_iou(anchors, gt_boxes)          # (A, L)
+        ious = jnp.where(valid_gt[None, :], ious, -1.0)
+
+        # --- stage 1: bipartite matching (multibox_target.cc:112-148) ---
+        def bip_body(_, st):
+            flag, mgt, miou, gt_done = st
+            m = jnp.where((flag == 1)[:, None] | gt_done[None, :],
+                          BIG_NEG, ious)
+            idx = jnp.argmax(m)
+            a_i, g_i = idx // L, idx % L
+            good = m[a_i, g_i] > 1e-6
+            flag = jnp.where(good, flag.at[a_i].set(1), flag)
+            mgt = jnp.where(good, mgt.at[a_i].set(g_i), mgt)
+            miou = jnp.where(good, miou.at[a_i].set(m[a_i, g_i]), miou)
+            gt_done = jnp.where(good, gt_done.at[g_i].set(True), gt_done)
+            return flag, mgt, miou, gt_done
+
+        flag0 = jnp.full((A,), -1, jnp.int32)
+        st = (flag0, jnp.zeros((A,), jnp.int32), jnp.full((A,), -1.0),
+              ~valid_gt)
+        flag, mgt, miou, _ = lax.fori_loop(0, L, bip_body, st)
+
+        # --- stage 2: threshold matching (multibox_target.cc:151-180) ---
+        best_gt = jnp.argmax(ious, axis=1)
+        best_iou = jnp.max(ious, axis=1)
+        unmatched = flag != 1
+        if ov_thresh > 0:
+            pos2 = unmatched & (best_iou > ov_thresh)
+            flag = jnp.where(pos2, 1, flag)
+            mgt = jnp.where(pos2, best_gt, mgt)
+        cand_iou = jnp.where(unmatched, best_iou, miou)
+
+        num_pos = jnp.sum(flag == 1)
+        if mine_ratio > 0:
+            # --- negative mining (multibox_target.cc:182-240) ---
+            num_neg = jnp.minimum((num_pos * mine_ratio).astype(jnp.int32),
+                                  A - num_pos)
+            num_neg = jnp.maximum(num_neg, min_neg)
+            prob_bg = jax.nn.softmax(cls_preds, axis=0)[0]      # (A,)
+            cand = (flag == -1) & (cand_iou < mine_thresh)
+            key = jnp.where(cand, -prob_bg, BIG_NEG)            # hardest first
+            rank = jnp.argsort(jnp.argsort(-key))
+            flag = jnp.where(cand & (rank < num_neg), 0, flag)
+        else:
+            flag = jnp.where(flag != 1, 0, flag)
+
+        has_gt = jnp.any(valid_gt)
+        pos = (flag == 1) & has_gt
+        neg = (flag == 0) & has_gt
+        cls_t = jnp.where(pos, labels[mgt, 0] + 1.0,
+                          jnp.where(neg, 0.0, ignore))
+        loc_t = _encode_loc(anchors, gt_boxes[mgt], variances)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+        loc_m = jnp.broadcast_to(pos[:, None], (A, 4)).astype(anchors.dtype)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return (lax.stop_gradient(loc_t.astype(anchor.dtype)),
+            lax.stop_gradient(loc_m.astype(anchor.dtype)),
+            lax.stop_gradient(cls_t.astype(anchor.dtype)))
+
+
+def _decode_loc(anchors, loc, variances, clip):
+    """Inverse of _encode_loc (multibox_detection.cc:46-76)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    ox = loc[:, 0] * vx * aw + ax
+    oy = loc[:, 1] * vy * ah + ay
+    ow = jnp.exp(loc[:, 2] * vw) * aw / 2
+    oh = jnp.exp(loc[:, 3] * vh) * ah / 2
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], -1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("_contrib_MultiBoxDetection", nin=3,
+          aliases=("MultiBoxDetection",),
+          params={"clip": param(bool, True),
+                  "threshold": param(float, 0.01),
+                  "background_id": param(int, 0),
+                  "nms_threshold": param(float, 0.5),
+                  "force_suppress": param(bool, False),
+                  "variances": param("floats", (0.1, 0.1, 0.2, 0.2)),
+                  "nms_topk": param(int, -1)})
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """SSD decode + NMS (multibox_detection.cc:80-210).
+
+    cls_prob (N, C, A), loc_pred (N, 4A), anchor (1, A, 4) ->
+    (N, A, 6) rows [id, score, xmin, ymin, xmax, ymax], -1-padded.
+    """
+    thresh = attrs["threshold"]
+    nms_th = attrs["nms_threshold"]
+    topk = attrs["nms_topk"]
+    force = attrs["force_suppress"]
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    bg = attrs["background_id"]
+
+    def one(probs, loc):
+        nc = probs.shape[0]
+        masked = jnp.where(jnp.arange(nc)[:, None] == bg, BIG_NEG, probs)
+        score = jnp.max(masked, axis=0)
+        raw = jnp.argmax(masked, axis=0)                # class incl. bg slot
+        # id with background removed from the numbering (bg=0 -> raw-1)
+        cid = (raw - (raw > bg)).astype(probs.dtype) if bg >= 0 \
+            else raw.astype(probs.dtype)
+        cid = jnp.where(score < thresh, -1.0, cid)
+        boxes = _decode_loc(anchors, loc.reshape(-1, 4), attrs["variances"],
+                            attrs["clip"])
+        valid = cid >= 0
+        # sort by score descending, invalid rows last
+        key = jnp.where(valid, score, BIG_NEG)
+        order = jnp.argsort(-key)
+        svalid = valid[order]
+        # nms_topk only limits which rows participate in (and survive with
+        # an id) the suppression stage; the reference marks beyond-top-k
+        # rows id=-1 but keeps score/coords (multibox_detection.cc:155-160)
+        in_topk = svalid & (jnp.arange(A) < topk) if topk > 0 else svalid
+        if 0 < nms_th <= 1:
+            keep = _greedy_nms_keep(boxes, order, in_topk, cid[order],
+                                    nms_th, force)
+        else:
+            keep = in_topk
+        rows = jnp.concatenate(
+            [jnp.where(keep, cid[order], -1.0)[:, None],
+             score[order][:, None], boxes[order]], axis=-1)
+        rows = jnp.where(svalid[:, None], rows, -1.0)
+        return rows
+
+    out = jax.vmap(one)(cls_prob, loc_pred)
+    return lax.stop_gradient(out.astype(cls_prob.dtype))
+
+
+# ---------------------------------------------------------------------------
+# bounding-box ops (bounding_box-inl.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_box_nms", nin=1, nout=2, visible=1,
+          aliases=("_contrib_box_non_maximum_suppression", "box_nms"),
+          params={"overlap_thresh": param(float, 0.5),
+                  "valid_thresh": param(float, 0.0),
+                  "topk": param(int, -1),
+                  "coord_start": param(int, 2),
+                  "score_index": param(int, 1),
+                  "id_index": param(int, -1),
+                  "force_suppress": param(bool, False),
+                  "in_format": param(["corner", "center"], "corner"),
+                  "out_format": param(["corner", "center"], "corner")})
+def _box_nms(attrs, data):
+    """Generic batched NMS (bounding_box-inl.h:55-90).  Input (..., N, K);
+    output[0]: same shape, surviving rows (sorted by score desc) at front,
+    suppressed rows -1; output[1]: per-batch valid count (..., 1)."""
+    shape = data.shape
+    k = shape[-1]
+    n = shape[-2]
+    flat = data.reshape((-1, n, k))
+    cs, si, ii = attrs["coord_start"], attrs["score_index"], attrs["id_index"]
+    thresh = attrs["overlap_thresh"]
+    vthresh = attrs["valid_thresh"]
+    topk = attrs["topk"]
+    force = attrs["force_suppress"]
+
+    def one(rows):
+        score = rows[:, si]
+        valid = score > vthresh
+        key = jnp.where(valid, score, BIG_NEG)
+        order = jnp.argsort(-key)
+        svalid = valid[order]
+        if topk > 0:
+            svalid = svalid & (jnp.arange(n) < topk)
+        boxes = rows[:, cs:cs + 4]
+        if attrs["in_format"] == "center":
+            boxes = _center_to_corner(boxes)
+        classes = rows[order, ii] if ii >= 0 else None
+        keep = _greedy_nms_keep(boxes, order, svalid, classes, thresh, force)
+        out_rows = rows[order]
+        if attrs["out_format"] != attrs["in_format"]:
+            b = out_rows[:, cs:cs + 4]
+            b = (_corner_to_center(b) if attrs["out_format"] == "center"
+                 else _center_to_corner(b))
+            out_rows = out_rows.at[:, cs:cs + 4].set(b)
+        out_rows = jnp.where(keep[:, None], out_rows, -1.0)
+        return out_rows, jnp.sum(valid).astype(rows.dtype)[None]
+
+    out, count = jax.vmap(one)(flat)
+    return (lax.stop_gradient(out.reshape(shape)),
+            lax.stop_gradient(count.reshape(shape[:-2] + (1,))))
+
+
+@register("_contrib_box_iou", nin=2, aliases=("box_iou",),
+          params={"format": param(["corner", "center"], "corner")})
+def _box_iou(attrs, lhs, rhs):
+    """Pairwise IoU (bounding_box-inl.h:560-600): (..., 4) x (..., 4) ->
+    lhs.shape[:-1] + rhs.shape[:-1]."""
+    a = lhs.reshape((-1, 4))
+    b = rhs.reshape((-1, 4))
+    if attrs["format"] == "center":
+        a, b = _center_to_corner(a), _center_to_corner(b)
+    out = _corner_iou(a, b)
+    return lax.stop_gradient(
+        out.reshape(lhs.shape[:-1] + rhs.shape[:-1]).astype(lhs.dtype))
+
+
+@register("_contrib_bipartite_matching", nin=1, nout=2,
+          aliases=("bipartite_matching",),
+          params={"is_ascend": param(bool, False),
+                  "threshold": param(float, None, required=True),
+                  "topk": param(int, -1)})
+def _bipartite_matching(attrs, data):
+    """Greedy bipartite matching on a score matrix (bounding_box-inl.h:
+    680-700).  Input (..., N, M); outputs: row->col (..., N) and
+    col->row (..., M), -1 when unmatched."""
+    shape = data.shape
+    n, m = shape[-2], shape[-1]
+    flat = data.reshape((-1, n, m))
+    thr = attrs["threshold"]
+    asc = attrs["is_ascend"]
+    steps = min(n, m)
+    if attrs["topk"] > 0:
+        steps = min(steps, attrs["topk"])
+
+    def one(mat):
+        work = -mat if not asc else mat
+        lim = -thr if not asc else thr
+
+        def body(_, st):
+            rowm, colm, work = st
+            idx = jnp.argmin(work)
+            i, j = idx // m, idx % m
+            ok = work[i, j] <= lim
+            rowm = jnp.where(ok, rowm.at[i].set(j), rowm)
+            colm = jnp.where(ok, colm.at[j].set(i), colm)
+            work = jnp.where(ok, work.at[i, :].set(jnp.inf)
+                             .at[:, j].set(jnp.inf), work)
+            return rowm, colm, work
+
+        rowm = jnp.full((n,), -1.0, mat.dtype)
+        colm = jnp.full((m,), -1.0, mat.dtype)
+        rowm, colm, _ = lax.fori_loop(0, steps, body, (rowm, colm, work))
+        return rowm, colm
+
+    rowm, colm = jax.vmap(one)(flat)
+    return (lax.stop_gradient(rowm.reshape(shape[:-1])),
+            lax.stop_gradient(colm.reshape(shape[:-2] + (m,))))
+
+
+# ---------------------------------------------------------------------------
+# RPN proposals (proposal-inl.h, multi_proposal-inl.h)
+# ---------------------------------------------------------------------------
+def _gen_base_anchors(base_size, scales, ratios):
+    """py-faster-rcnn style base anchors (proposal-inl.h GenerateAnchors):
+    ratio-first enumeration with rounding."""
+    px = (base_size - 1) * 0.5
+    anchors = []
+    size = base_size * base_size
+    for r in ratios:
+        ws = round(np.sqrt(size / r))
+        hs = round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s * 0.5, hs * s * 0.5
+            anchors.append([px - w2 + 0.5, px - h2 + 0.5,
+                            px + w2 - 0.5, px + h2 - 0.5])
+    return np.asarray(anchors, np.float32)
+
+
+def _proposal_impl(attrs, score, bbox_deltas, im_info):
+    """One image's RPN proposals.  score (A, H, W) foreground scores."""
+    stride = attrs["feature_stride"]
+    anchors0 = _gen_base_anchors(stride, attrs["scales"], attrs["ratios"])
+    na = anchors0.shape[0]
+    h, w = score.shape[-2], score.shape[-1]
+    shift_x = np.arange(w) * stride
+    shift_y = np.arange(h) * stride
+    sx, sy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    all_anchors = jnp.asarray(
+        (shifts + anchors0[None]).reshape(-1, 4))       # (H*W*A, 4)
+    # deltas (4A, H, W) -> (H*W*A, 4); scores (A, H, W) -> (H*W*A,)
+    deltas = bbox_deltas.reshape(na, 4, h, w).transpose(2, 3, 0, 1)\
+        .reshape(-1, 4)
+    scores = score.transpose(1, 2, 0).reshape(-1)
+
+    if attrs["iou_loss"]:
+        # IoUTransformInv (proposal.cc): deltas are direct corner offsets
+        boxes = all_anchors + deltas
+    else:
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+        ax = all_anchors[:, 0] + aw * 0.5
+        ay = all_anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1),
+                           cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)], -1)
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                       jnp.clip(boxes[:, 1], 0, im_h - 1),
+                       jnp.clip(boxes[:, 2], 0, im_w - 1),
+                       jnp.clip(boxes[:, 3], 0, im_h - 1)], -1)
+    min_size = attrs["rpn_min_size"] * im_scale
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+                ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+    scores = jnp.where(keep_size, scores, BIG_NEG)
+
+    pre_n = min(attrs["rpn_pre_nms_top_n"], boxes.shape[0])
+    post_n = attrs["rpn_post_nms_top_n"]
+    top_scores, order = lax.top_k(scores, pre_n)
+    top_boxes = boxes[order]
+    valid = top_scores > BIG_NEG / 2
+    keep = _greedy_nms_keep(top_boxes, jnp.arange(pre_n), valid, None,
+                            attrs["threshold"], True)
+    # compact kept to front preserving score order, pad by wrapping (the
+    # reference fills the fixed post_nms_top_n output cyclically)
+    nkeep = jnp.maximum(jnp.sum(keep), 1)
+    slots = jnp.arange(post_n) % nkeep
+    src = jnp.argsort(~keep)
+    idx = src[slots]
+    rois = top_boxes[idx]
+    roi_scores = top_scores[idx][:, None]
+    return rois, roi_scores
+
+
+_PROPOSAL_PARAMS = {
+    "rpn_pre_nms_top_n": param(int, 6000),
+    "rpn_post_nms_top_n": param(int, 300),
+    "threshold": param(float, 0.7),
+    "rpn_min_size": param(int, 16),
+    "scales": param("floats", (4.0, 8.0, 16.0, 32.0)),
+    "ratios": param("floats", (0.5, 1.0, 2.0)),
+    "feature_stride": param(int, 16),
+    "output_score": param(bool, False),
+    "iou_loss": param(bool, False),
+}
+
+
+@register("_contrib_Proposal", nin=3, aliases=("Proposal",),
+          nout=lambda attrs: 2 if attrs["output_score"] else 1,
+          params=dict(_PROPOSAL_PARAMS))
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal op (proposal-inl.h:60-90); batch size 1.
+    cls_prob (1, 2A, H, W); output rois (post_n, 5) [batch_idx, corners]."""
+    na = cls_prob.shape[1] // 2
+    rois, scores = _proposal_impl(attrs, cls_prob[0, na:], bbox_pred[0],
+                                  im_info[0])
+    rois = jnp.concatenate([jnp.zeros((rois.shape[0], 1), rois.dtype), rois],
+                           axis=-1)
+    rois = lax.stop_gradient(rois.astype(cls_prob.dtype))
+    if attrs["output_score"]:
+        return rois, lax.stop_gradient(scores.astype(cls_prob.dtype))
+    return rois
+
+
+@register("_contrib_MultiProposal", nin=3, aliases=("MultiProposal",),
+          nout=lambda attrs: 2 if attrs["output_score"] else 1,
+          params=dict(_PROPOSAL_PARAMS))
+def _multi_proposal(attrs, cls_prob, bbox_pred, im_info):
+    """Batched Proposal (multi_proposal-inl.h): output (N*post_n, 5) with
+    per-image batch index in column 0."""
+    n = cls_prob.shape[0]
+    na = cls_prob.shape[1] // 2
+
+    def one(probs, deltas, info):
+        return _proposal_impl(attrs, probs[na:], deltas, info)
+
+    rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    post_n = rois.shape[1]
+    bidx = jnp.broadcast_to(
+        jnp.arange(n, dtype=rois.dtype)[:, None, None], (n, post_n, 1))
+    rois = jnp.concatenate([bidx, rois], -1).reshape(n * post_n, 5)
+    rois = lax.stop_gradient(rois.astype(cls_prob.dtype))
+    if attrs["output_score"]:
+        return rois, lax.stop_gradient(
+            scores.reshape(n * post_n, 1).astype(cls_prob.dtype))
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling family
+# ---------------------------------------------------------------------------
+@register("ROIPooling", nin=2, aliases=("roipooling",),
+          params={"pooled_size": param("shape", None, required=True),
+                  "spatial_scale": param(float, None, required=True)})
+def _roi_pooling(attrs, data, rois):
+    """Max ROI pooling (roi_pooling-inl.h:50-60; forward roi_pooling.cc).
+
+    data (N, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords.  TPU design: per-roi masked max over the feature map (bin
+    membership as a separable h/w mask) instead of scalar bin loops.
+    """
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    n, c, h, w = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        img = data[b]                                    # (C, H, W)
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        hstart = jnp.clip(jnp.floor(iy * bin_h) + y1, 0, h)
+        hend = jnp.clip(jnp.ceil((iy + 1) * bin_h) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(ix * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((ix + 1) * bin_w) + x1, 0, w)
+        hs = jnp.arange(h)
+        ws = jnp.arange(w)
+        mh = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])
+        mw = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])
+        # (C, ph, H, W) masked -> max over H,W
+        m = mh[None, :, None, :, None] & mw[None, None, :, None, :]
+        vals = jnp.where(m, img[:, None, None, :, :], BIG_NEG)
+        out = jnp.max(vals, axis=(3, 4))
+        empty = (hend[:, None] <= hstart[:, None]) | \
+                (wend[None, :] <= wstart[None, :])
+        return jnp.where(empty[None], 0.0, out)
+
+    return jax.vmap(one)(rois).astype(data.dtype)
+
+
+def _bilinear_sample(img, ys, xs):
+    """Bilinear sample img (C, H, W) at float coords; zero outside.
+    ys/xs any shape; returns (C,) + shape."""
+    h, w = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            v = img[:, yc, xc]
+            out = out + v * (wy * wx * inb)[None]
+    return out
+
+
+@register("_contrib_ROIAlign", nin=2, aliases=("ROIAlign",),
+          params={"pooled_size": param("shape", None, required=True),
+                  "spatial_scale": param(float, None, required=True),
+                  "sample_ratio": param(int, -1)})
+def _roi_align(attrs, data, rois):
+    """ROIAlign (roi_align-inl.h:50-60): average of bilinear samples per
+    bin, no coordinate rounding."""
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    sr = attrs["sample_ratio"]
+    n, c, h, w = data.shape
+    # static sample counts (reference uses adaptive ceil(roi/bin) when -1;
+    # static compromise: 2 — the detectron default)
+    sh = sr if sr > 0 else 2
+    sw = sr if sr > 0 else 2
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, \
+            roi[3] * scale, roi[4] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        iy = jnp.arange(ph)[:, None, None, None]
+        ix = jnp.arange(pw)[None, :, None, None]
+        ky = jnp.arange(sh)[None, None, :, None]
+        kx = jnp.arange(sw)[None, None, None, :]
+        ys = y1 + iy * bh + (ky + 0.5) * bh / sh
+        xs = x1 + ix * bw + (kx + 0.5) * bw / sw
+        ys = jnp.broadcast_to(ys, (ph, pw, sh, sw))
+        xs = jnp.broadcast_to(xs, (ph, pw, sh, sw))
+        vals = _bilinear_sample(data[b], ys, xs)         # (C, ph, pw, sh, sw)
+        return jnp.mean(vals, axis=(3, 4))
+
+    return jax.vmap(one)(rois).astype(data.dtype)
+
+
+@register("_contrib_PSROIPooling", nin=2, aliases=("PSROIPooling",),
+          params={"spatial_scale": param(float, None, required=True),
+                  "output_dim": param(int, None, required=True),
+                  "pooled_size": param(int, None, required=True),
+                  "group_size": param(int, 0)})
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI pooling (psroi_pooling-inl.h:55-65, R-FCN):
+    bin (i,j) of output channel d averages input channel
+    (d*G + gi)*G + gj over the bin."""
+    scale = attrs["spatial_scale"]
+    od = attrs["output_dim"]
+    p = attrs["pooled_size"]
+    g = attrs["group_size"] or p
+    n, c, h, w = data.shape
+    # static channel map (p, p) -> group cell
+    gi = (np.arange(p) * g // p).clip(0, g - 1)
+    chan = (np.arange(od)[:, None, None] * g + gi[None, :, None]) * g + \
+        gi[None, None, :]                                # (od, p, p)
+    chan = jnp.asarray(chan)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = jnp.round(roi[3] + 1.0) * scale
+        y2 = jnp.round(roi[4] + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / p, rw / p
+        iy, ix = jnp.arange(p), jnp.arange(p)
+        hstart = jnp.clip(jnp.floor(iy * bh + y1), 0, h)
+        hend = jnp.clip(jnp.ceil((iy + 1) * bh + y1), 0, h)
+        wstart = jnp.clip(jnp.floor(ix * bw + x1), 0, w)
+        wend = jnp.clip(jnp.ceil((ix + 1) * bw + x1), 0, w)
+        hs, ws = jnp.arange(h), jnp.arange(w)
+        mh = (hs[None] >= hstart[:, None]) & (hs[None] < hend[:, None])
+        mw = (ws[None] >= wstart[:, None]) & (ws[None] < wend[:, None])
+        m = (mh[:, None, :, None] & mw[None, :, None, :]).astype(data.dtype)
+        img = data[b][chan]                              # (od, p, p, h, w)
+        s = jnp.einsum("dijhw,ijhw->dij", img, m)
+        cnt = jnp.maximum(jnp.einsum("ijhw->ij", m), 1.0)
+        empty = (hend[:, None] <= hstart[:, None]) | \
+                (wend[None, :] <= wstart[None, :])
+        return jnp.where(empty[None], 0.0, s / cnt[None])
+
+    return jax.vmap(one)(rois).astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling", nin=-1,
+          aliases=("DeformablePSROIPooling",),
+          params={"spatial_scale": param(float, None, required=True),
+                  "output_dim": param(int, None, required=True),
+                  "group_size": param(int, None, required=True),
+                  "pooled_size": param(int, None, required=True),
+                  "part_size": param(int, 0),
+                  "sample_per_part": param(int, 1),
+                  "trans_std": param(float, 0.0),
+                  "no_trans": param(bool, False)})
+def _deformable_psroi_pooling(attrs, data, rois, *maybe_trans):
+    """Deformable PS-ROI pooling (deformable_psroi_pooling-inl.h:60-74):
+    PS-ROI bins shifted by a learned normalized offset per part cell,
+    sampled bilinearly (sample_per_part² samples per bin)."""
+    scale = attrs["spatial_scale"]
+    od = attrs["output_dim"]
+    p = attrs["pooled_size"]
+    g = attrs["group_size"]
+    part = attrs["part_size"] or p
+    sp = attrs["sample_per_part"]
+    tstd = attrs["trans_std"]
+    no_trans = attrs["no_trans"] or not maybe_trans
+    n, c, h, w = data.shape
+    gi = (np.arange(p) * g // p).clip(0, g - 1)
+    chan = (np.arange(od)[:, None, None] * g + gi[None, :, None]) * g + \
+        gi[None, None, :]
+    chan = jnp.asarray(chan)
+    pi = (np.arange(p) * part // p).clip(0, part - 1)
+
+    def one(roi, trans):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / p, rw / p
+        # per-bin learned offsets from the part grid (class-agnostic: the
+        # trans input has 2*num_offset_classes channels; class 0 used here
+        # per bin cell)
+        if no_trans:
+            dy = jnp.zeros((p, p))
+            dx = jnp.zeros((p, p))
+        else:
+            tr = trans.reshape(-1, 2, part, part)
+            dy = tr[0, 1][pi[:, None], pi[None, :]] * tstd * rh
+            dx = tr[0, 0][pi[:, None], pi[None, :]] * tstd * rw
+        iy = jnp.arange(p)[:, None, None, None]
+        ix = jnp.arange(p)[None, :, None, None]
+        ky = jnp.arange(sp)[None, None, :, None]
+        kx = jnp.arange(sp)[None, None, None, :]
+        sub_h = bh / sp
+        sub_w = bw / sp
+        ys = y1 + iy * bh + (ky + 0.5) * sub_h + dy[:, :, None, None]
+        xs = x1 + ix * bw + (kx + 0.5) * sub_w + dx[:, :, None, None]
+        ys = jnp.broadcast_to(ys, (p, p, sp, sp)).reshape(p * p, sp, sp)
+        xs = jnp.broadcast_to(xs, (p, p, sp, sp)).reshape(p * p, sp, sp)
+        # gather each bin's position-sensitive channels FIRST, then sample
+        # only those od channels (g² fewer gathers than sampling all C)
+        imgs = data[b][chan].transpose(1, 2, 0, 3, 4)\
+            .reshape(p * p, od, h, w)
+        vals = jax.vmap(_bilinear_sample)(imgs, ys, xs)  # (p*p, od, sp, sp)
+        pooled = jnp.mean(vals, axis=(2, 3))             # (p*p, od)
+        return pooled.T.reshape(od, p, p)
+
+    r = rois.shape[0]
+    trans = maybe_trans[0] if maybe_trans else jnp.zeros((r, 2, part, part),
+                                                         data.dtype)
+    return jax.vmap(one)(rois, trans).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (deformable_convolution-inl.h, deformable_im2col.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_DeformableConvolution", nin=-1,
+          aliases=("DeformableConvolution",),
+          params={"kernel": param("shape", None, required=True),
+                  "stride": param("shape", ()),
+                  "dilate": param("shape", ()),
+                  "pad": param("shape", ()),
+                  "num_filter": param(int, None, required=True),
+                  "num_group": param(int, 1),
+                  "num_deformable_group": param(int, 1),
+                  "workspace": param(int, 1024),
+                  "no_bias": param(bool, False),
+                  "layout": param(str, None)})
+def _deformable_convolution(attrs, data, offset, weight, *maybe_bias):
+    """Deformable conv v1 (deformable_im2col.h bilinear im2col + GEMM).
+
+    offset (N, num_deformable_group*2*kh*kw, Ho, Wo), per-tap (dy, dx)
+    channel pairs (deformable_im2col.h: channel 2*tap = y, 2*tap+1 = x).
+    TPU design: bilinear-gather the deformed im2col patch tensor
+    (N, C, kh*kw, Ho, Wo) then one grouped einsum on the MXU.
+    """
+    kh, kw = attrs["kernel"]
+    stride = attrs["stride"] or (1, 1)
+    dilate = attrs["dilate"] or (1, 1)
+    pad = attrs["pad"] or (0, 0)
+    groups = attrs["num_group"]
+    dg = attrs["num_deformable_group"]
+    nf = attrs["num_filter"]
+    n, c, h, w = data.shape
+    ho = (h + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    wo = (w + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    kk = kh * kw
+
+    base_y = (np.arange(ho) * stride[0] - pad[0])[:, None] + \
+        (np.arange(kh) * dilate[0])[None, :]             # (Ho, kh)
+    base_x = (np.arange(wo) * stride[1] - pad[1])[:, None] + \
+        (np.arange(kw) * dilate[1])[None, :]             # (Wo, kw)
+
+    # per-tap base coordinates: tap t = (t//kw, t%kw)
+    ys_tap = np.repeat(base_y.T, kw, axis=0)             # (kk, Ho)
+    xs_tap = np.tile(base_x.T, (kh, 1))                  # (kk, Wo)
+
+    def one(img, off):
+        # off (dg*2*kk, Ho, Wo) -> (dg, kk, 2, Ho, Wo)
+        off = off.reshape(dg, kk, 2, ho, wo)
+        cols = []
+        for gidx in range(dg):
+            ys = jnp.asarray(ys_tap)[:, :, None] + off[gidx, :, 0]
+            xs = jnp.asarray(xs_tap)[:, None, :] + off[gidx, :, 1]
+            sub = img[gidx * (c // dg):(gidx + 1) * (c // dg)]
+            cols.append(_bilinear_sample(sub, ys, xs))   # (C/dg, kk, Ho, Wo)
+        return jnp.concatenate(cols, axis=0)             # (C, kk, Ho, Wo)
+
+    cols = jax.vmap(one)(data, offset)                   # (N, C, kk, Ho, Wo)
+    cols = cols.reshape(n, groups, (c // groups) * kk, ho * wo)
+    w3 = weight.reshape(groups, nf // groups, (c // groups) * kk)
+    out = jnp.einsum("gmk,ngkp->ngmp", w3, cols,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, nf, ho, wo).astype(data.dtype)
+    if not attrs["no_bias"] and maybe_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out

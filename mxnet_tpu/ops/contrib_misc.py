@@ -1,0 +1,305 @@
+"""Misc contrib ops: CTC loss, FFT, count-sketch, khatri-rao, quadratic,
+adaptive/bilinear pooling-resize, channel operator, div_sqrt_dim.
+
+Reference analogs (`src/operator/contrib/`, SURVEY.md N7 contrib/):
+- ``_contrib_CTCLoss`` — ctc_loss-inl.h:195-215 (warp-ctc semantics:
+  softmax inside, ``blank_label`` first/last, optional per-sample lengths).
+- ``_contrib_fft`` / ``_contrib_ifft`` — fft-inl.h:50-60 (cuFFT real->
+  interleaved-complex; ifft unnormalized like cuFFT).
+- ``_contrib_count_sketch`` — count_sketch-inl.h:45-55.
+- ``khatri_rao`` — krprod.cc (column-wise Kronecker product).
+- ``_contrib_quadratic`` — quadratic_op-inl.h (a*x² + b*x + c).
+- ``_contrib_AdaptiveAvgPooling2D`` — adaptive_avg_pooling-inl.h:50-56;
+  ``_contrib_BilinearResize2D`` — bilinear_resize-inl.h:50-58 (both lowered
+  to interpolation-matrix einsums so they ride the MXU instead of the
+  reference's scalar bin loops).
+- ``_contrib_ChannelOperator`` — channel_operator-inl.h:32-50 (the fork's
+  R-FCN helper: Group_Max / Group_Softmax / Group_Pick).
+- ``_contrib_div_sqrt_dim`` — transformer.cc:33-40.
+
+TPU-native design notes: CTC's alpha recursion is a ``lax.scan`` over time
+in log space — the backward pass is ``jax.vjp`` of that scan (the reference
+ships warp-ctc's hand-written beta recursion; vjp-of-alpha computes the
+same gradient); FFTs map to XLA's native fft HLO.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, param
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+@register("_contrib_CTCLoss", nin=-1, nout=2, visible=1,
+          aliases=("_contrib_ctc_loss", "ctc_loss", "CTCLoss"),
+          params={"use_data_lengths": param(bool, False),
+                  "use_label_lengths": param(bool, False),
+                  "blank_label": param(["first", "last"], "first")})
+def _ctc_loss(attrs, data, label, *lengths):
+    """CTC loss (ctc_loss-inl.h:195-215).
+
+    data (T, N, A) activations (softmax applied internally, warp-ctc
+    convention); label (N, L): with ``blank_label=first`` blank is 0 and
+    labels are 1-based with 0-padding; with ``last`` blank is A-1, labels
+    0-based with -1 padding.  Optional data_lengths (N,) and/or
+    label_lengths (N,) follow in input order.  Outputs: (loss (N,),
+    grad-ready log-alphas hidden output).
+    """
+    t_max, n, a = data.shape
+    l_max = label.shape[1]
+    use_dl, use_ll = attrs["use_data_lengths"], attrs["use_label_lengths"]
+    rest = list(lengths)
+    data_len = rest.pop(0) if use_dl else None
+    label_len = rest.pop(0) if use_ll else None
+    blank_first = attrs["blank_label"] == "first"
+    blank = 0 if blank_first else a - 1
+
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)  # (T, N, A)
+
+    lab = label.astype(jnp.int32)
+    if blank_first:
+        pad = lab <= 0
+        lab_ids = lab           # already 1-based with blank 0
+    else:
+        pad = lab < 0
+        lab_ids = lab
+    if label_len is not None:
+        pad = pad | (jnp.arange(l_max)[None, :] >=
+                     label_len.astype(jnp.int32)[:, None])
+    num_lab = jnp.sum(~pad, axis=1)                      # (N,)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  (len 2L+1)
+    s_len = 2 * l_max + 1
+    ext = jnp.full((n, s_len), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(pad, blank, lab_ids))
+    valid_s = jnp.arange(s_len)[None, :] < (2 * num_lab + 1)[:, None]
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s_len]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    if data_len is not None:
+        t_len = data_len.astype(jnp.int32)
+    else:
+        t_len = jnp.full((n,), t_max, jnp.int32)
+
+    def step(alpha, inputs):
+        lp_t, t = inputs                                  # lp_t (N, A)
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                          constant_values=NEG_INF)[:, :s_len]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                          constant_values=NEG_INF)[:, :s_len]
+        a_new = jnp.logaddexp(alpha, a_prev1)
+        a_new = jnp.where(can_skip, jnp.logaddexp(a_new, a_prev2), a_new)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)     # (N, s_len)
+        a_new = a_new + emit
+        a_new = jnp.where(valid_s, a_new, NEG_INF)
+        # frozen once past this sample's length
+        a_new = jnp.where((t < t_len)[:, None], a_new, alpha)
+        return a_new, None
+
+    alpha0 = jnp.full((n, s_len), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_emit = jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(num_lab > 0, first_emit, NEG_INF))
+    alpha, _ = lax.scan(step, alpha0,
+                        (logp[1:], jnp.arange(1, t_max)))
+    # loss = -log(alpha[2L] + alpha[2L-1]) at the final valid frame
+    idx_last = 2 * num_lab
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(a_last,
+                       jnp.where(num_lab > 0, a_prev, NEG_INF))
+    loss = (-ll).astype(data.dtype)
+    return loss, alpha.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# FFT family (cuFFT semantics: interleaved complex, unnormalized inverse)
+# ---------------------------------------------------------------------------
+@register("_contrib_fft", nin=1, aliases=("fft",),
+          params={"compute_size": param(int, 128)})
+def _fft(attrs, data):
+    """Batched FFT over the last dim (fft-inl.h:50-60): real (..., D) ->
+    interleaved complex (..., 2D)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],))
+    return out.astype(data.dtype)
+
+
+@register("_contrib_ifft", nin=1, aliases=("ifft",),
+          params={"compute_size": param(int, 128)})
+def _ifft(attrs, data):
+    """Inverse FFT (ifft-inl.h): interleaved complex (..., 2D) -> real
+    (..., D), unnormalized (cuFFT convention — caller divides by D)."""
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    z = c[..., 0] + 1j * c[..., 1]
+    out = jnp.fft.ifft(z, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# count sketch
+# ---------------------------------------------------------------------------
+@register("_contrib_count_sketch", nin=3,
+          aliases=("count_sketch",),
+          params={"out_dim": param(int, None, required=True),
+                  "processing_batch_size": param(int, 32)})
+def _count_sketch(attrs, data, h, s):
+    """Count sketch projection (count_sketch-inl.h:45-55): data (N, D),
+    hash bucket h (1, D) in [0, out_dim), sign s (1, D) in {+1, -1} ->
+    (N, out_dim): out[n, h[d]] += s[d] * data[n, d]."""
+    out_dim = attrs["out_dim"]
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1)
+    signed = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, hh].add(signed)
+
+
+@register("khatri_rao", nin=-1)
+def _khatri_rao(attrs, *mats):
+    """Column-wise Kronecker product (krprod.cc): inputs (n_i, K) ->
+    (prod n_i, K)."""
+    if not mats:
+        raise MXNetError("khatri_rao needs at least one input")
+    out = mats[0]
+    for m in mats[1:]:
+        k = out.shape[1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+@register("_contrib_quadratic", nin=1, aliases=("quadratic",),
+          params={"a": param(float, 0.0), "b": param(float, 0.0),
+                  "c": param(float, 0.0)})
+def _quadratic(attrs, data):
+    """Elementwise a*x² + b*x + c (quadratic_op-inl.h)."""
+    return attrs["a"] * data * data + attrs["b"] * data + attrs["c"]
+
+
+@register("_contrib_div_sqrt_dim", nin=1, aliases=("div_sqrt_dim",))
+def _div_sqrt_dim(attrs, data):
+    """out = data / sqrt(data.shape[-1]) (transformer.cc:33-40, the fork's
+    attention scaling helper)."""
+    return data / np.sqrt(data.shape[-1]).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling / bilinear resize — interpolation-matrix einsums
+# ---------------------------------------------------------------------------
+def _adaptive_pool_matrix(in_size: int, out_size: int) -> np.ndarray:
+    """(out, in) averaging matrix with bin [floor(i*I/O), ceil((i+1)*I/O))."""
+    m = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)  # ceil div
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return m
+
+
+@register("_contrib_AdaptiveAvgPooling2D", nin=1,
+          aliases=("AdaptiveAvgPooling2D",),
+          params={"output_size": param("shape", ())})
+def _adaptive_avg_pooling(attrs, data):
+    """Adaptive average pooling (adaptive_avg_pooling-inl.h:50-56): NCHW ->
+    NC(out_h)(out_w); empty output_size means global (1, 1)."""
+    osize = attrs["output_size"] or (1, 1)
+    if len(osize) == 1:
+        osize = (osize[0], osize[0])
+    h, w = data.shape[2], data.shape[3]
+    mh = jnp.asarray(_adaptive_pool_matrix(h, osize[0]))
+    mw = jnp.asarray(_adaptive_pool_matrix(w, osize[1]))
+    out = jnp.einsum("oh,nchw,pw->ncop", mh, data.astype(jnp.float32), mw)
+    return out.astype(data.dtype)
+
+
+def _bilinear_matrix(in_size: int, out_size: int) -> np.ndarray:
+    """(out, in) align-corners bilinear interpolation matrix
+    (bilinear_resize-inl.h caffe2-style: scale = (in-1)/(out-1))."""
+    m = np.zeros((out_size, in_size), np.float32)
+    if out_size == 1 or in_size == 1:
+        m[:, 0] = 1.0
+        return m
+    scale = (in_size - 1) / (out_size - 1)
+    for i in range(out_size):
+        src = i * scale
+        lo = int(np.floor(src))
+        hi = min(lo + 1, in_size - 1)
+        frac = src - lo
+        m[i, lo] += 1.0 - frac
+        m[i, hi] += frac
+    return m
+
+
+@register("_contrib_BilinearResize2D", nin=1,
+          aliases=("BilinearResize2D",),
+          params={"height": param(int, None, required=True),
+                  "width": param(int, None, required=True)})
+def _bilinear_resize(attrs, data):
+    """Bilinear resize (bilinear_resize-inl.h:50-58), align-corners
+    semantics, as two 1-D interpolation matmuls."""
+    h, w = data.shape[2], data.shape[3]
+    mh = jnp.asarray(_bilinear_matrix(h, attrs["height"]))
+    mw = jnp.asarray(_bilinear_matrix(w, attrs["width"]))
+    out = jnp.einsum("oh,nchw,pw->ncop", mh, data.astype(jnp.float32), mw)
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# channel operator (the fork's R-FCN helper)
+# ---------------------------------------------------------------------------
+@register("_contrib_ChannelOperator", nin=-1,
+          aliases=("ChannelOperator",),
+          nout=lambda attrs: 2 if attrs["op_type"] == "Group_Max" else 1,
+          visible=1,
+          params={"op_type": param(["Group_Max", "Group_Pick",
+                                    "Group_Softmax"], None, required=True),
+                  "group": param(int, None, required=True),
+                  "pick_type": param(["Label_Pick", "Score_Pick"],
+                                     "Label_Pick")})
+def _channel_operator(attrs, data, *rest):
+    """Grouped channel ops (channel_operator-inl.h:32-50).
+
+    - Group_Max: (N, C, ...) -> (N, C/group, ...) max within each group of
+      ``group`` consecutive channels (+ argmax hidden output for backward).
+    - Group_Softmax: softmax within each group, shape preserved.
+    - Group_Pick: second input picks one channel per group:
+      Label_Pick uses integer labels (N,), Score_Pick the per-group argmax
+      of the picks input.
+    """
+    g = attrs["group"]
+    op_type = attrs["op_type"]
+    n, c = data.shape[0], data.shape[1]
+    tail = data.shape[2:]
+    grouped = data.reshape((n, c // g, g) + tail)
+    if op_type == "Group_Max":
+        out = jnp.max(grouped, axis=2)
+        amax = jnp.argmax(grouped, axis=2).astype(data.dtype)
+        return out, amax
+    if op_type == "Group_Softmax":
+        return jax.nn.softmax(grouped, axis=2).reshape(data.shape)
+    # Group_Pick
+    if not rest:
+        raise MXNetError("ChannelOperator Group_Pick needs a pick input")
+    pick = rest[0]
+    if attrs["pick_type"] == "Score_Pick":
+        idx = jnp.argmax(pick.reshape((n, c // g, g) + tail).mean(
+            axis=tuple(range(3, 3 + len(tail)))), axis=2)
+    else:
+        idx = jnp.broadcast_to(
+            pick.reshape(n, -1)[:, 0:1].astype(jnp.int32), (n, c // g))
+    idx = idx.reshape((n, c // g) + (1,) * (len(tail) + 1))
+    out = jnp.take_along_axis(grouped, idx, axis=2)
+    return out[:, :, 0]

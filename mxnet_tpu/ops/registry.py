@@ -63,6 +63,12 @@ class param:
             if isinstance(v, (int, np.integer)):
                 return (int(v),)
             return tuple(int(x) for x in v)
+        if t == "floats":
+            if isinstance(v, str):
+                v = ast.literal_eval(v)
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                return (float(v),)
+            return tuple(float(x) for x in v)
         if t == "dtype":
             if v in (None, "None"):
                 return None

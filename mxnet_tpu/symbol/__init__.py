@@ -6,3 +6,4 @@ from . import register as _register
 _register.populate(globals())
 
 from . import random  # noqa: F401
+from . import contrib  # noqa: F401

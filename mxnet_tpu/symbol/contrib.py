@@ -1,0 +1,24 @@
+"""``sym.contrib`` namespace: experimental/contrib operators (symbolic).
+
+Parity target: ``python/mxnet/symbol/contrib.py``.
+"""
+from __future__ import annotations
+
+from ..ops.registry import OPS
+from . import register as _register
+
+_PREFIX = "_contrib_"
+
+
+def populate(module_dict):
+    for name in list(OPS):
+        if name.startswith(_PREFIX):
+            short = name[len(_PREFIX):]
+            if short not in module_dict:
+                fn = _register._make_fn(name)
+                fn.__name__ = short
+                fn.__qualname__ = short
+                module_dict[short] = fn
+
+
+populate(globals())

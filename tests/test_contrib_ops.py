@@ -1,0 +1,376 @@
+"""Tests for contrib operators (detection family + misc).
+
+Parity model: tests/python/unittest/test_contrib_operator.py and
+test_operator.py multibox/bounding-box/CTC sections of the reference.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_layout():
+    x = nd.zeros((1, 3, 2, 3))
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.5,), ratios=(1.0,))
+    a = out.asnumpy()
+    assert a.shape == (1, 2 * 3 * 1, 4)
+    # first anchor centred at ((0+.5)/3, (0+.5)/2) with w=.5*h/w/2, h=.5/2
+    cx, cy = 0.5 / 3, 0.5 / 2
+    w, h = 0.5 * 2 / 3 / 2, 0.5 / 2
+    np.testing.assert_allclose(a[0, 0], [cx - w, cy - h, cx + w, cy + h],
+                               atol=1e-6)
+
+
+def test_multibox_prior_clip_and_count():
+    x = nd.zeros((1, 3, 4, 4))
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.9, 0.4), ratios=(1, 2, 0.5),
+                                   clip=True)
+    a = out.asnumpy()
+    # anchors per pixel = num_sizes - 1 + num_ratios = 4
+    assert a.shape == (1, 4 * 4 * 4, 4)
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+def test_multibox_target_basic():
+    anchors = nd.array([[[0., 0., .5, .5], [.5, .5, 1., 1.],
+                         [0., 0., 1., 1.]]])
+    # one gt of class 1 overlapping anchor 0 region
+    label = nd.array([[[1., .0, .0, .45, .45], [-1, -1, -1, -1, -1]]])
+    cls_pred = nd.zeros((1, 3, 3))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    # best-matching anchor gets class 1+1=2, others negative (0)
+    assert ct[0] == 2.0
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    lm = loc_m.asnumpy().reshape(3, 4)
+    assert lm[0].all() and not lm[1].any() and not lm[2].any()
+
+
+def test_multibox_target_no_gt():
+    anchors = nd.array([[[0., 0., .5, .5], [.5, .5, 1., 1.]]])
+    label = nd.full((1, 2, 5), -1.0)
+    cls_pred = nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert (cls_t.asnumpy() == -1.0).all()      # ignore_label everywhere
+    assert (loc_m.asnumpy() == 0).all()
+    assert (loc_t.asnumpy() == 0).all()
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd.array([[[0., 0., .5, .5], [.5, .5, 1., 1.],
+                         [0., .5, .5, 1.], [.5, 0., 1., .5]]])
+    label = nd.array([[[0., .0, .0, .5, .5]]])
+    cls_pred = nd.zeros((1, 2, 4))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0                          # positive
+    # exactly 1 negative mined (ratio 1:1), rest ignore
+    assert (ct == 0).sum() == 1
+    assert (ct == -1).sum() == 2
+
+
+def test_multibox_detection_roundtrip():
+    # anchors + zero loc_pred + variance decode = anchors themselves
+    anchors = nd.array([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+    cls_prob = nd.array([[[0.1, 0.2], [0.9, 0.8]]])   # class 1 wins both
+    loc_pred = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5).asnumpy()[0]
+    assert out.shape == (2, 6)
+    # both kept (no overlap), sorted by score desc
+    np.testing.assert_allclose(out[0], [0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                               atol=1e-5)
+    np.testing.assert_allclose(out[1], [0, 0.8, 0.6, 0.6, 0.9, 0.9],
+                               atol=1e-5)
+
+
+def test_multibox_detection_threshold_and_nms():
+    anchors = nd.array([[[0., 0., 1., 1.], [0.02, 0., 1.02, 1.],
+                         [0.5, 0.5, 0.6, 0.6]]])
+    cls_prob = nd.array([[[0.1, 0.1, 0.9], [0.9, 0.8, 0.05]]])
+    loc_pred = nd.zeros((1, 12))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.5,
+                                       nms_threshold=0.5).asnumpy()[0]
+    ids = out[:, 0]
+    # overlapping duplicate suppressed, low-score anchor dropped
+    assert (ids >= 0).sum() == 1
+
+
+def test_multibox_detection_topk_keeps_fields():
+    # beyond-top-k rows lose their id but keep score/coords
+    # (multibox_detection.cc:155-160 semantics)
+    anchors = nd.array([[[0., 0., .1, .1], [0.4, 0.4, .5, .5],
+                         [0.8, 0.8, .9, .9]]])
+    cls_prob = nd.array([[[0.1, 0.2, 0.3], [0.9, 0.8, 0.7]]])
+    loc_pred = nd.zeros((1, 12))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_topk=2,
+                                       nms_threshold=0.5).asnumpy()[0]
+    assert out[0, 0] == 0 and out[1, 0] == 0
+    assert out[2, 0] == -1                      # id dropped
+    np.testing.assert_allclose(out[2, 1], 0.7)  # but score kept
+
+
+def test_multibox_detection_background_id():
+    anchors = nd.array([[[0.1, 0.1, 0.4, 0.4]]])
+    loc_pred = nd.zeros((1, 4))
+    # background last: class 0 and 1 are foreground
+    cls_prob = nd.array([[[0.1], [0.7], [0.2]]])
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       background_id=2).asnumpy()[0]
+    assert out[0, 0] == 1 and abs(out[0, 1] - 0.7) < 1e-6
+
+
+def test_bipartite_matching_topk():
+    score = nd.array([[[0.9, 0.1], [0.2, 0.8]]])
+    rowm, _ = nd.contrib.bipartite_matching(score, threshold=0.05, topk=1)
+    assert (rowm.asnumpy() >= 0).sum() == 1
+
+
+def test_box_nms():
+    dets = nd.array([[[0, 0.9, 0, 0, 1, 1],
+                      [0, 0.8, 0.05, 0, 1.05, 1],
+                      [1, 0.7, 2, 2, 3, 3]]])
+    out, = [nd.contrib.box_nms(dets, overlap_thresh=0.5, id_index=0)]
+    o = out.asnumpy()[0]
+    assert o.shape == (3, 6)
+    np.testing.assert_allclose(o[0, 1], 0.9)
+    assert (o[1] == -1).all()                    # suppressed duplicate
+    np.testing.assert_allclose(o[2, 1], 0.7)     # different class survives
+
+
+def test_box_nms_valid_thresh_topk():
+    dets = nd.array([[[0.9, 0, 0, 1, 1],
+                      [0.05, 2, 2, 3, 3],
+                      [0.8, 5, 5, 6, 6]]])
+    out = nd.contrib.box_nms(dets, overlap_thresh=0.5, valid_thresh=0.1,
+                             coord_start=1, score_index=0, topk=1)
+    o = out.asnumpy()[0]
+    assert (o[0] >= 0).all()
+    assert (o[1:] == -1).all()
+
+
+def test_box_iou():
+    l = nd.array([[0., 0., 1., 1.]])
+    r = nd.array([[0.5, 0., 1.5, 1.], [2., 2., 3., 3.]])
+    out = nd.contrib.box_iou(l, r).asnumpy()
+    np.testing.assert_allclose(out, [[1. / 3, 0.]], atol=1e-6)
+
+
+def test_bipartite_matching():
+    score = nd.array([[[0.9, 0.1], [0.2, 0.8]]])
+    rowm, colm = nd.contrib.bipartite_matching(score, threshold=0.5)
+    np.testing.assert_allclose(rowm.asnumpy(), [[0., 1.]])
+    np.testing.assert_allclose(colm.asnumpy(), [[0., 1.]])
+    # below threshold -> unmatched
+    rowm2, _ = nd.contrib.bipartite_matching(
+        nd.array([[[0.4, 0.1], [0.2, 0.3]]]), threshold=0.5)
+    assert (rowm2.asnumpy() == -1).all()
+
+
+def test_roi_pooling():
+    feat = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = nd.array([[0, 0, 0, 7, 7]])
+    out = nd.ROIPooling(feat, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[27, 31], [59, 63]])
+
+
+def test_roi_align_matches_interior():
+    feat = nd.array(np.ones((1, 2, 8, 8), np.float32) * 3.0)
+    rois = nd.array([[0, 1, 1, 6, 6]])
+    out = nd.contrib.ROIAlign(feat, rois, pooled_size=(3, 3),
+                              spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out, np.full((1, 2, 3, 3), 3.0), atol=1e-5)
+
+
+def test_psroi_pooling_constant():
+    # constant per position-sensitive channel -> each output channel constant
+    data = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    for d in range(2):
+        for g in range(9):
+            data[0, d * 9 + g] = d * 10 + g
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array([[0, 0, 0, 5, 5]]),
+                                  spatial_scale=1.0, output_dim=2,
+                                  pooled_size=3).asnumpy()
+    expect = np.arange(9).reshape(3, 3)
+    np.testing.assert_allclose(out[0, 0], expect, atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], expect + 10, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    dat = nd.array(rng.randn(2, 4, 6, 6).astype(np.float32))
+    off = nd.zeros((2, 2 * 9, 6, 6))
+    wt = nd.array(rng.randn(8, 4, 3, 3).astype(np.float32))
+    dc = nd.contrib.DeformableConvolution(dat, off, wt, kernel=(3, 3),
+                                          pad=(1, 1), num_filter=8,
+                                          no_bias=True)
+    conv = nd.Convolution(dat, wt, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                          no_bias=True)
+    np.testing.assert_allclose(dc.asnumpy(), conv.asnumpy(), atol=1e-4)
+
+
+def test_deformable_conv_shift_offset():
+    # offset of exactly +1 in x == shifting the sampled image
+    dat = np.zeros((1, 1, 5, 5), np.float32)
+    dat[0, 0, 2, 3] = 1.0
+    off = np.zeros((1, 2, 5, 5), np.float32)
+    off[0, 1] = 1.0                              # dx = +1 for the 1x1 tap
+    wt = np.ones((1, 1, 1, 1), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(dat), nd.array(off), nd.array(wt), kernel=(1, 1),
+        num_filter=1, no_bias=True).asnumpy()
+    assert out[0, 0, 2, 2] == 1.0 and out[0, 0, 2, 3] == 0.0
+
+
+def test_proposal_shapes_and_batch_index():
+    rng = np.random.RandomState(0)
+    cls_prob = nd.array(rng.rand(1, 2 * 12, 4, 4).astype(np.float32))
+    bbox = nd.array((rng.randn(1, 4 * 12, 4, 4) * 0.1).astype(np.float32))
+    iminfo = nd.array([[64., 64., 1.0]])
+    rois = nd.contrib.Proposal(cls_prob, bbox, iminfo,
+                               rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:3] >= 0).all() and (r[:, 3:] <= 63).all()
+    rois2, scores = nd.contrib.Proposal(cls_prob, bbox, iminfo,
+                                        rpn_pre_nms_top_n=50,
+                                        rpn_post_nms_top_n=10,
+                                        output_score=True)
+    assert scores.shape == (10, 1)
+
+
+def test_multi_proposal_batch():
+    rng = np.random.RandomState(1)
+    cls_prob = nd.array(rng.rand(2, 24, 4, 4).astype(np.float32))
+    bbox = nd.array((rng.randn(2, 48, 4, 4) * 0.1).astype(np.float32))
+    iminfo = nd.array([[64., 64., 1.], [64., 64., 1.]])
+    rois = nd.contrib.MultiProposal(cls_prob, bbox, iminfo,
+                                    rpn_pre_nms_top_n=50,
+                                    rpn_post_nms_top_n=5).asnumpy()
+    assert rois.shape == (10, 5)
+    assert (rois[:5, 0] == 0).all() and (rois[5:, 0] == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# misc contrib
+# ---------------------------------------------------------------------------
+def test_ctc_loss_simple():
+    # T=2, A=2 (blank=0, one symbol), label = [1]: paths for "1":
+    # (1,1), (1,blank), (blank,1) -> p = p1(1)p2(1)+p1(1)p2(0)+p1(0)p2(1)
+    logits = np.zeros((2, 1, 2), np.float32)     # uniform 0.5 probs
+    label = np.array([[1., 0.]], np.float32)
+    loss = nd.contrib.CTCLoss(nd.array(logits), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(loss[0], -np.log(0.75), atol=1e-5)
+
+
+def test_ctc_loss_blank_last():
+    logits = np.zeros((2, 1, 2), np.float32)
+    label = np.array([[0., -1.]], np.float32)    # symbol 0, blank = A-1
+    loss = nd.contrib.CTCLoss(nd.array(logits), nd.array(label),
+                              blank_label="last").asnumpy()
+    np.testing.assert_allclose(loss[0], -np.log(0.75), atol=1e-5)
+
+
+def test_ctc_loss_gradient_flows():
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.randn(6, 2, 5).astype(np.float32))
+    label = nd.array([[1, 2, 0], [3, 1, 2]])
+    data.attach_grad()
+    with mx.autograd.record():
+        loss = nd.contrib.CTCLoss(data, label)
+        s = loss.sum()
+    s.backward()
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0 and np.isfinite(g).all()
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    d = rng.randn(3, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(d))
+    assert f.shape == (3, 16)
+    back = nd.contrib.ifft(f).asnumpy() / 8
+    np.testing.assert_allclose(back, d, atol=1e-4)
+    # fft of constant = DC spike
+    c = nd.contrib.fft(nd.array(np.ones((1, 4), np.float32))).asnumpy()
+    np.testing.assert_allclose(c[0, 0], 4.0, atol=1e-5)
+    assert np.abs(c[0, 2:]).max() < 1e-5
+
+
+def test_count_sketch():
+    data = nd.array([[1., 2., 3.]])
+    h = nd.array([[0, 2, 0]])
+    s = nd.array([[1, -1, 1]])
+    out = nd.contrib.count_sketch(data, h, s, out_dim=3).asnumpy()
+    np.testing.assert_allclose(out, [[4., 0., -2.]])
+
+
+def test_khatri_rao():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([[1., 0.], [0., 1.]])
+    out = nd.khatri_rao(a, b).asnumpy()
+    np.testing.assert_allclose(out, [[1, 0], [0, 2], [3, 0], [0, 4]])
+
+
+def test_quadratic():
+    out = nd.contrib.quadratic(nd.array([1., 2.]), a=1, b=2, c=3).asnumpy()
+    np.testing.assert_allclose(out, [6., 11.])
+
+
+def test_div_sqrt_dim():
+    x = nd.array(np.ones((2, 16), np.float32))
+    out = nd.contrib.div_sqrt_dim(x).asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 16), 0.25), atol=1e-6)
+
+
+def test_adaptive_avg_pooling():
+    img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.contrib.AdaptiveAvgPooling2D(img, output_size=(2, 2)).asnumpy()
+    np.testing.assert_allclose(out.reshape(4), [2.5, 4.5, 10.5, 12.5])
+    glob = nd.contrib.AdaptiveAvgPooling2D(img).asnumpy()
+    np.testing.assert_allclose(glob.reshape(1), [7.5])
+
+
+def test_bilinear_resize():
+    img = nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = nd.contrib.BilinearResize2D(img, height=3, width=3).asnumpy()
+    np.testing.assert_allclose(out[0, 0],
+                               [[0, .5, 1], [1, 1.5, 2], [2, 2.5, 3]],
+                               atol=1e-6)
+
+
+def test_bilinear_resize_grad():
+    img = nd.array(np.random.rand(1, 1, 4, 4).astype(np.float32))
+    img.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.BilinearResize2D(img, height=8, width=8)
+        s = out.sum()
+    s.backward()
+    g = img.grad.asnumpy()
+    np.testing.assert_allclose(g.sum(), 64.0, rtol=1e-4)
+
+
+def test_channel_operator():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(1, 6, 1, 2) )
+    gmax = nd.contrib.ChannelOperator(x, op_type="Group_Max", group=3)
+    assert gmax.shape == (1, 2, 1, 2)
+    np.testing.assert_allclose(gmax.asnumpy()[0, :, 0, 0], [4., 10.])
+    sm = nd.contrib.ChannelOperator(x, op_type="Group_Softmax", group=3)
+    assert sm.shape == x.shape
+    s = sm.asnumpy().reshape(2, 3, 2).sum(axis=1)
+    np.testing.assert_allclose(s, np.ones((2, 2)), atol=1e-5)
+
+
+def test_symbol_contrib_compose():
+    data = mx.sym.var("data")
+    out = mx.sym.contrib.BilinearResize2D(data, height=4, width=4)
+    ex = out.bind(mx.cpu(), {"data": nd.ones((1, 1, 2, 2))})
+    y = ex.forward()[0]
+    assert y.shape == (1, 1, 4, 4)
