@@ -20,3 +20,35 @@ def populate(module_dict):
 
 
 populate(globals())
+
+
+def foreach(body, data, init_states):
+    """Run a user body over axis 0 of ``data``, threading loop states
+    (parity: python/mxnet/ndarray/contrib.py:101 / control_flow.cc:483).
+
+    ``body(data_i, states) -> (outs, new_states)``.  Returns (stacked outs,
+    final states).  Imperative form = the reference's per-step execution;
+    the symbolic form (sym.contrib.foreach) lowers to ``lax.scan``.
+    """
+    from . import ndarray as _nd
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    data_list = [data] if single_data else list(data)
+    states = init_states if single_state else list(init_states)
+    n = data_list[0].shape[0]
+    collected = None
+    single_out = False
+    for i in range(n):
+        xs = [d[i] for d in data_list]
+        outs, states = body(xs[0] if single_data else xs, states)
+        single_out = not isinstance(outs, (list, tuple))
+        outs = [outs] if single_out else list(outs)
+        if collected is None:
+            collected = [[] for _ in outs]
+        for slot, o in zip(collected, outs):
+            slot.append(o)
+    if collected is None:
+        raise ValueError("foreach: empty data")
+    stacked = [_nd.imperative_invoke("stack", *slot, axis=0, num_args=len(slot))
+               for slot in collected]
+    return (stacked[0] if single_out else stacked), states

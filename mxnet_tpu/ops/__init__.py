@@ -19,3 +19,6 @@ from . import shape_hints   # noqa: F401  (installs arg names + infer hints)
 from . import vision_fork   # noqa: F401  (yangyu12 fork custom vision ops)
 from . import contrib_det   # noqa: F401  (SSD/RCNN detection contrib ops)
 from . import contrib_misc  # noqa: F401  (CTC/FFT/resize/… contrib ops)
+from . import linalg        # noqa: F401  (_linalg_* BLAS3/LAPACK family)
+from . import spatial       # noqa: F401  (STN/correlation/SVM ops)
+from . import control_flow  # noqa: F401  (_foreach scan op)

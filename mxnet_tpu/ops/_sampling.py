@@ -1,0 +1,34 @@
+"""Shared bilinear-sampling kernel for the gather-based spatial ops.
+
+One definition serves ROIAlign, DeformableConvolution/DeformablePSROIPooling
+(contrib_det.py) and BilinearSampler/SpatialTransformer (spatial.py) — the
+reference implements this gather five times over (roi_align.cc,
+deformable_im2col.h, bilinear_sampler.cc, spatial_transformer.cc,
+deformable_psroi_pooling.cc); here it is a single XLA-fusable program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bilinear_sample(img, ys, xs):
+    """Bilinear-sample ``img (C, H, W)`` at float coords, zero outside.
+
+    ``ys``/``xs`` may be any (matching) shape S; returns ``(C,) + S``.
+    Out-of-range taps contribute zero (the between-boundary rule shared by
+    all the reference samplers).
+    """
+    h, w = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    fy = ys - y0
+    fx = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - fy), (1, fy)):
+        for dx, wx in ((0, 1 - fx), (1, fx)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            v = img[:, jnp.clip(yy, 0, h - 1), jnp.clip(xx, 0, w - 1)]
+            out = out + v * (wy * wx * inb)[None]
+    return out

@@ -36,6 +36,7 @@ from jax import lax
 
 from ..base import MXNetError
 from .registry import register, param
+from ._sampling import bilinear_sample as _bilinear_sample
 
 BIG_NEG = -1e30
 
@@ -366,7 +367,10 @@ def _box_nms(attrs, data):
             b = (_corner_to_center(b) if attrs["out_format"] == "center"
                  else _center_to_corner(b))
             out_rows = out_rows.at[:, cs:cs + 4].set(b)
-        out_rows = jnp.where(keep[:, None], out_rows, -1.0)
+        # compact survivors to the front (preserving score order); the
+        # trailing rows are all -1
+        perm = jnp.argsort(~keep)
+        out_rows = jnp.where(keep[perm][:, None], out_rows[perm], -1.0)
         return out_rows, jnp.sum(valid).astype(rows.dtype)[None]
 
     out, count = jax.vmap(one)(flat)
@@ -491,7 +495,9 @@ def _proposal_impl(attrs, score, bbox_deltas, im_info):
                 ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
     scores = jnp.where(keep_size, scores, BIG_NEG)
 
-    pre_n = min(attrs["rpn_pre_nms_top_n"], boxes.shape[0])
+    pre_n = attrs["rpn_pre_nms_top_n"]
+    # non-positive means "keep all" (reference proposal-inl.h convention)
+    pre_n = boxes.shape[0] if pre_n <= 0 else min(pre_n, boxes.shape[0])
     post_n = attrs["rpn_post_nms_top_n"]
     top_scores, order = lax.top_k(scores, pre_n)
     top_boxes = boxes[order]
@@ -609,27 +615,6 @@ def _roi_pooling(attrs, data, rois):
         return jnp.where(empty[None], 0.0, out)
 
     return jax.vmap(one)(rois).astype(data.dtype)
-
-
-def _bilinear_sample(img, ys, xs):
-    """Bilinear sample img (C, H, W) at float coords; zero outside.
-    ys/xs any shape; returns (C,) + shape."""
-    h, w = img.shape[-2], img.shape[-1]
-    y0 = jnp.floor(ys)
-    x0 = jnp.floor(xs)
-    wy1 = ys - y0
-    wx1 = xs - x0
-    out = 0.0
-    for dy, wy in ((0, 1 - wy1), (1, wy1)):
-        for dx, wx in ((0, 1 - wx1), (1, wx1)):
-            yy = (y0 + dy).astype(jnp.int32)
-            xx = (x0 + dx).astype(jnp.int32)
-            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
-            yc = jnp.clip(yy, 0, h - 1)
-            xc = jnp.clip(xx, 0, w - 1)
-            v = img[:, yc, xc]
-            out = out + v * (wy * wx * inb)[None]
-    return out
 
 
 @register("_contrib_ROIAlign", nin=2, aliases=("ROIAlign",),

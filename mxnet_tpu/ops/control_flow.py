@@ -1,0 +1,78 @@
+"""Control-flow operator: ``_foreach``.
+
+Reference analog: ``src/operator/control_flow.cc:483`` (the ``_foreach`` op:
+runs a subgraph over axis 0 of the scan inputs, threading loop states) with
+Python front-ends ``mx.nd.contrib.foreach`` / ``mx.sym.contrib.foreach``
+(python/mxnet/{ndarray,symbol}/contrib.py:101,157).
+
+TPU-native design: the symbolic form lowers to ``lax.scan`` — the XLA-native
+loop primitive — with the body subgraph traced once through the executor's
+graph plan (no per-iteration dispatch, unlike the reference's CachedOp-per-
+step execution).  The subgraph travels in the node attrs as symbol JSON so
+graphs containing ``_foreach`` stay JSON-serializable like the reference's.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, param
+
+
+@functools.lru_cache(maxsize=64)
+def _load_plan(subgraph_json: str, train: bool):
+    from ..symbol.symbol import load_json
+    from ..executor import _Plan
+    return _Plan(load_json(subgraph_json), train=train)
+
+
+def _names(attrs, key):
+    v = attrs.get(key, ())
+    if isinstance(v, str):
+        v = tuple(ast.literal_eval(v))
+    return tuple(v)
+
+
+@register("_foreach", nin=-1, train_aware=True,
+          nout=lambda attrs: int(attrs["num_outputs"]),
+          params={"num_data": param(int, 1),
+                  "num_states": param(int, 0),
+                  "num_out_data": param(int, 1),
+                  "num_outputs": param(int, 1)})
+def _foreach(attrs, *arrays):
+    """Scan the body subgraph over axis 0 of the data inputs.
+
+    Inputs: [data..., init_states..., free_vars...]; outputs:
+    [stacked out_data..., final_states...].
+    """
+    nd_, ns = attrs["num_data"], attrs["num_states"]
+    n_out_data = attrs["num_out_data"]
+    data = arrays[:nd_]
+    states = tuple(arrays[nd_:nd_ + ns])
+    free = arrays[nd_ + ns:]
+    data_names = _names(attrs, "data_names")
+    state_names = _names(attrs, "state_names")
+    free_names = _names(attrs, "free_names")
+    if len(free) != len(free_names):
+        raise MXNetError("_foreach: free-variable count mismatch (%d vs %d)"
+                         % (len(free), len(free_names)))
+    plan = _load_plan(attrs["subgraph"], bool(attrs.get("__train__", False)))
+    if plan.n_rng:
+        raise MXNetError("_foreach: random ops inside the loop body are not "
+                         "supported yet")
+    free_vals = dict(zip(free_names, free))
+
+    def step(carry, xs):
+        arg_vals = dict(zip(data_names, xs))
+        arg_vals.update(zip(state_names, carry))
+        arg_vals.update(free_vals)
+        outs, _ = plan.execute(arg_vals, {}, keys=None)
+        return tuple(outs[n_out_data:]), tuple(outs[:n_out_data])
+
+    final_states, stacked = lax.scan(step, states, tuple(data))
+    out = tuple(stacked) + tuple(final_states)
+    return out if len(out) > 1 else out[0]

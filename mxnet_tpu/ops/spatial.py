@@ -1,0 +1,199 @@
+"""Spatial-transform ops: BilinearSampler, GridGenerator, SpatialTransformer,
+Correlation, SVMOutput.
+
+Reference analogs:
+- ``BilinearSampler`` — src/operator/bilinear_sampler-inl.h (STN sampler:
+  grid (N, 2, Ho, Wo) with channel 0 = x, 1 = y in [-1, 1]; zero padding
+  outside).
+- ``GridGenerator`` — src/operator/grid_generator-inl.h:56-130 (affine:
+  (N, 6) theta x normalized target grid; warp: optical flow + identity,
+  normalized).
+- ``SpatialTransformer`` — src/operator/spatial_transformer-inl.h:59-63
+  (= affine GridGenerator + BilinearSampler fused).
+- ``Correlation`` — src/operator/correlation-inl.h:53-63, correlation.cc:
+  41-82 (FlowNet cost volume: displacement-grid inner products,
+  normalized by kernel²·C).
+- ``SVMOutput`` — src/operator/svm_output-inl.h:56-62, svm_output.cc:30-67
+  (identity forward; L1/L2 margin hinge gradient as custom VJP).
+
+TPU-native design: the samplers are gather+weight tensor programs (vmapped
+over batch) and the correlation op is a static displacement-grid loop of
+elementwise multiplies + channel reductions — all static shapes, XLA-fusable,
+gradients via jax.vjp (reference hand-writes each backward kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, param
+from ._sampling import bilinear_sample
+
+
+@register("BilinearSampler", nin=2, aliases=("bilinearsampler",))
+def _bilinear_sampler(attrs, data, grid):
+    """STN bilinear sampler: data (N, C, H, W), grid (N, 2, Ho, Wo) with
+    x = grid[:, 0], y = grid[:, 1] in [-1, 1]."""
+    h, w = data.shape[2], data.shape[3]
+
+    def one(img, g):
+        xs = (g[0] + 1.0) * (w - 1) / 2.0
+        ys = (g[1] + 1.0) * (h - 1) / 2.0
+        return bilinear_sample(img, ys, xs)
+
+    return jax.vmap(one)(data, grid).astype(data.dtype)
+
+
+@register("GridGenerator", nin=1, nout=2, visible=1,
+          aliases=("gridgenerator",),
+          params={"transform_type": param(["affine", "warp"], None,
+                                          required=True),
+                  "target_shape": param("shape", (0, 0))})
+def _grid_generator(attrs, data):
+    """Sampling-grid generator (grid_generator-inl.h:86-130).
+
+    affine: data (N, 6) -> grid (N, 2, H, W) = theta @ [x_t; y_t; 1]
+    warp:   data = flow (N, 2, H, W) -> (flow + pixel grid) normalized
+    Second (hidden) output is the reference's grid_dst workspace.
+    """
+    if attrs["transform_type"] == "affine":
+        th, tw = attrs["target_shape"]
+        xs = -1.0 + np.arange(tw) * (2.0 / (tw - 1)) if tw > 1 \
+            else np.zeros(tw)
+        ys = -1.0 + np.arange(th) * (2.0 / (th - 1)) if th > 1 \
+            else np.zeros(th)
+        gx, gy = np.meshgrid(xs, ys)
+        dst = jnp.asarray(np.stack([gx.ravel(), gy.ravel(),
+                                    np.ones(th * tw)], 0), data.dtype)
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.matmul(theta, dst,
+                         precision=lax.Precision.HIGHEST).reshape(-1, 2, th, tw)
+        return out.astype(data.dtype), dst
+    # warp
+    n, _, h, w = data.shape
+    gx, gy = np.meshgrid(np.arange(w, dtype=np.float32),
+                         np.arange(h, dtype=np.float32))
+    base = jnp.asarray(np.stack([gx, gy], 0), data.dtype)   # (2, H, W)
+    denom = jnp.asarray(
+        np.array([(w - 1) / 2.0, (h - 1) / 2.0], np.float32)
+    ).reshape(1, 2, 1, 1)
+    out = (data + base[None]) / denom - 1.0
+    return out.astype(data.dtype), base
+
+
+@register("SpatialTransformer", nin=2, nout=2, visible=1,
+          aliases=("spatialtransformer",),
+          params={"target_shape": param("shape", (0, 0)),
+                  "transform_type": param(["affine"], "affine"),
+                  "sampler_type": param(["bilinear"], "bilinear")})
+def _spatial_transformer(attrs, data, loc):
+    """Affine STN (spatial_transformer-inl.h): grid = theta @ target grid,
+    then bilinear sampling of data.  loc (N, 6)."""
+    th, tw = attrs["target_shape"]
+    h, w = data.shape[2], data.shape[3]
+    xs = -1.0 + np.arange(tw) * (2.0 / (tw - 1)) if tw > 1 else np.zeros(tw)
+    ys = -1.0 + np.arange(th) * (2.0 / (th - 1)) if th > 1 else np.zeros(th)
+    gx, gy = np.meshgrid(xs, ys)
+    dst = jnp.asarray(np.stack([gx.ravel(), gy.ravel(), np.ones(th * tw)], 0),
+                      data.dtype)
+    grid = jnp.matmul(loc.reshape(-1, 2, 3), dst,
+                      precision=lax.Precision.HIGHEST)   # (N, 2, th*tw)
+
+    def one(img, g):
+        xr = (g[0] + 1.0) * (w - 1) / 2.0
+        yr = (g[1] + 1.0) * (h - 1) / 2.0
+        return bilinear_sample(img, yr, xr)
+
+    out = jax.vmap(one)(data, grid)                         # (N, C, th*tw)
+    out = out.reshape(data.shape[0], data.shape[1], th, tw)
+    return out.astype(data.dtype), grid.reshape(-1, 2, th, tw)
+
+
+@register("Correlation", nin=2, nout=3, visible=1,
+          aliases=("correlation",),
+          params={"kernel_size": param(int, 1),
+                  "max_displacement": param(int, 1),
+                  "stride1": param(int, 1),
+                  "stride2": param(int, 1),
+                  "pad_size": param(int, 0),
+                  "is_multiply": param(bool, True)})
+def _correlation(attrs, data1, data2):
+    """FlowNet correlation / cost volume (correlation.cc:41-82).
+
+    out[n, (p,o), i, j] = sum over kernel window & channels of
+    data1[window at (i,j)] * data2[window shifted by (p,o)*stride2],
+    normalized by kernel²·C.  Hidden outputs = the reference's padded
+    workspaces (tmp1, tmp2).
+    """
+    ks = attrs["kernel_size"]
+    md = attrs["max_displacement"]
+    s1, s2 = attrs["stride1"], attrs["stride2"]
+    pad = attrs["pad_size"]
+    kr = (ks - 1) // 2
+    border = md + kr
+    n, c, h, w = data1.shape
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_h = int(np.ceil((ph - 2 * border) / s1))
+    top_w = int(np.ceil((pw - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    sumelems = ks * ks * c
+
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    iy = md + np.arange(top_h) * s1
+    ix = md + np.arange(top_w) * s1
+
+    outs = []
+    for p in range(-ngr, ngr + 1):
+        for o in range(-ngr, ngr + 1):
+            acc = 0.0
+            for kh in range(ks):
+                for kw in range(ks):
+                    a = d1[:, :, iy + kh][:, :, :, ix + kw]
+                    b = d2[:, :, iy + kh + p * s2][:, :, :, ix + kw + o * s2]
+                    if attrs["is_multiply"]:
+                        acc = acc + jnp.sum(a * b, axis=1)
+                    else:
+                        acc = acc + jnp.sum(jnp.abs(a - b), axis=1)
+            outs.append(acc / sumelems)
+    out = jnp.stack(outs, axis=1).astype(data1.dtype)       # (N, ngw², th, tw)
+    return out, d1, d2
+
+
+@register("SVMOutput", nin=2, aliases=("svmoutput",),
+          params={"margin": param(float, 1.0),
+                  "regularization_coefficient": param(float, 1.0),
+                  "use_linear": param(bool, False)})
+def _svm_output(attrs, data, label):
+    """SVM output layer (svm_output.cc:30-67): identity forward; backward
+    is the L1/L2 margin hinge gradient (incoming head gradient ignored,
+    like SoftmaxOutput)."""
+    margin = attrs["margin"]
+    reg = attrs["regularization_coefficient"]
+    l2 = not attrs["use_linear"]
+
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return d
+
+    def _fwd_fwd(d, l):
+        return d, (d, l)
+
+    def _fwd_bwd(res, g):
+        d, l = res
+        lab = l.astype(jnp.int32)
+        is_k = jax.nn.one_hot(lab, d.shape[1], dtype=bool, axis=-1)
+        if l2:
+            gk = jnp.where(margin > d, -2.0 * reg * (margin - d), 0.0)
+            gx = jnp.where(margin > -d, 2.0 * reg * (margin + d), 0.0)
+        else:
+            gk = -reg * (margin > d)
+            gx = reg * (margin > -d)
+        grad = jnp.where(is_k, gk, gx).astype(d.dtype)
+        return grad, jnp.zeros_like(l)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data, label)

@@ -22,3 +22,54 @@ def populate(module_dict):
 
 
 populate(globals())
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Symbolic scan (parity: python/mxnet/symbol/contrib.py:157): builds a
+    ``_foreach`` node whose body subgraph lowers to ``lax.scan``.
+
+    ``body(data_sym, states) -> (outs, new_states)``; free variables of the
+    body (weights etc.) are detected from the subgraph and wired as extra
+    loop-invariant inputs.
+    """
+    from .symbol import Symbol, Group, var, _create
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    data_list = [data] if single_data else list(data)
+    state_list = [init_states] if single_state else list(init_states)
+
+    data_vars = [var("%s_data%d" % (name, i)) for i in range(len(data_list))]
+    state_vars = [var("%s_state%d" % (name, i))
+                  for i in range(len(state_list))]
+    outs, new_states = body(data_vars[0] if single_data else data_vars,
+                            state_vars[0] if single_state else state_vars)
+    single_out = not isinstance(outs, (list, tuple))
+    out_list = [outs] if single_out else list(outs)
+    ns_list = [new_states] if not isinstance(new_states, (list, tuple)) \
+        else list(new_states)
+    if len(ns_list) != len(state_list):
+        raise ValueError("foreach: body must return as many states as "
+                         "init_states")
+    sub = Group(out_list + ns_list)
+
+    data_names = tuple(s.name for s in data_vars)
+    state_names = tuple(s.name for s in state_vars)
+    placeholders = set(data_names) | set(state_names)
+    free_nodes = [n for n in sub._topo()
+                  if n.is_var and n.name not in placeholders]
+    free_names = tuple(n.name for n in free_nodes)
+    free_syms = [Symbol([(n, 0)]) for n in free_nodes]
+
+    node = _create("_foreach", data_list + state_list + free_syms,
+                   {"num_data": len(data_list),
+                    "num_states": len(state_list),
+                    "num_out_data": len(out_list),
+                    "num_outputs": len(out_list) + len(ns_list),
+                    "data_names": list(data_names),
+                    "state_names": list(state_names),
+                    "free_names": list(free_names),
+                    "subgraph": sub.tojson()}, name=name)
+    outputs = [node[i] for i in range(len(out_list))]
+    states_out = [node[len(out_list) + i] for i in range(len(ns_list))]
+    return ((outputs[0] if single_out else outputs),
+            (states_out[0] if single_state else states_out))

@@ -138,9 +138,10 @@ def test_box_nms():
     out, = [nd.contrib.box_nms(dets, overlap_thresh=0.5, id_index=0)]
     o = out.asnumpy()[0]
     assert o.shape == (3, 6)
+    # survivors compacted to the front in score order; trailing rows -1
     np.testing.assert_allclose(o[0, 1], 0.9)
-    assert (o[1] == -1).all()                    # suppressed duplicate
-    np.testing.assert_allclose(o[2, 1], 0.7)     # different class survives
+    np.testing.assert_allclose(o[1, 1], 0.7)     # different class survives
+    assert (o[2] == -1).all()                    # suppressed duplicate gone
 
 
 def test_box_nms_valid_thresh_topk():

@@ -1,0 +1,230 @@
+"""Tests for linalg ops, spatial-transform ops, and _foreach control flow.
+
+Parity model: reference tests/python/unittest/test_operator.py sections
+test_laop*, test_stn, test_bilinear_sampler, test_grid_generator,
+test_correlation, test_svmoutput; tests/python/unittest/test_contrib_control_flow.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestLinalg:
+    def test_gemm(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        c = rng.randn(2, 3, 5).astype(np.float32)
+        out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                             alpha=2.0, beta=0.5).asnumpy()
+        np.testing.assert_allclose(out, 2 * np.matmul(a, b) + 0.5 * c,
+                                   rtol=1e-4, atol=1e-4)
+        # transpose flags
+        out2 = nd.linalg_gemm2(nd.array(a), nd.array(c),
+                               transpose_a=True, transpose_b=False).asnumpy()
+        np.testing.assert_allclose(
+            out2, np.matmul(a.transpose(0, 2, 1), c), rtol=1e-4, atol=1e-4)
+
+    def test_potrf_potri(self):
+        spd = np.array([[[4., 2.], [2., 3.]]], np.float32)
+        l = nd.linalg_potrf(nd.array(spd))
+        lv = l.asnumpy()
+        np.testing.assert_allclose(np.matmul(lv, lv.transpose(0, 2, 1)), spd,
+                                   atol=1e-4)
+        assert np.allclose(np.triu(lv[0], 1), 0)
+        inv = nd.linalg_potri(l).asnumpy()
+        np.testing.assert_allclose(np.matmul(inv, spd),
+                                   np.eye(2)[None], atol=1e-3)
+
+    def test_trmm_trsm(self):
+        rng = np.random.RandomState(1)
+        l = np.tril(rng.rand(1, 3, 3) + 1.0).astype(np.float32)
+        b = rng.randn(1, 3, 2).astype(np.float32)
+        tr = nd.linalg_trmm(nd.array(l), nd.array(b)).asnumpy()
+        np.testing.assert_allclose(tr, np.matmul(l, b), rtol=1e-4, atol=1e-4)
+        ts = nd.linalg_trsm(nd.array(l), nd.array(tr)).asnumpy()
+        np.testing.assert_allclose(ts, b, rtol=1e-3, atol=1e-3)
+        # rightside + transpose roundtrip
+        br = rng.randn(1, 2, 3).astype(np.float32)
+        tr2 = nd.linalg_trmm(nd.array(l), nd.array(br), rightside=True,
+                             transpose=True).asnumpy()
+        np.testing.assert_allclose(tr2, np.matmul(br, l.transpose(0, 2, 1)),
+                                   rtol=1e-4, atol=1e-4)
+        ts2 = nd.linalg_trsm(nd.array(l), nd.array(tr2), rightside=True,
+                             transpose=True).asnumpy()
+        np.testing.assert_allclose(ts2, br, rtol=1e-3, atol=1e-3)
+
+    def test_sumlogdiag_syrk(self):
+        spd = np.array([[[4., 2.], [2., 3.]]], np.float32)
+        out = nd.linalg_sumlogdiag(nd.array(spd)).asnumpy()
+        np.testing.assert_allclose(out, [np.log(4) + np.log(3)], rtol=1e-5)
+        a = np.random.RandomState(0).randn(1, 2, 4).astype(np.float32)
+        sy = nd.linalg_syrk(nd.array(a), alpha=1.5).asnumpy()
+        np.testing.assert_allclose(sy, 1.5 * np.matmul(a, a.transpose(0, 2, 1)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gelqf(self):
+        a = np.random.RandomState(2).randn(1, 2, 4).astype(np.float32)
+        l, q = nd.linalg_gelqf(nd.array(a))
+        lv, qv = l.asnumpy(), q.asnumpy()
+        np.testing.assert_allclose(np.matmul(lv, qv), a, atol=1e-3)
+        np.testing.assert_allclose(np.matmul(qv, qv.transpose(0, 2, 1)),
+                                   np.eye(2)[None], atol=1e-3)
+        assert np.allclose(np.triu(lv[0], 1), 0, atol=1e-5)
+        assert (np.diag(lv[0]) >= 0).all()
+
+    def test_syevd(self):
+        spd = np.array([[[4., 2.], [2., 3.]]], np.float32)
+        u, w = nd.linalg_syevd(nd.array(spd))
+        uv, wv = u.asnumpy(), w.asnumpy()
+        assert wv[0, 0] <= wv[0, 1]                       # ascending
+        rec = np.matmul(uv.transpose(0, 2, 1) * wv[:, None, :], uv)
+        np.testing.assert_allclose(rec, spd, atol=1e-3)
+
+    def test_gemm_gradient(self):
+        a = nd.array(np.random.rand(1, 2, 3).astype(np.float32))
+        b = nd.array(np.random.rand(1, 3, 2).astype(np.float32))
+        c = nd.array(np.zeros((1, 2, 2), np.float32))
+        a.attach_grad()
+        with mx.autograd.record():
+            out = nd.linalg_gemm(a, b, c)
+            s = out.sum()
+        s.backward()
+        expect = np.matmul(np.ones((1, 2, 2), np.float32),
+                           b.asnumpy().transpose(0, 2, 1))
+        np.testing.assert_allclose(a.grad.asnumpy(), expect, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestSpatial:
+    def test_bilinear_sampler_identity(self):
+        img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        grid = nd.array(np.stack([xs, ys])[None].astype(np.float32))
+        out = nd.BilinearSampler(img, grid).asnumpy()
+        np.testing.assert_allclose(out, img.asnumpy(), atol=1e-3)
+
+    def test_bilinear_sampler_outside_is_zero(self):
+        img = nd.array(np.ones((1, 1, 4, 4), np.float32))
+        grid = nd.array(np.full((1, 2, 2, 2), -3.0, np.float32))
+        out = nd.BilinearSampler(img, grid).asnumpy()
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_grid_generator_affine(self):
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        theta = nd.array([[1., 0., 0., 0., 1., 0.]])
+        out = nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 4)).asnumpy()
+        np.testing.assert_allclose(out[0, 0], xs, atol=1e-4)
+        np.testing.assert_allclose(out[0, 1], ys, atol=1e-4)
+        # translation shifts x by 0.5
+        theta2 = nd.array([[1., 0., 0.5, 0., 1., 0.]])
+        out2 = nd.GridGenerator(theta2, transform_type="affine",
+                                target_shape=(4, 4)).asnumpy()
+        np.testing.assert_allclose(out2[0, 0], xs + 0.5, atol=1e-4)
+
+    def test_grid_generator_warp(self):
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        out = nd.GridGenerator(nd.zeros((1, 2, 4, 4)),
+                               transform_type="warp").asnumpy()
+        np.testing.assert_allclose(out[0, 0], xs, atol=1e-5)
+        np.testing.assert_allclose(out[0, 1], ys, atol=1e-5)
+
+    def test_spatial_transformer_identity(self):
+        img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        theta = nd.array([[1., 0., 0., 0., 1., 0.]])
+        out = nd.SpatialTransformer(img, theta, target_shape=(4, 4)).asnumpy()
+        np.testing.assert_allclose(out, img.asnumpy(), atol=1e-3)
+
+    def test_spatial_transformer_grad(self):
+        img = nd.array(np.random.rand(1, 1, 4, 4).astype(np.float32))
+        theta = nd.array([[1., 0., 0.1, 0., 1., -0.1]])
+        img.attach_grad()
+        theta.attach_grad()
+        with mx.autograd.record():
+            out = nd.SpatialTransformer(img, theta, target_shape=(4, 4))
+            s = out.sum()
+        s.backward()
+        assert np.isfinite(img.grad.asnumpy()).all()
+        assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+    def test_correlation_self_center(self):
+        rng = np.random.RandomState(0)
+        d = nd.array(rng.randn(1, 3, 8, 8).astype(np.float32))
+        out = nd.Correlation(d, d, kernel_size=1, max_displacement=2,
+                             stride1=1, stride2=1, pad_size=2).asnumpy()
+        assert out.shape == (1, 25, 8, 8)
+        expect = (d.asnumpy() ** 2).sum(axis=1)[0] / 3
+        np.testing.assert_allclose(out[0, 12], expect, atol=1e-2, rtol=1e-2)
+
+    def test_correlation_subtract(self):
+        d = nd.array(np.ones((1, 2, 4, 4), np.float32))
+        out = nd.Correlation(d, d, kernel_size=1, max_displacement=1,
+                             stride1=1, stride2=1, pad_size=1,
+                             is_multiply=False).asnumpy()
+        # center displacement: |a-a| = 0
+        np.testing.assert_allclose(out[0, 4], 0.0, atol=1e-6)
+
+    def test_svm_output_l1(self):
+        dat = nd.array(np.array([[0.5, -0.5, 0.2]], np.float32))
+        lab = nd.array([0.])
+        dat.attach_grad()
+        with mx.autograd.record():
+            out = nd.SVMOutput(dat, lab, margin=1.0, use_linear=True)
+        np.testing.assert_allclose(out.asnumpy(), dat.asnumpy())
+        out.backward()
+        np.testing.assert_allclose(dat.grad.asnumpy(), [[-1., 1., 1.]])
+
+    def test_svm_output_l2(self):
+        dat = nd.array(np.array([[0.5, -2.0]], np.float32))
+        lab = nd.array([0.])
+        dat.attach_grad()
+        with mx.autograd.record():
+            out = nd.SVMOutput(dat, lab, margin=1.0)
+        out.backward()
+        g = dat.grad.asnumpy()
+        # k: margin > 0.5 -> -2*(1-0.5) = -1; other: margin > 2.0 false -> 0
+        np.testing.assert_allclose(g, [[-1., 0.]], atol=1e-5)
+
+
+class TestForeach:
+    def test_foreach_imperative(self):
+        data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+        outs, states = nd.contrib.foreach(
+            lambda x, s: (x + s[0], [x + s[0]]), data, [nd.zeros((2,))])
+        np.testing.assert_allclose(states[0].asnumpy(), [6., 9.])
+        np.testing.assert_allclose(outs.asnumpy()[-1], [6., 9.])
+        assert outs.shape == (3, 2)
+
+    def test_foreach_symbolic_scan(self):
+        import mxnet_tpu.symbol as sym
+        data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+        d = sym.var("d")
+        w = sym.var("w")
+        outs_s, st_s = sym.contrib.foreach(
+            lambda x, s: (x * w + s[0], [x * w + s[0]]), d, [sym.var("s0")])
+        ex = outs_s.bind(mx.cpu(), {"d": data, "s0": nd.zeros((2,)),
+                                    "w": nd.array([2., 1.])})
+        y = ex.forward()[0].asnumpy()
+        expect, s = [], np.zeros(2)
+        for i in range(3):
+            s = data.asnumpy()[i] * np.array([2., 1.]) + s
+            expect.append(s.copy())
+        np.testing.assert_allclose(y, np.stack(expect), rtol=1e-5)
+
+    def test_foreach_symbolic_json_roundtrip(self):
+        import mxnet_tpu.symbol as sym
+        d = sym.var("d")
+        outs_s, _ = sym.contrib.foreach(
+            lambda x, s: (x * 2.0, [s[0] + x.sum()]), d, [sym.var("s0")])
+        js = outs_s.tojson()
+        back = sym.load_json(js)
+        data = nd.array(np.ones((2, 3), np.float32))
+        ex = back.bind(mx.cpu(), {"d": data, "s0": nd.zeros((1,))})
+        y = ex.forward()[0].asnumpy()
+        np.testing.assert_allclose(y, np.full((2, 3), 2.0))
