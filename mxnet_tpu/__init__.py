@@ -43,6 +43,7 @@ from . import module
 from . import module as mod
 from . import model
 from .model import FeedForward
+from . import contrib
 
 
 def kvstore_create(name="local"):

@@ -1,0 +1,3 @@
+"""Contrib package (parity: python/mxnet/contrib/): quantization,
+text utilities, ONNX import, experimental APIs."""
+from . import quantization  # noqa: F401

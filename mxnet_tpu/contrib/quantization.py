@@ -1,0 +1,280 @@
+"""INT8 model quantization: graph pass + calibration.
+
+Reference analog: ``python/mxnet/contrib/quantization.py`` (quantize_model,
+calib modes none/naive/entropy) driving the C++ graph pass
+``src/operator/quantization/quantize_graph_pass.cc``.
+
+Pipeline (same as reference):
+1. rewrite the symbol graph: supported ops (Convolution, FullyConnected,
+   Pooling, Flatten) become ``_contrib_quantized_*`` nodes fed by
+   ``_contrib_quantize`` (activations, on-the-fly min/max) and offline-
+   quantized weight/bias vars; each int32 accumulator goes through
+   ``_contrib_requantize`` (+calibrated ranges) and lazily through
+   ``_contrib_dequantize`` for fp32 consumers;
+2. quantize parameters offline (int8 + min/max vars);
+3. calibrate: run the fp32 graph on sample data collecting per-layer output
+   ranges — ``naive`` records min/max, ``entropy`` minimizes KL divergence
+   between the fp32 histogram and its int8 projection (the reference's
+   _get_optimal_threshold).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+QUANTIZABLE = {"Convolution", "FullyConnected", "Pooling", "Flatten",
+               "flatten"}
+
+
+def _symbol_of(node, idx=0):
+    from ..symbol.symbol import Symbol
+    return Symbol([(node, idx)])
+
+
+def quantize_graph(sym, excluded_sym_names: Sequence[str] = (),
+                   th_dict: Optional[Dict[str, Tuple[float, float]]] = None,
+                   quantized_dtype: str = "int8"):
+    """Rewrite ``sym`` into its int8 form.  Returns (qsym, offline_params)
+    where offline_params maps original param name -> role for
+    :func:`quantize_params`."""
+    from .. import symbol as S
+    from ..symbol.symbol import _create
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported "
+                         "(TPU MXU int8 path)")
+    th_dict = th_dict or {}
+    excluded = set(excluded_sym_names)
+
+    fp32: Dict[Tuple[int, int], object] = {}   # (node id, out idx) -> Symbol
+    qmemo: Dict[Tuple[int, int], Tuple] = {}   # -> (q, min, max) Symbols
+    offline: List[str] = []
+
+    def fp32_of(entry):
+        node, idx = entry
+        return fp32[(id(node), idx)]
+
+    def quantized_of(entry):
+        """int8 view of an entry: reuse producer's, else insert quantize."""
+        node, idx = entry
+        key = (id(node), idx)
+        if key in qmemo:
+            return qmemo[key]
+        data = fp32_of(entry)
+        mn = S.min(data)
+        mx = S.max(data)
+        q = S.contrib.quantize(data, mn, mx, out_type="int8")
+        qmemo[key] = (q[0], q[1], q[2])
+        return qmemo[key]
+
+    topo = sym._topo()
+    for node in topo:
+        if node.is_var:
+            fp32[(id(node), 0)] = _symbol_of(node)
+            continue
+        op_name = node.op.name
+        ins = node.inputs
+        if op_name == "Convolution" and node.name not in excluded or \
+                op_name == "FullyConnected" and node.name not in excluded:
+            no_bias = str(node.attrs.get("no_bias", "False")).lower() in \
+                ("1", "true")
+            qd, dmin, dmax = quantized_of(ins[0])
+            wnode = ins[1][0]
+            if not wnode.is_var:
+                raise MXNetError("quantization: %s weight must be a "
+                                 "variable" % node.name)
+            qw = S.var(wnode.name + "_quantize")
+            wmin = S.var(wnode.name + "_min")
+            wmax = S.var(wnode.name + "_max")
+            offline.append(wnode.name)
+            inputs = [qd, qw]
+            tail = [dmin, dmax, wmin, wmax]
+            if not no_bias:
+                bnode = ins[2][0]
+                qb = S.var(bnode.name + "_quantize")
+                bmin = S.var(bnode.name + "_min")
+                bmax = S.var(bnode.name + "_max")
+                offline.append(bnode.name)
+                inputs.append(qb)
+                tail += [bmin, bmax]
+            qop = "_contrib_quantized_conv" if op_name == "Convolution" \
+                else "_contrib_quantized_fully_connected"
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            node_q = _create(qop, inputs + tail, attrs,
+                             name=node.name + "_quantize")
+            rq_attrs = {}
+            if node.name in th_dict:
+                mn_c, mx_c = th_dict[node.name]
+                rq_attrs = {"min_calib_range": float(mn_c),
+                            "max_calib_range": float(mx_c)}
+            rq = _create("_contrib_requantize",
+                         [node_q[0], node_q[1], node_q[2]], rq_attrs,
+                         name=node.name + "_requantize")
+            qmemo[(id(node), 0)] = (rq[0], rq[1], rq[2])
+            fp32[(id(node), 0)] = S.contrib.dequantize(rq[0], rq[1], rq[2])
+            continue
+        pool_ok = op_name != "Pooling" or \
+            str(node.attrs.get("pool_type", "max")) in ("max", "avg")
+        if op_name in ("Pooling", "Flatten", "flatten") and pool_ok and \
+                node.name not in excluded and \
+                (id(ins[0][0]), ins[0][1]) in qmemo:
+            # stay int8 when the producer is already quantized
+            q, mn, mx = qmemo[(id(ins[0][0]), ins[0][1])]
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            qop = "_contrib_quantized_pooling" if op_name == "Pooling" \
+                else "_contrib_quantized_flatten"
+            node_q = _create(qop, [q, mn, mx], attrs,
+                             name=node.name + "_quantize")
+            qmemo[(id(node), 0)] = (node_q[0], node_q[1], node_q[2])
+            fp32[(id(node), 0)] = S.contrib.dequantize(
+                node_q[0], node_q[1], node_q[2])
+            continue
+        # default: rebuild the fp32 node on rewritten inputs
+        in_syms = [fp32_of(e) for e in ins]
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        new_node = _create(op_name, in_syms, attrs, name=node.name)
+        for i in range(node.num_outputs()):
+            fp32[(id(node), i)] = new_node[i] \
+                if node.num_outputs() > 1 else new_node
+
+    outs = [fp32_of(e) for e in sym._outputs]
+    qsym = outs[0] if len(outs) == 1 else S.Group(outs)
+    return qsym, offline
+
+
+def quantize_params(qsym, params):
+    """Offline int8 parameter quantization (reference _quantize_params):
+    for every ``X_quantize`` argument of ``qsym``, quantize ``params[X]``."""
+    from .. import nd
+    qargs = {}
+    arg_names = set(qsym.list_arguments())
+    for name in arg_names:
+        if name.endswith("_quantize"):
+            base = name[:-len("_quantize")]
+            val = params[base]
+            # route through the same op as activation quantization so the
+            # scale/round/clip convention has a single definition
+            q, mn, mx = nd.contrib.quantize(val, val.min(), val.max(),
+                                            out_type="int8")
+            qargs[name] = q
+            qargs[base + "_min"] = mn
+            qargs[base + "_max"] = mx
+        elif name in params:
+            qargs[name] = params[name]
+    return qargs
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+def _collect_layer_outputs(sym, arg_params, aux_params, ctx, calib_data,
+                           collect_names, num_calib_examples=None,
+                           data_names=("data",), label_names=("softmax_label",)):
+    """Run the fp32 graph over calib batches, returning {name: [np arrays]}
+    for each collected node output (reference _LayerOutputCollector)."""
+    from .. import symbol as S
+    from .. import nd
+    name_to_node = {}
+    for node in sym._topo():
+        if not node.is_var:
+            name_to_node[node.name] = node
+    out_syms = [_symbol_of(name_to_node[n]) for n in collect_names]
+    group = S.Group(out_syms)
+    collected = {n: [] for n in collect_names}
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        args = dict(arg_params)
+        for dn, arr in zip(data_names, batch.data):
+            args[dn] = arr
+        ex = group.bind(ctx, args, aux_states=dict(aux_params),
+                        grad_req="null")
+        outs = ex.forward(is_train=False)
+        for n, o in zip(collect_names, outs):
+            collected[n].append(o.asnumpy())
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return collected
+
+
+def _get_optimal_threshold(arr, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| (reference _get_optimal_threshold).
+
+    Builds a histogram of the fp32 values and picks the symmetric clip
+    threshold whose int8 projection minimizes KL(p || q).
+    """
+    a = np.abs(np.concatenate([x.ravel() for x in arr]))
+    amax = float(a.max()) if a.size else 1e-8
+    if amax < 1e-8:
+        return 1e-8
+    hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    # candidate thresholds sweep the upper half of the histogram
+    for i in range(num_quantized_bins // 2, num_bins + 1,
+                   max(1, num_bins // 64)):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()                     # clip outliers into edge
+        if p.sum() == 0:
+            continue
+        # project p onto num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        idx = (np.arange(i) / factor).astype(np.int64).clip(
+            0, num_quantized_bins - 1)
+        q_small = np.bincount(idx, weights=p, minlength=num_quantized_bins)
+        counts = np.bincount(idx, minlength=num_quantized_bins)
+        q = np.where(counts[idx] > 0, q_small[idx] / counts[idx], 0)
+        pn = p / p.sum()
+        qn = q / q.sum() if q.sum() > 0 else q
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(
+            pn[mask] / np.maximum(qn[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return max(best_t, 1e-8)
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a model (parity: python/mxnet/contrib/quantization.py
+    quantize_model).  Returns (qsym, qarg_params, aux_params)."""
+    from .. import context as _ctx_mod
+    ctx = ctx or _ctx_mod.current_context()
+    excluded = excluded_sym_names or []
+
+    th_dict = {}
+    if calib_mode and calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data is required for calib_mode=%r"
+                             % calib_mode)
+        collect = [n.name for n in sym._topo()
+                   if not n.is_var and n.op.name in
+                   ("Convolution", "FullyConnected")
+                   and n.name not in excluded]
+        outputs = _collect_layer_outputs(
+            sym, arg_params, aux_params, ctx, calib_data, collect,
+            num_calib_examples, data_names, label_names)
+        for name, arrs in outputs.items():
+            if calib_mode == "naive":
+                t = max(abs(float(np.min([a.min() for a in arrs]))),
+                        abs(float(np.max([a.max() for a in arrs]))))
+            elif calib_mode == "entropy":
+                t = _get_optimal_threshold(arrs)
+            else:
+                raise MXNetError("unknown calib_mode %r" % calib_mode)
+            th_dict[name] = (-t, t)
+            if logger:
+                logger.info("calibrated %s: threshold=%f", name, t)
+
+    qsym, _ = quantize_graph(sym, excluded, th_dict, quantized_dtype)
+    qarg_params = quantize_params(qsym, arg_params)
+    return qsym, qarg_params, aux_params

@@ -22,3 +22,4 @@ from . import contrib_misc  # noqa: F401  (CTC/FFT/resize/… contrib ops)
 from . import linalg        # noqa: F401  (_linalg_* BLAS3/LAPACK family)
 from . import spatial       # noqa: F401  (STN/correlation/SVM ops)
 from . import control_flow  # noqa: F401  (_foreach scan op)
+from . import quantization  # noqa: F401  (INT8 quantize/quantized_* ops)

@@ -165,7 +165,10 @@ def _pooling(attrs, data):
             (p, p + e) for p, e in zip(pad, extra))
     pt = attrs["pool_type"]
     if pt == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = -jnp.inf
+        else:  # typed scalar so reduce_window init matches operand dtype
+            init = np.asarray(jnp.iinfo(data.dtype).min, data.dtype)[()]
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
     ssum = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
     if pt == "sum":

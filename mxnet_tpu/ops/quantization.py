@@ -1,0 +1,193 @@
+"""INT8 quantization operators.
+
+Reference analogs (`src/operator/quantization/`, SURVEY.md N7 quantization/):
+- ``_contrib_quantize`` — quantize-inl.h:90-145 (uint8 affine / int8
+  zero-centered; emits (q, min, max)).
+- ``_contrib_dequantize`` — dequantize-inl.h.
+- ``_contrib_requantize`` — requantize-inl.h:40-90 (int32 -> int8 with
+  calibrated or on-the-fly real range).
+- ``_contrib_quantized_conv`` / ``_contrib_quantized_fully_connected`` —
+  quantized_conv.cc / quantized_fully_connected.cc (int8 x int8 -> int32
+  accumulation; output range = product ranges scaled to int32, the
+  QuantizationRangeForMultiplication convention of quantization_utils.h).
+- ``_contrib_quantized_pooling`` / ``_contrib_quantized_flatten`` —
+  quantized_pooling.cc / quantized_flatten.cc (range pass-through).
+
+Value convention (quantization_utils.h ``QuantizedToFloat``): a quantized
+tensor q with float range (min, max) represents ``q * MaxAbs(min,max)/Q``
+where Q = 127 for int8 and 2³¹-1 for int32.
+
+TPU-native design: int8 convolution/matmul lower to XLA ``dot``/``conv``
+HLOs with s8 operands and s32 accumulation — the MXU's native int8 path —
+instead of the reference's cuDNN int8 or CPU reference kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, param
+
+INT32_Q = float(2 ** 31 - 1)
+
+
+def _max_abs(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+@register("_contrib_quantize", nin=3, nout=3,
+          aliases=("quantize",),
+          params={"out_type": param(["int8", "uint8"], "int8")})
+def _quantize(attrs, data, min_range, max_range):
+    """fp32 -> int8/uint8 (quantize-inl.h:90-145).  min/max_range are
+    1-element float tensors (the observed/calibrated float range)."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if attrs["out_type"] == "int8":
+        # zero-centered: scale = 127 / MaxAbs(min, max)
+        t = _max_abs(mn, mx)
+        scale = 127.0 / jnp.maximum(t, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+        return q, -t.reshape(1), t.reshape(1)
+    # uint8 affine
+    scale = 255.0 / jnp.maximum(mx - mn, 1e-30)
+    q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+@register("_contrib_dequantize", nin=3,
+          aliases=("dequantize",),
+          params={"out_type": param(["float32"], "float32")})
+def _dequantize(attrs, data, min_range, max_range):
+    """int8/uint8/int32 -> fp32 (dequantize-inl.h)."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    q = INT32_Q if data.dtype == jnp.int32 else 127.0
+    return data.astype(jnp.float32) * (_max_abs(mn, mx) / q)
+
+
+@register("_contrib_requantize", nin=3, nout=3,
+          aliases=("requantize",),
+          params={"min_calib_range": param(float, None),
+                  "max_calib_range": param(float, None)})
+def _requantize(attrs, data, min_range, max_range):
+    """int32 -> int8 (requantize-inl.h:71-90): real range from calibration
+    when given, else from the actual tensor extrema."""
+    real = data.astype(jnp.float32) * \
+        (_max_abs(min_range.reshape(()), max_range.reshape(())) / INT32_Q)
+    if attrs["min_calib_range"] is not None and \
+            attrs["max_calib_range"] is not None:
+        t = jnp.asarray(max(abs(attrs["min_calib_range"]),
+                            abs(attrs["max_calib_range"])), jnp.float32)
+    else:
+        t = jnp.maximum(jnp.max(jnp.abs(real)), 1e-30)
+    q = jnp.clip(jnp.round(real * (127.0 / t)), -127, 127).astype(jnp.int8)
+    return q, (-t).reshape(1), t.reshape(1)
+
+
+def _range_for_multiplication(td, tw):
+    """Output float range of an int32 accumulator holding products of two
+    int8 tensors (quantization_utils.h QuantizationRangeForMultiplication):
+    s32 * T_out/(2³¹-1) == s32 * (Td/127) * (Tw/127)."""
+    return td * tw * INT32_Q / (127.0 * 127.0)
+
+
+def _bias_to_int32(bias_q, tb, td, tw):
+    """Re-scale an int8 bias (range Tb) into the s32 accumulator scale."""
+    scale = (tb / 127.0) / ((td / 127.0) * (tw / 127.0))
+    return jnp.round(bias_q.astype(jnp.float32) * scale).astype(jnp.int32)
+
+
+@register("_contrib_quantized_conv", nin=-1, nout=3,
+          params={"kernel": param("shape", None, required=True),
+                  "stride": param("shape", ()),
+                  "dilate": param("shape", ()),
+                  "pad": param("shape", ()),
+                  "num_filter": param(int, None, required=True),
+                  "num_group": param(int, 1),
+                  "no_bias": param(bool, False),
+                  "layout": param(str, None)})
+def _quantized_conv(attrs, data, weight, *rest):
+    """int8 conv -> int32 (quantized_conv.cc).  Inputs: data, weight,
+    [bias], min_data, max_data, min_weight, max_weight, [min_bias,
+    max_bias]."""
+    no_bias = attrs["no_bias"]
+    if no_bias:
+        (min_d, max_d, min_w, max_w), bias = rest, None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = rest
+    stride = attrs["stride"] or (1, 1)
+    dilate = attrs["dilate"] or (1, 1)
+    pad = attrs["pad"] or (0, 0)
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(stride), padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=attrs["num_group"],
+        preferred_element_type=jnp.int32)
+    td = _max_abs(min_d.reshape(()), max_d.reshape(()))
+    tw = _max_abs(min_w.reshape(()), max_w.reshape(()))
+    if bias is not None:
+        tb = _max_abs(min_b.reshape(()), max_b.reshape(()))
+        out = out + _bias_to_int32(bias, tb, td, tw).reshape(1, -1, 1, 1)
+    t_out = _range_for_multiplication(td, tw)
+    return out, (-t_out).reshape(1), t_out.reshape(1)
+
+
+@register("_contrib_quantized_fully_connected", nin=-1, nout=3,
+          params={"num_hidden": param(int, None, required=True),
+                  "no_bias": param(bool, False),
+                  "flatten": param(bool, True)})
+def _quantized_fully_connected(attrs, data, weight, *rest):
+    """int8 FC -> int32 (quantized_fully_connected.cc)."""
+    no_bias = attrs["no_bias"]
+    if no_bias:
+        (min_d, max_d, min_w, max_w), bias = rest, None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = rest
+    x = data.reshape(data.shape[0], -1) if attrs["flatten"] else data
+    out = jax.lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    td = _max_abs(min_d.reshape(()), max_d.reshape(()))
+    tw = _max_abs(min_w.reshape(()), max_w.reshape(()))
+    if bias is not None:
+        tb = _max_abs(min_b.reshape(()), max_b.reshape(()))
+        out = out + _bias_to_int32(bias, tb, td, tw)
+    t_out = _range_for_multiplication(td, tw)
+    return out, (-t_out).reshape(1), t_out.reshape(1)
+
+
+@register("_contrib_quantized_pooling", nin=3, nout=3,
+          params={"kernel": param("shape", ()),
+                  "pool_type": param(["max", "avg"], "max"),
+                  "global_pool": param(bool, False),
+                  "stride": param("shape", ()),
+                  "pad": param("shape", ()),
+                  "pooling_convention": param(["valid", "full"], "valid"),
+                  "count_include_pad": param(bool, True),
+                  "p_value": param(int, 2)})
+def _quantized_pooling(attrs, data, min_range, max_range):
+    """int8 pooling, range pass-through (quantized_pooling.cc)."""
+    from .nn import _pooling
+    if attrs["pool_type"] == "max":
+        out = _pooling(attrs, data.astype(jnp.int8))
+    else:
+        out = jnp.clip(jnp.round(_pooling(attrs, data.astype(jnp.float32))),
+                       -127, 127).astype(jnp.int8)
+    return out, min_range, max_range
+
+
+@register("_contrib_quantized_flatten", nin=3, nout=3)
+def _quantized_flatten(attrs, data, min_range, max_range):
+    """Flatten on quantized data, range pass-through
+    (quantized_flatten.cc)."""
+    return (data.reshape(data.shape[0], -1), min_range, max_range)
