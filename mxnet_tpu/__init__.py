@@ -12,6 +12,7 @@ from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus)
 from . import engine
+from . import operator  # registers the Custom op before namespace gen
 from . import ndarray
 from . import ndarray as nd
 from . import random

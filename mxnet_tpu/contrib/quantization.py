@@ -25,10 +25,6 @@ import numpy as np
 
 from ..base import MXNetError
 
-QUANTIZABLE = {"Convolution", "FullyConnected", "Pooling", "Flatten",
-               "flatten"}
-
-
 def _symbol_of(node, idx=0):
     from ..symbol.symbol import Symbol
     return Symbol([(node, idx)])
@@ -116,8 +112,10 @@ def quantize_graph(sym, excluded_sym_names: Sequence[str] = (),
             qmemo[(id(node), 0)] = (rq[0], rq[1], rq[2])
             fp32[(id(node), 0)] = S.contrib.dequantize(rq[0], rq[1], rq[2])
             continue
-        pool_ok = op_name != "Pooling" or \
-            str(node.attrs.get("pool_type", "max")) in ("max", "avg")
+        pool_ok = op_name != "Pooling" or (
+            str(node.attrs.get("pool_type", "max")) in ("max", "avg") and
+            str(node.attrs.get("pooling_convention", "valid")) in
+            ("valid", "full"))
         if op_name in ("Pooling", "Flatten", "flatten") and pool_ok and \
                 node.name not in excluded and \
                 (id(ins[0][0]), ins[0][1]) in qmemo:
@@ -188,12 +186,19 @@ def _collect_layer_outputs(sym, arg_params, aux_params, ctx, calib_data,
     collected = {n: [] for n in collect_names}
     seen = 0
     calib_data.reset()
+    ex = None
     for batch in calib_data:
-        args = dict(arg_params)
-        for dn, arr in zip(data_names, batch.data):
-            args[dn] = arr
-        ex = group.bind(ctx, args, aux_states=dict(aux_params),
-                        grad_req="null")
+        if ex is None:
+            # bind ONCE: a fresh Executor per batch would re-trace and
+            # re-compile the whole fp32 graph every iteration
+            args = dict(arg_params)
+            for dn, arr in zip(data_names, batch.data):
+                args[dn] = arr
+            ex = group.bind(ctx, args, aux_states=dict(aux_params),
+                            grad_req="null")
+        else:
+            for dn, arr in zip(data_names, batch.data):
+                ex.arg_dict[dn][:] = arr
         outs = ex.forward(is_train=False)
         for n, o in zip(collect_names, outs):
             collected[n].append(o.asnumpy())

@@ -60,30 +60,33 @@ class Cast(HybridBlock):
 
 
 class ToTensor(HybridBlock):
-    """uint8 HWC [0,255] image → float32 CHW [0,1) tensor."""
+    """uint8 HWC (or NHWC) [0,255] image → float32 CHW (NCHW) [0,1) tensor.
+
+    Backed by the ``_image_to_tensor`` op (reference transforms call the
+    ``_image_*`` ops of image_random.cc) so the conversion has ONE
+    definition for both eager and hybridized paths.
+    """
 
     def __init__(self):
         super().__init__()
 
     def hybrid_forward(self, F, x):
-        return F.transpose(F.Cast(x, dtype="float32"),
-                           axes=(2, 0, 1)) / 255.0
+        return F.image.to_tensor(x)
 
 
 class Normalize(HybridBlock):
-    """Normalizes a CHW tensor with mean and std per channel."""
+    """Normalizes a CHW / NCHW tensor with mean and std per channel
+    (backed by the ``_image_normalize`` op)."""
 
     def __init__(self, mean, std):
         super().__init__()
-        mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        std = np.asarray(std, np.float32).reshape(-1, 1, 1)
-        self._mean_c = self.params.get_constant("mean", mean)
-        self._std_c = self.params.get_constant("std", std)
-        self._mean_c.initialize()
-        self._std_c.initialize()
+        self._mean = tuple(np.atleast_1d(np.asarray(mean, np.float32))
+                           .tolist())
+        self._std = tuple(np.atleast_1d(np.asarray(std, np.float32))
+                          .tolist())
 
-    def hybrid_forward(self, F, x, _mean_c, _std_c):
-        return F.broadcast_div(F.broadcast_sub(x, _mean_c), _std_c)
+    def hybrid_forward(self, F, x):
+        return F.image.normalize(x, mean=self._mean, std=self._std)
 
 
 class Resize(Block):

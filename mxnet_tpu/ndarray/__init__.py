@@ -10,6 +10,7 @@ from . import register as _register
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from . import contrib  # noqa: F401
+from . import image  # noqa: F401
 from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
                      cast_storage)
 

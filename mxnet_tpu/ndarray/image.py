@@ -1,0 +1,13 @@
+"""``nd.image`` namespace (parity: python/mxnet/ndarray/image.py, generated
+from the ``_image_`` op prefix)."""
+from __future__ import annotations
+
+from ..ops.registry import OPS
+from .register import _make_fn
+
+_PREFIX = "_image_"
+
+for _name in list(OPS):
+    if _name.startswith(_PREFIX):
+        _short = _name[len(_PREFIX):]
+        globals()[_short] = _make_fn(_name, display_name=_short)

@@ -1,0 +1,225 @@
+"""Custom operators implemented in Python (``mx.operator``).
+
+Reference analog: ``python/mxnet/operator.py`` (CustomOp:426, CustomOpProp:
+472, register:692) backed by ``src/operator/custom/custom.cc`` /
+``custom-inl.h:50-173`` (N22): Python callbacks for infer-shape / forward /
+backward, executed on a dedicated worker thread so host Python work never
+blocks the scheduler.
+
+TPU-native design: the ``Custom`` op lowers to ``jax.pure_callback`` — the
+XLA host-callback mechanism — wrapped in a ``jax.custom_vjp`` whose backward
+is a second callback into the user's ``backward``.  This works both in the
+eager path and inside jitted CachedOp/Executor programs (the callback is a
+host node in the compiled graph, the analog of the reference's kAsync custom
+op dispatch).  User code still runs on one dedicated worker thread
+(custom-inl.h:74-173 parity), keeping the no-deadlock guarantee.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import register as _register_op, param
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+# the reference executes all python custom-op callbacks on one dedicated
+# worker thread (custom-inl.h:50-173); mirror that
+_WORKER = concurrent.futures.ThreadPoolExecutor(
+    max_workers=1, thread_name_prefix="mxnet_custom_op")
+
+
+def _on_worker(fn, *args):
+    import threading
+    if threading.current_thread().name.startswith("mxnet_custom_op"):
+        # nested Custom op (an op whose forward invokes another Custom op):
+        # run inline — re-submitting to the single worker would deadlock
+        return fn(*args)
+    return _WORKER.submit(fn, *args).result()
+
+
+class CustomOp:
+    """Base class for operators implemented in Python
+    (parity: operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign ``src`` to ``dst`` according to ``req``
+        (parity: operator.py:463)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Base class for custom-op property classes
+    (parity: operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+_PROPS: Dict[str, type] = {}
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (parity: operator.py:692)."""
+
+    def deco(prop_cls):
+        _PROPS[reg_name] = prop_cls
+        # drop caches so re-registration (notebook iteration) takes effect:
+        # prop instances AND compiled Custom executables bake in the class
+        _make_prop.cache_clear()
+        from .ops.registry import OPS
+        OPS["Custom"]._jit_cache.clear()
+        return prop_cls
+
+    return deco
+
+
+def get_prop_cls(name):
+    cls = _PROPS.get(name)
+    if cls is None:
+        raise MXNetError("custom op type %r is not registered (have %s)"
+                         % (name, sorted(_PROPS)))
+    return cls
+
+
+@functools.lru_cache(maxsize=256)
+def _make_prop(op_type: str, kwargs_items: Tuple[Tuple[str, str], ...]):
+    cls = get_prop_cls(op_type)
+    # reference passes all ctor kwargs as strings (custom.cc param protocol)
+    return cls(**{k: v for k, v in kwargs_items})
+
+
+def _prop_of(attrs):
+    items = tuple(sorted((k, str(v)) for k, v in attrs.items()
+                         if k not in ("op_type",) and not k.startswith("__")
+                         and v is not None))
+    return _make_prop(attrs["op_type"], items)
+
+
+def _nd_list(np_arrays):
+    from . import ndarray as nd
+    return [nd.array(a) for a in np_arrays]
+
+
+def _custom_num_outputs(attrs):
+    return len(_prop_of(attrs).list_outputs())
+
+
+@_register_op("Custom", nin=-1, train_aware=True,
+              nout=_custom_num_outputs,
+              params={"op_type": param(str, None, required=True)})
+def _custom(attrs, *inputs):
+    """The Custom op: host-callback execution of user Python code."""
+    from . import ndarray as nd
+    prop = _prop_of(attrs)
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    if len(inputs) != n_args + n_aux:
+        raise MXNetError(
+            "Custom op %r expects %d inputs (%d args + %d aux), got %d"
+            % (attrs["op_type"], n_args + n_aux, n_args, n_aux, len(inputs)))
+    in_shapes = [tuple(x.shape) for x in inputs[:n_args]]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [np.dtype(x.dtype) for x in inputs[:n_args]]
+    _, out_types, _ = prop.infer_type(in_types)
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                      for s, t in zip(out_shapes, out_types))
+    is_train = bool(attrs.get("__train__", False))
+
+    def _run_forward(*np_ins):
+        def work():
+            op = prop.create_operator(None, in_shapes, in_types)
+            in_data = _nd_list(np_ins[:n_args])
+            aux = _nd_list(np_ins[n_args:])
+            out_data = [nd.zeros(s, dtype=t)
+                        for s, t in zip(out_shapes, out_types)]
+            op.forward(is_train, ["write"] * n_out, in_data, out_data, aux)
+            return tuple(o.asnumpy() for o in out_data)
+        return _on_worker(work)
+
+    def _run_backward(*np_all):
+        # np_all = inputs..., aux..., saved forward outputs..., out_grads...
+        def work():
+            op = prop.create_operator(None, in_shapes, in_types)
+            in_data = _nd_list(np_all[:n_args])
+            aux = _nd_list(np_all[n_args:n_args + n_aux])
+            out_data = _nd_list(np_all[n_args + n_aux:
+                                       n_args + n_aux + n_out])
+            grads_np = np_all[n_args + n_aux + n_out:]
+            out_grad = _nd_list(grads_np)
+            in_grad = [nd.zeros(s, dtype=t)
+                       for s, t in zip(in_shapes, in_types)]
+            op.backward(["write"] * n_args, out_grad, in_data, out_data,
+                        in_grad, aux)
+            return tuple(g.asnumpy() for g in in_grad)
+        return _on_worker(work)
+
+    @jax.custom_vjp
+    def _apply(*xs):
+        outs = jax.pure_callback(_run_forward, out_avals, *xs)
+        return tuple(outs)
+
+    def _apply_fwd(*xs):
+        outs = _apply(*xs)
+        # save the ACTUAL forward outputs: backward must not re-run a
+        # (possibly stochastic) user forward to reconstruct out_data
+        return outs, (xs, outs)
+
+    def _apply_bwd(res, gs):
+        xs, outs = res
+        in_avals = tuple(jax.ShapeDtypeStruct(s, t)
+                         for s, t in zip(in_shapes, in_types))
+        grads = jax.pure_callback(_run_backward, in_avals, *xs, *outs, *gs)
+        # aux inputs receive zero gradient
+        aux_zero = tuple(jnp.zeros(x.shape, x.dtype) for x in xs[n_args:])
+        return tuple(grads) + aux_zero
+
+    _apply.defvjp(_apply_fwd, _apply_bwd)
+    outs = _apply(*inputs)
+    return outs if len(outs) > 1 else outs[0]

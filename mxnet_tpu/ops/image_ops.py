@@ -1,0 +1,36 @@
+"""Image operators (``_image_*``).
+
+Reference analog: ``src/operator/image/image_random.cc`` (the ``mx.nd.image``
+namespace backing gluon.data.vision.transforms): ``_image_to_tensor``,
+``_image_normalize``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, param
+
+
+@register("_image_to_tensor", nin=1, aliases=("to_tensor",))
+def _image_to_tensor(attrs, data):
+    """HWC (or NHWC) uint8 [0,255] -> CHW (NCHW) float32 [0,1)
+    (image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", nin=1, aliases=("normalize",),
+          params={"mean": param("floats", (0.0,)),
+                  "std": param("floats", (1.0,))})
+def _image_normalize(attrs, data):
+    """Channel-wise normalization of a CHW / NCHW float tensor
+    (image_random.cc Normalize)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    mean = jnp.asarray(np.asarray(attrs["mean"], np.float32)).reshape(shape)
+    std = jnp.asarray(np.asarray(attrs["std"], np.float32)).reshape(shape)
+    return (data - mean) / std

@@ -7,3 +7,4 @@ _register.populate(globals())
 
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from . import image  # noqa: F401

@@ -1,0 +1,16 @@
+"""``sym.image`` namespace (parity: python/mxnet/symbol/image.py, generated
+from the ``_image_`` op prefix)."""
+from __future__ import annotations
+
+from ..ops.registry import OPS
+from . import register as _register
+
+_PREFIX = "_image_"
+
+for _name in list(OPS):
+    if _name.startswith(_PREFIX):
+        _short = _name[len(_PREFIX):]
+        _fn = _register._make_fn(_name)
+        _fn.__name__ = _short
+        _fn.__qualname__ = _short
+        globals()[_short] = _fn

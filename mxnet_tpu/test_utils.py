@@ -1,0 +1,245 @@
+"""Testing utilities (parity: ``python/mxnet/test_utils.py``, 1,955 LoC in
+the reference — the numeric-gradient checker, tolerance asserts, random
+tensors for all stypes, and backend cross-checking used throughout
+``tests/python/unittest``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import context as _context
+
+_DEFAULT_CTX = None
+
+
+def default_context():
+    """The context tests run on (reference default_context(), env-switchable
+    via MXNET_TEST_DEVICE)."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is None:
+        import os
+        dev = os.environ.get("MXNET_TEST_DEVICE", "")
+        if dev.startswith("tpu"):
+            _DEFAULT_CTX = _context.tpu(0)
+        elif dev.startswith("gpu"):
+            _DEFAULT_CTX = _context.gpu(0)
+        else:
+            _DEFAULT_CTX = _context.current_context()
+    return _DEFAULT_CTX
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    """Tolerance assert with a useful message (reference
+    assert_almost_equal)."""
+    from .ndarray import NDArray
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        err = np.abs(a - b)
+        rel = err / (np.abs(b) + 1e-12)
+        raise AssertionError(
+            "%s and %s differ: max abs err %g, max rel err %g "
+            "(rtol=%g atol=%g)" % (names[0], names[1], err.max(), rel.max(),
+                                   rtol, atol))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None):
+    """Random NDArray of any storage type (reference rand_ndarray)."""
+    from . import ndarray as nd
+    dtype = dtype or np.float32
+    if stype == "default":
+        return nd.array(np.random.uniform(-1, 1, shape).astype(dtype),
+                        ctx=ctx)
+    return rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
+    from .ndarray import sparse
+    dtype = dtype or np.float32
+    density = 0.5 if density is None else density
+    dense = np.random.uniform(-1, 1, shape).astype(dtype)
+    mask = np.random.uniform(0, 1, (shape[0],) if stype == "row_sparse"
+                             else shape) <= density
+    if stype == "row_sparse":
+        dense = dense * mask.reshape((-1,) + (1,) * (len(shape) - 1))
+    else:
+        dense = dense * mask
+    from . import ndarray as nd
+    return nd.array(dense).tostype(stype)
+
+
+def _executor_for(sym, location, aux_states, grad_req, ctx):
+    from . import ndarray as nd
+    args = {k: (v if isinstance(v, nd.NDArray) else nd.array(v))
+            for k, v in location.items()}
+    grads = {k: nd.zeros(v.shape, dtype=v.dtype) for k, v in args.items()
+             if grad_req.get(k, "write") != "null"}
+    aux = {k: (v if isinstance(v, nd.NDArray) else nd.array(v))
+           for k, v in (aux_states or {}).items()}
+    return sym.bind(ctx, args, args_grad=grads, grad_req=grad_req,
+                    aux_states=aux)
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None,
+                           dtype=np.float64):
+    """Finite-difference gradient check of a symbol's backward
+    (reference check_numeric_gradient).
+
+    location: dict arg name -> np.ndarray/NDArray.  The symbol's outputs are
+    reduced with a fixed random projection to a scalar; analytic grads from
+    backward are compared to central differences of the forward.
+    """
+    from . import ndarray as nd
+    ctx = ctx or default_context()
+    location = {k: np.asarray(v.asnumpy() if isinstance(v, nd.NDArray)
+                              else v, np.float32)
+                for k, v in location.items()}
+    grad_nodes = list(grad_nodes or location.keys())
+    grad_req = {k: ("write" if k in grad_nodes else "null")
+                for k in location}
+
+    ex = _executor_for(sym, location, aux_states, grad_req, ctx)
+    outs = ex.forward(is_train=True)
+    rng = np.random.RandomState(0)
+    projections = [rng.normal(0, 1, o.shape).astype(np.float32)
+                   for o in outs]
+
+    def loss_at(loc):
+        for k, v in loc.items():
+            ex.arg_dict[k][:] = v
+        outs = ex.forward(is_train=True)
+        return sum(float((o.asnumpy().astype(np.float64) * p).sum())
+                   for o, p in zip(outs, projections))
+
+    ex.forward(is_train=True)
+    ex.backward([nd.array(p) for p in projections])
+    analytic = {k: ex.grad_dict[k].asnumpy().copy() for k in grad_nodes}
+
+    atol = rtol if atol is None else atol
+    for name in grad_nodes:
+        base = location[name]
+        num = np.zeros_like(base, np.float64)
+        flat = base.ravel()
+        numf = num.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            fp = loss_at(location)
+            flat[i] = orig - numeric_eps
+            fm = loss_at(location)
+            flat[i] = orig
+            numf[i] = (fp - fm) / (2 * numeric_eps)
+        loss_at(location)  # restore
+        a, n = analytic[name], num
+        denom = np.maximum(np.abs(n), np.abs(a))
+        bad = np.abs(a - n) > (atol + rtol * denom)
+        if bad.any():
+            raise AssertionError(
+                "numeric gradient check failed for %r: analytic %s vs "
+                "numeric %s" % (name, a.ravel()[bad.ravel()][:5],
+                                n.ravel()[bad.ravel()][:5]))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare symbol forward outputs against expected arrays
+    (reference check_symbolic_forward)."""
+    from . import ndarray as nd
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    grad_req = {k: "null" for k in location}
+    ex = _executor_for(sym, location, aux_states, grad_req, ctx)
+    outs = ex.forward(is_train=False)
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o.asnumpy(), e, rtol=rtol,
+                            atol=rtol if atol is None else atol)
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare symbol backward gradients against expected arrays
+    (reference check_symbolic_backward)."""
+    from . import ndarray as nd
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    reqs = {k: grad_req for k in location} if isinstance(grad_req, str) \
+        else grad_req
+    ex = _executor_for(sym, location, aux_states, reqs, ctx)
+    ex.forward(is_train=True)
+    ex.backward([g if isinstance(g, nd.NDArray) else nd.array(g)
+                 for g in out_grads])
+    for k, e in expected.items():
+        if reqs.get(k) == "null":
+            continue
+        assert_almost_equal(ex.grad_dict[k].asnumpy(), e, rtol=rtol,
+                            atol=rtol if atol is None else atol,
+                            names=("grad(%s)" % k, "expected"))
+    return ex
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
+    """Run one symbol on several contexts and require matching outputs
+    (reference check_consistency — the CPU/GPU cross-check pattern, here
+    CPU interpreter vs TPU)."""
+    if not ctx_list:
+        return
+    # ctx_list entries: {'ctx': Context, <arg shapes by name>}
+    arg_shapes = {k: v for k, v in ctx_list[0].items() if k != "ctx"}
+    rng = np.random.RandomState(0)
+    location = {k: (rng.normal(0, scale, s).astype(np.float32))
+                for k, s in arg_shapes.items()}
+    outputs = []
+    for entry in ctx_list:
+        ctx = entry["ctx"]
+        grad_req = {k: "null" for k in location}
+        ex = _executor_for(sym, location, None, grad_req, ctx)
+        outputs.append([o.asnumpy() for o in ex.forward(is_train=False)])
+    for other in outputs[1:]:
+        for a, b in zip(outputs[0], other):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol)
+    return outputs
+
+
+def list_gpus():
+    return []
+
+
+def list_tpus():
+    import jax
+    try:
+        return [d.id for d in jax.devices() if d.platform in ("tpu", "axon")]
+    except RuntimeError:
+        return []

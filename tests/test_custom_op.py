@@ -1,0 +1,188 @@
+"""Tests for the Python custom-op bridge, test_utils, and image ops.
+
+Parity model: reference tests/python/unittest/test_operator.py
+(test_custom_op), test_gluon_data_vision (image ops).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import test_utils as tu
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(1.0 / (1.0 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(gy * y * (1 - y)))
+
+
+@mx.operator.register("test_sigmoid_custom")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid_custom")
+        s = y.sum()
+    s.backward()
+    ey = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ey, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), ey * (1 - ey), rtol=1e-5)
+
+
+def test_custom_op_symbolic():
+    d = sym.var("data")
+    out = sym.Custom(d, op_type="test_sigmoid_custom", name="sig")
+    x = nd.array(np.array([[0.5, -0.5]], np.float32))
+    ex = out.bind(mx.cpu(), {"data": x})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-5)
+
+
+@mx.operator.register("test_scale_custom")
+class _ScaleProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        prop = self
+
+        class Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * prop.scale)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * prop.scale)
+
+        return Op()
+
+
+def test_custom_op_string_kwargs():
+    z = nd.Custom(nd.array([1., 2.]), op_type="test_scale_custom",
+                  scale="3.0")
+    np.testing.assert_allclose(z.asnumpy(), [3., 6.])
+
+
+def test_custom_op_multi_output():
+    @mx.operator.register("test_split2_custom")
+    class Split2Prop(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["half", "double"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] / 2)
+                    self.assign(out_data[1], req[1], in_data[0] * 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] / 2 + out_grad[1] * 2)
+
+            return Op()
+
+    x = nd.array([2., 4.])
+    h, d = nd.Custom(x, op_type="test_split2_custom")
+    np.testing.assert_allclose(h.asnumpy(), [1., 2.])
+    np.testing.assert_allclose(d.asnumpy(), [4., 8.])
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.array([1.]), op_type="never_registered_xyz")
+
+
+class TestTestUtils:
+    def test_assert_almost_equal_raises(self):
+        with pytest.raises(AssertionError):
+            tu.assert_almost_equal(np.ones(3), np.zeros(3))
+        tu.assert_almost_equal(np.ones(3), np.ones(3) + 1e-9, atol=1e-6)
+
+    def test_check_numeric_gradient(self):
+        a = sym.var("a")
+        b = sym.var("b")
+        out = sym.broadcast_mul(a, b) + sym.sin(a)
+        loc = {"a": np.random.rand(2, 3).astype(np.float32),
+               "b": np.random.rand(2, 3).astype(np.float32)}
+        tu.check_numeric_gradient(out, loc, numeric_eps=1e-3, rtol=0.05,
+                                  atol=1e-3)
+
+    def test_check_numeric_gradient_catches_wrong_grad(self):
+        # SVMOutput's backward ignores the head gradient -> finite
+        # differences of the identity forward disagree with the hinge grad
+        d = sym.var("d")
+        out = sym.SVMOutput(d, sym.var("label"))
+        with pytest.raises(AssertionError):
+            tu.check_numeric_gradient(
+                out, {"d": np.random.rand(2, 3).astype(np.float32),
+                      "label": np.zeros(2, np.float32)},
+                grad_nodes=["d"], rtol=0.01, atol=1e-3)
+
+    def test_check_symbolic_forward_backward(self):
+        a = sym.var("a")
+        x = np.random.rand(2, 3).astype(np.float32)
+        tu.check_symbolic_forward(sym.square(a), {"a": x}, [x ** 2])
+        tu.check_symbolic_backward(sym.square(a), {"a": x},
+                                   [np.ones_like(x)], [2 * x])
+
+    def test_rand_ndarray_stypes(self):
+        d = tu.rand_ndarray((4, 5))
+        assert d.shape == (4, 5)
+        rs = tu.rand_ndarray((6, 3), "row_sparse", density=0.5)
+        assert rs.stype == "row_sparse"
+        csr = tu.rand_ndarray((6, 3), "csr", density=0.3)
+        assert csr.stype == "csr"
+
+    def test_check_consistency(self):
+        a = sym.var("a")
+        tu.check_consistency(sym.exp(a), [{"ctx": mx.cpu(), "a": (3, 2)},
+                                          {"ctx": mx.cpu(), "a": (3, 2)}])
+
+
+class TestImageOps:
+    def test_to_tensor(self):
+        img = nd.array(np.full((4, 5, 3), 255, np.uint8))
+        t = nd.image.to_tensor(img)
+        assert t.shape == (3, 4, 5)
+        np.testing.assert_allclose(t.asnumpy(), 1.0, atol=1e-6)
+        batch = nd.array(np.zeros((2, 4, 5, 3), np.uint8))
+        tb = nd.image.to_tensor(batch)
+        assert tb.shape == (2, 3, 4, 5)
+
+    def test_normalize(self):
+        x = nd.array(np.ones((3, 2, 2), np.float32))
+        out = nd.image.normalize(x, mean=(0.5, 0.5, 0.5),
+                                 std=(0.25, 0.5, 1.0))
+        np.testing.assert_allclose(out.asnumpy()[:, 0, 0], [2., 1., 0.5],
+                                   rtol=1e-5)
+
+    def test_transforms_backed_by_image_ops(self):
+        from mxnet_tpu.gluon.data.vision import transforms
+        t = transforms.Compose([transforms.ToTensor(),
+                                transforms.Normalize(0.5, 0.25)])
+        img = nd.array(np.full((4, 4, 3), 128, np.uint8))
+        out = t(img)
+        assert out.shape == (3, 4, 4)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   (128 / 255 - 0.5) / 0.25, rtol=1e-4)
