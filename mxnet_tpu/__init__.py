@@ -45,6 +45,13 @@ from . import module as mod
 from . import model
 from .model import FeedForward
 from . import contrib
+from . import profiler
+from . import monitor as _monitor_mod
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import rtc
+from . import test_utils
 
 
 def kvstore_create(name="local"):

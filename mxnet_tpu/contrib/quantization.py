@@ -187,15 +187,20 @@ def _collect_layer_outputs(sym, arg_params, aux_params, ctx, calib_data,
     seen = 0
     calib_data.reset()
     ex = None
+    bound_shapes = None
     for batch in calib_data:
-        if ex is None:
-            # bind ONCE: a fresh Executor per batch would re-trace and
-            # re-compile the whole fp32 graph every iteration
+        shapes = tuple(tuple(a.shape) for a in batch.data)
+        if ex is None or shapes != bound_shapes:
+            # bind once per batch SHAPE (normally once total): a fresh
+            # Executor per batch would re-trace and re-compile the whole
+            # fp32 graph every iteration; a ragged final batch rebinds
+            # instead of silently broadcasting into the old buffers
             args = dict(arg_params)
             for dn, arr in zip(data_names, batch.data):
                 args[dn] = arr
             ex = group.bind(ctx, args, aux_states=dict(aux_params),
                             grad_req="null")
+            bound_shapes = shapes
         else:
             for dn, arr in zip(data_names, batch.data):
                 ex.arg_dict[dn][:] = arr

@@ -59,7 +59,7 @@ class _Plan:
             # aux writeback: map op output index -> aux name
             wb = {}
             if train:
-                for oi, ii in node.op.aux_writeback.items():
+                for oi, ii in node.op.get_aux_writeback(attrs).items():
                     if ii < len(node.inputs):
                         src = node.inputs[ii][0]
                         if id(src) in aux_ids:
@@ -90,6 +90,10 @@ class _Plan:
             for oi, aux_name in wb.items():
                 new_aux[aux_name] = outs[oi]
             if monitor is not None:
+                if getattr(monitor, "monitor_all", False):
+                    for i, (p, pi) in enumerate(node.inputs):
+                        monitor("%s_input%d" % (node.name, i),
+                                env[(id(p), pi)])
                 for i in range(node.num_visible()):
                     monitor(node.name + "_output", outs[i])
         outputs = [env[e] for e in self.out_entries]
@@ -204,9 +208,12 @@ class Executor:
             dst = self.arg_dict[k]
             dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
                 else jnp.asarray(v, dst.dtype)
+        from . import profiler as _profiler
         plan = self._plan(bool(is_train))
         keys = self._keys(plan)
         self._last_keys = keys
+        _prof = _profiler.is_running()
+        _pt0 = _profiler._now_us() if _prof else 0.0
         if self._monitor is not None:
             args, auxs = self._gather()
             outs, new_aux = plan.execute(
@@ -216,6 +223,9 @@ class Executor:
             new_aux = [new_aux[n] for n in self.aux_names]
         else:
             outs, new_aux = self._fwd_fn(bool(is_train))(*self._gather(), keys)
+        if _prof:
+            _profiler.record_span("Executor::Forward", _pt0,
+                                  _profiler._now_us(), "executor")
         if is_train:
             self._writeback_aux(new_aux)
         return self._wrap_outputs(outs)
@@ -310,7 +320,9 @@ class Executor:
                 raise MXNetError("unknown aux state %r" % k)
 
     def set_monitor_callback(self, callback, monitor_all=False):
-        """Install a per-node-output callback (runs the un-jitted plan)."""
+        """Install a per-node-output callback (runs the un-jitted plan);
+        ``monitor_all`` additionally reports every node INPUT
+        (reference SetMonitorCallback monitor_all semantics)."""
         if callback is None:
             self._monitor = None
             return
@@ -319,6 +331,7 @@ class Executor:
             from .ndarray.ndarray import NDArray
             callback(name, NDArray(arr, self._ctx))
 
+        mon.monitor_all = bool(monitor_all)
         self._monitor = mon
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):

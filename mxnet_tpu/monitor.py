@@ -1,0 +1,74 @@
+"""Monitor: per-node tensor statistics during execution.
+
+Reference analog: ``python/mxnet/monitor.py:33`` — installs an executor
+monitor callback (``GraphExecutor::SetMonitorCallback``,
+graph_executor.cc:123) invoked per node output in ``RunOps``; collects a
+user stat function of every intermediate tensor between ``tic()`` and
+``toc()``.
+"""
+from __future__ import annotations
+
+import re
+from math import sqrt
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect per-node output statistics every ``interval`` batches
+    (parity: monitor.py:33)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.activated = False
+        self.step = 0
+        self.queue = []
+        self.exes = []
+
+    def install(self, exe):
+        """Attach to an executor (reference install_executor)."""
+        exe.set_monitor_callback(self._stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    install_executor = install
+
+    def _stat_helper(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns [(step, name, stat), ...]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for n, k, v_ in self.queue:
+            if isinstance(v_, NDArray):
+                v_ = v_.asnumpy()
+            res.append((n, k, v_))
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            print("Batch: %7d %30s %s" % (n, k, v))

@@ -518,6 +518,9 @@ def invoke(op: Union[str, Operator], inputs: Sequence[NDArray],
         _autograd._entry_of(a) is not None
         for a in inputs if isinstance(a, NDArray))
 
+    from .. import profiler as _profiler
+    _prof = _profiler.is_running()
+    _pt0 = _profiler._now_us() if _prof else 0.0
     if recording:
         fn, _attrs, _prefix = op.fn, attrs, tuple(prefix)
 
@@ -537,6 +540,9 @@ def invoke(op: Union[str, Operator], inputs: Sequence[NDArray],
         res = op.compiled(attrs)(*prefix, *arrays)
         outs = res if isinstance(res, tuple) else (res,)
         vjp_caller = None
+    if _prof:
+        # ProfileOperator analog (threaded_engine.h:80): span per dispatch
+        _profiler.record_span(op.name, _pt0, _profiler._now_us())
 
     if ctx is not None and not isinstance(ctx, Context):
         ctx = Context(*ctx) if isinstance(ctx, tuple) else _parse_ctx(ctx)
@@ -550,7 +556,7 @@ def invoke(op: Union[str, Operator], inputs: Sequence[NDArray],
                             nd_outs)
 
     # aux writeback (BatchNorm moving stats, optimizer states)
-    for oi, ii in op.aux_writeback.items():
+    for oi, ii in op.get_aux_writeback(attrs).items():
         if ii < len(inputs) and isinstance(inputs[ii], NDArray):
             inputs[ii]._data = outs[oi]
 

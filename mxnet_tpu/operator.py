@@ -147,11 +147,29 @@ def _nd_list(np_arrays):
 
 
 def _custom_num_outputs(attrs):
+    prop = _prop_of(attrs)
+    return len(prop.list_outputs()) + len(prop.list_auxiliary_states())
+
+
+def _custom_num_visible(attrs):
     return len(_prop_of(attrs).list_outputs())
+
+
+def _custom_aux_writeback(attrs):
+    """Updated aux states trail the user outputs; write them back into the
+    trailing (aux) inputs — how the reference's CustomOp aux mutation is
+    expressed functionally on TPU."""
+    prop = _prop_of(attrs)
+    n_out = len(prop.list_outputs())
+    n_args = len(prop.list_arguments())
+    return {n_out + i: n_args + i
+            for i in range(len(prop.list_auxiliary_states()))}
 
 
 @_register_op("Custom", nin=-1, train_aware=True,
               nout=_custom_num_outputs,
+              visible=_custom_num_visible,
+              aux_writeback=_custom_aux_writeback,
               params={"op_type": param(str, None, required=True)})
 def _custom(attrs, *inputs):
     """The Custom op: host-callback execution of user Python code."""
@@ -170,6 +188,8 @@ def _custom(attrs, *inputs):
     _, out_types, _ = prop.infer_type(in_types)
     out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
                       for s, t in zip(out_shapes, out_types))
+    aux_avals = tuple(jax.ShapeDtypeStruct(tuple(x.shape), np.dtype(x.dtype))
+                      for x in inputs[n_args:])
     is_train = bool(attrs.get("__train__", False))
 
     def _run_forward(*np_ins):
@@ -180,7 +200,10 @@ def _custom(attrs, *inputs):
             out_data = [nd.zeros(s, dtype=t)
                         for s, t in zip(out_shapes, out_types)]
             op.forward(is_train, ["write"] * n_out, in_data, out_data, aux)
-            return tuple(o.asnumpy() for o in out_data)
+            # aux mutations flow back as extra outputs (written back into
+            # the caller's aux NDArrays by the dispatch layer)
+            return tuple(o.asnumpy() for o in out_data) + \
+                tuple(a.asnumpy() for a in aux)
         return _on_worker(work)
 
     def _run_backward(*np_all):
@@ -202,24 +225,27 @@ def _custom(attrs, *inputs):
 
     @jax.custom_vjp
     def _apply(*xs):
-        outs = jax.pure_callback(_run_forward, out_avals, *xs)
+        outs = jax.pure_callback(_run_forward, out_avals + aux_avals, *xs)
         return tuple(outs)
 
     def _apply_fwd(*xs):
         outs = _apply(*xs)
         # save the ACTUAL forward outputs: backward must not re-run a
         # (possibly stochastic) user forward to reconstruct out_data
-        return outs, (xs, outs)
+        return outs, (xs, outs[:n_out])
 
     def _apply_bwd(res, gs):
         xs, outs = res
         in_avals = tuple(jax.ShapeDtypeStruct(s, t)
                          for s, t in zip(in_shapes, in_types))
-        grads = jax.pure_callback(_run_backward, in_avals, *xs, *outs, *gs)
+        grads = jax.pure_callback(_run_backward, in_avals, *xs, *outs,
+                                  *gs[:n_out])
         # aux inputs receive zero gradient
         aux_zero = tuple(jnp.zeros(x.shape, x.dtype) for x in xs[n_args:])
         return tuple(grads) + aux_zero
 
     _apply.defvjp(_apply_fwd, _apply_bwd)
     outs = _apply(*inputs)
+    # outputs: user outputs first, then updated aux (picked up by
+    # get_aux_writeback below)
     return outs if len(outs) > 1 else outs[0]

@@ -154,6 +154,12 @@ class Operator:
     def num_outputs(self, attrs: AttrDict) -> int:
         return self.nout(attrs) if callable(self.nout) else self.nout
 
+    def get_aux_writeback(self, attrs: AttrDict) -> Dict[int, int]:
+        """aux_writeback may be a static dict or callable(attrs)->dict
+        (ops like Custom whose aux count depends on attrs)."""
+        wb = self.aux_writeback
+        return wb(attrs) if callable(wb) else wb
+
     def num_visible_outputs(self, attrs: AttrDict) -> int:
         if self.visible is None:
             return self.num_outputs(attrs)

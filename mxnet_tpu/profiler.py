@@ -1,0 +1,263 @@
+"""Profiler (parity: ``python/mxnet/profiler.py`` over SURVEY.md N16/§5.1).
+
+Reference analog: ``src/profiler/profiler.{h,cc}`` + ``c_api_profile.cc`` —
+Chrome-trace JSON of per-op spans recorded by the engine
+(``ProfileOperator`` wraps each executed op, threaded_engine.h:80), an
+in-memory aggregate table (``aggregate_stats.cc``), and user-defined
+Domain/Task/Frame/Event/Counter/Marker objects.
+
+TPU-native design: the host-side dispatch layer (imperative ``invoke`` and
+the Executor) is where op spans are recorded — device-side XLA timing comes
+from ``jax.profiler`` (start/stop a TensorBoard trace alongside when
+``profile_device`` is requested), keeping the reference's "profile
+everything through the scheduler" shape with XLA as the device half.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "profiler_set_config", "profiler_set_state",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "profile_device": False,
+    "tensorboard_dir": None,
+}
+_state = "stop"          # 'run' | 'stop'
+_paused = False
+_events: List[dict] = []
+_t0 = time.perf_counter()
+_jax_trace_active = False
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def is_running():
+    return _state == "run" and not _paused
+
+
+def record_span(name: str, begin_us: float, end_us: float,
+                category: str = "operator"):
+    """Append one complete span (the ProfileOperator analog)."""
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": begin_us, "dur": end_us - begin_us,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000})
+
+
+class span:
+    """Context manager used by the dispatch layer around each op."""
+
+    __slots__ = ("name", "cat", "begin")
+
+    def __init__(self, name, category="operator"):
+        self.name = name
+        self.cat = category
+
+    def __enter__(self):
+        self.begin = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        record_span(self.name, self.begin, _now_us(), self.cat)
+        return False
+
+
+def set_config(**kwargs):
+    """Configure the profiler (parity: profiler.py:28 set_config)."""
+    for k, v in kwargs.items():
+        if k not in _config:
+            # tolerate reference-only knobs silently (e.g. continuous_dump)
+            continue
+        _config[k] = v
+
+
+profiler_set_config = set_config  # legacy alias (reference keeps both)
+
+
+def set_state(state="stop"):
+    """'run' starts collection; 'stop' ends it (parity: set_state)."""
+    global _state, _jax_trace_active
+    if state not in ("run", "stop"):
+        raise ValueError("profiler state must be 'run' or 'stop'")
+    if state == "run" and _state != "run":
+        if _config["profile_device"] and _config["tensorboard_dir"]:
+            import jax
+            jax.profiler.start_trace(_config["tensorboard_dir"])
+            _jax_trace_active = True
+    if state == "stop" and _state == "run" and _jax_trace_active:
+        import jax
+        jax.profiler.stop_trace()
+        _jax_trace_active = False
+    _state = state
+
+
+profiler_set_state = set_state
+
+
+def pause():
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def dump(finished=True):
+    """Write the Chrome-trace JSON file (parity: Profiler::DumpProfile)."""
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "us"}, f)
+    return _config["filename"]
+
+
+def dumps(reset=False):
+    """Aggregate-stats table as a string
+    (parity: MXAggregateProfileStatsPrint)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in events:
+        if "dur" in e:  # complete spans only (not counters/markers)
+            agg[e["name"]].append(e["dur"])
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(us)", "Min(us)", "Max(us)", "Avg(us)")]
+    for name in sorted(agg, key=lambda n: -sum(agg[n])):
+        durs = agg[name]
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" %
+                     (name, len(durs), sum(durs), min(durs), max(durs),
+                      sum(durs) / len(durs)))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# user-defined profiling objects (parity: profiler.py Domain/Task/Frame/...)
+# ---------------------------------------------------------------------------
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    def __init__(self, domain, name, category):
+        self.domain = domain
+        self.name = name
+        self._cat = category
+        self._begin = None
+
+    def start(self):
+        self._begin = _now_us()
+
+    def stop(self):
+        if self._begin is not None:
+            record_span("%s::%s" % (self.domain.name, self.name),
+                        self._begin, _now_us(), self._cat)
+            self._begin = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Span):
+    def __init__(self, domain, name):
+        super().__init__(domain, name, "task")
+
+
+class Frame(_Span):
+    def __init__(self, domain, name):
+        super().__init__(domain, name, "frame")
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__(Domain("event"), name, "event")
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if is_running():
+            with _lock:
+                _events.append({"name": "%s::%s" % (self.domain.name,
+                                                    self.name),
+                                "cat": "counter", "ph": "C",
+                                "ts": _now_us(), "pid": os.getpid(),
+                                "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        if is_running():
+            with _lock:
+                _events.append({"name": "%s::%s" % (self.domain.name,
+                                                    self.name),
+                                "cat": "marker", "ph": "i", "ts": _now_us(),
+                                "pid": os.getpid(), "s": scope[0]})

@@ -118,8 +118,10 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     """
     from . import ndarray as nd
     ctx = ctx or default_context()
+    # dtype governs host-side perturbation/difference arithmetic; device
+    # execution stays in each arg's own dtype
     location = {k: np.asarray(v.asnumpy() if isinstance(v, nd.NDArray)
-                              else v, np.float32)
+                              else v, dtype)
                 for k, v in location.items()}
     grad_nodes = list(grad_nodes or location.keys())
     grad_req = {k: ("write" if k in grad_nodes else "null")

@@ -1,0 +1,108 @@
+"""Network visualization (parity: ``python/mxnet/visualization.py``):
+``print_summary`` textual table and ``plot_network`` graphviz rendering."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary table of a symbol
+    (parity: visualization.py print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        arg_dict = dict(zip(symbol.list_arguments(), arg_shapes))
+    else:
+        arg_dict = {}
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos - 1].ljust(pos)
+        print(line)
+
+    print("_" * line_length)
+    print_row(headers)
+    print("=" * line_length)
+
+    total_params = 0
+    # walk the graph in topo order
+    out_shape_of = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        onames = internals.list_outputs()
+        try:
+            _, ishapes, _ = internals.infer_shape(**shape)
+            out_shape_of = dict(zip(onames, ishapes))
+        except MXNetError:
+            pass
+    for node in symbol._topo():
+        if node.is_var:
+            continue
+        name = node.name
+        op_name = node.op.name
+        oshape = out_shape_of.get(name + "_output", "")
+        params = 0
+        prevs = []
+        for pnode, _ in node.inputs:
+            if pnode.is_var:
+                if pnode.name in arg_dict and pnode.name != "data":
+                    n = 1
+                    for d in arg_dict[pnode.name]:
+                        n *= d
+                    params += n
+            else:
+                prevs.append(pnode.name)
+        total_params += params
+        print_row(["%s (%s)" % (name, op_name), oshape, params,
+                   ",".join(prevs)])
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz Digraph of the symbol (parity: visualization.py
+    plot_network).  Requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires the graphviz python "
+                          "package") from e
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title, format=save_format)
+    base_attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    base_attrs.update(node_attrs)
+    palette = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+               "Activation": "#ffffb3", "BatchNorm": "#bebada",
+               "Pooling": "#80b1d3", "SoftmaxOutput": "#fccde5"}
+    seen = set()
+    for node in symbol._topo():
+        name = node.name
+        if node.is_var:
+            if hide_weights and (name.endswith("_weight") or
+                                 name.endswith("_bias") or
+                                 name.endswith("_gamma") or
+                                 name.endswith("_beta")):
+                continue
+            dot.node(name, name, {**base_attrs, "fillcolor": "#8dd3c7",
+                                  "shape": "oval"})
+        else:
+            color = palette.get(node.op.name, "#b3de69")
+            dot.node(name, "%s\n%s" % (name, node.op.name),
+                     {**base_attrs, "fillcolor": color})
+        seen.add(name)
+        for pnode, _ in node.inputs:
+            # parents precede their consumers in topo order, so every
+            # drawn parent is already in `seen`; hidden weight vars are not
+            if pnode.name in seen:
+                dot.edge(pnode.name, name)
+    return dot

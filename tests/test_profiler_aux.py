@@ -1,0 +1,150 @@
+"""Tests for profiler, Monitor, visualization, and the Pallas RTC bridge.
+
+Parity model: reference tests/python/unittest/test_profiler.py,
+test_monitor usage in test_operator.py, tests/python/gpu/test_rtc.py.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+
+
+class TestProfiler:
+    def test_span_collection_and_dump(self, tmp_path):
+        f = str(tmp_path / "profile.json")
+        mx.profiler.set_config(filename=f)
+        mx.profiler.set_state("run")
+        x = nd.array(np.random.rand(16, 16).astype(np.float32))
+        for _ in range(3):
+            y = nd.dot(x, x)
+        y.asnumpy()
+        table = mx.profiler.dumps()
+        assert "dot" in table
+        out = mx.profiler.dump()
+        mx.profiler.set_state("stop")
+        ev = json.load(open(out))["traceEvents"]
+        assert sum(1 for e in ev if e["name"] == "dot") >= 3
+        assert all("ts" in e for e in ev)
+
+    def test_pause_resume(self, tmp_path):
+        mx.profiler.set_config(filename=str(tmp_path / "p.json"))
+        mx.profiler.set_state("run")
+        x = nd.ones((4, 4))
+        mx.profiler.pause()
+        _ = nd.exp(x)
+        mx.profiler.resume()
+        _ = nd.log(x + 1.0)
+        table = mx.profiler.dumps(reset=True)
+        mx.profiler.set_state("stop")
+        assert "exp" not in table
+        assert "log" in table
+
+    def test_domains_tasks_counters(self, tmp_path):
+        f = str(tmp_path / "d.json")
+        mx.profiler.set_config(filename=f)
+        mx.profiler.set_state("run")
+        d = mx.profiler.Domain("userdomain")
+        with d.new_task("work"):
+            pass
+        c = d.new_counter("cnt", 1)
+        c += 5
+        d.new_marker("mark").mark()
+        mx.profiler.dump()
+        mx.profiler.set_state("stop")
+        ev = json.load(open(f))["traceEvents"]
+        names = [e["name"] for e in ev]
+        assert "userdomain::work" in names
+        assert "userdomain::cnt" in names
+        assert "userdomain::mark" in names
+
+    def test_executor_span(self, tmp_path):
+        f = str(tmp_path / "e.json")
+        mx.profiler.set_config(filename=f)
+        mx.profiler.set_state("run")
+        a = sym.var("a")
+        ex = sym.exp(a).bind(mx.cpu(), {"a": nd.ones((2, 2))})
+        ex.forward()
+        mx.profiler.dump()
+        mx.profiler.set_state("stop")
+        ev = json.load(open(f))["traceEvents"]
+        assert any(e["name"] == "Executor::Forward" for e in ev)
+
+
+class TestMonitor:
+    def _bound(self):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        act = sym.Activation(fc, act_type="relu", name="relu")
+        return act.bind(mx.cpu(), {"data": nd.ones((2, 3)),
+                                   "fc_weight": nd.ones((4, 3)),
+                                   "fc_bias": nd.zeros((4,))})
+
+    def test_collects_stats(self):
+        ex = self._bound()
+        mon = mx.Monitor(1, pattern=".*")
+        mon.install(ex)
+        mon.tic()
+        ex.forward()
+        stats = mon.toc()
+        assert any("relu" in k for _, k, _v in stats)
+        assert any("fc" in k for _, k, _v in stats)
+
+    def test_interval_and_pattern(self):
+        ex = self._bound()
+        mon = mx.Monitor(2, pattern=".*relu.*")
+        mon.install(ex)
+        mon.tic()
+        ex.forward()
+        stats = mon.toc()
+        assert stats and all("relu" in k for _, k, _v in stats)
+        # second tic within the interval: no collection
+        mon.tic()
+        ex.forward()
+        assert mon.toc() == []
+
+
+class TestVisualization:
+    def test_print_summary_counts_params(self, capsys):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        act = sym.Activation(fc, act_type="relu", name="relu")
+        total = mx.viz.print_summary(act, shape={"data": (2, 3)})
+        assert total == 3 * 4 + 4
+        out = capsys.readouterr().out
+        assert "fc" in out and "relu" in out
+
+
+class TestPallasRTC:
+    def test_module_from_source(self):
+        src = (
+            "def add_one_kernel(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] + 1.0\n")
+        mod = mx.rtc.PallasModule(src)
+        k = mod.get_kernel("add_one_kernel")
+        out = k.launch([nd.array(np.ones((8, 16), np.float32))])
+        np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+    def test_grid_kernel(self):
+        src = (
+            "def scale_kernel(x_ref, o_ref):\n"
+            "    i = pl.program_id(0)\n"
+            "    o_ref[i, :] = x_ref[i, :] * 3.0\n")
+        mod = mx.rtc.PallasModule(src)
+        out = mod.get_kernel("scale_kernel", grid=(4,)).launch(
+            [nd.array(np.ones((4, 8), np.float32))])
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+    def test_exports_and_missing_kernel(self):
+        src = "def k1(x_ref, o_ref):\n    o_ref[...] = x_ref[...]\n"
+        mod = mx.rtc.PallasModule(src, exports=["k1"])
+        with pytest.raises(mx.MXNetError):
+            mod.get_kernel("nope")
+
+    def test_cuda_module_stub(self):
+        with pytest.raises(mx.MXNetError):
+            mx.rtc.CudaModule("__global__ void f(){}")
