@@ -1,0 +1,108 @@
+"""Native runtime loader: builds (once) and loads the C++ shared library.
+
+The C++ core (``src/engine.cc`` threaded dependency engine,
+``src/recordio.cc`` RecordIO) is the native half of the runtime (SURVEY.md
+N1/N14/N17).  Built lazily with ``make`` on first import — a laptop-style
+`pip install -e` flow — and cached; if no toolchain is available the Python
+fallbacks take over transparently (``lib() -> None``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmxnet_tpu_native.so")
+_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "src"))
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    try:
+        return any(
+            os.path.getmtime(os.path.join(_SRC, f)) > so_m
+            for f in os.listdir(_SRC) if f.endswith(".cc"))
+    except OSError:
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    # engine
+    lib.MXNativeEngineCreate.restype = c.c_void_p
+    lib.MXNativeEngineCreate.argtypes = [c.c_int]
+    lib.MXNativeEngineFree.argtypes = [c.c_void_p]
+    lib.MXNativeEngineNewVar.restype = c.c_void_p
+    lib.MXNativeEngineNewVar.argtypes = [c.c_void_p]
+    lib.MXNativeEngineDeleteVar.argtypes = [c.c_void_p, c.c_void_p]
+    lib.MXNativeEnginePush.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p,
+        c.POINTER(c.c_void_p), c.c_int,
+        c.POINTER(c.c_void_p), c.c_int, c.c_int]
+    lib.MXNativeEngineWaitForVar.restype = c.c_int64
+    lib.MXNativeEngineWaitForVar.argtypes = [c.c_void_p, c.c_void_p]
+    lib.MXNativeEngineWaitForAll.argtypes = [c.c_void_p]
+    # recordio
+    lib.MXNativeRecordIOGetLastError.restype = c.c_char_p
+    lib.MXNativeRecordIOWriterCreate.restype = c.c_void_p
+    lib.MXNativeRecordIOWriterCreate.argtypes = [c.c_char_p]
+    lib.MXNativeRecordIOWriterWrite.restype = c.c_int
+    lib.MXNativeRecordIOWriterWrite.argtypes = [c.c_void_p, c.c_char_p,
+                                                c.c_uint64]
+    lib.MXNativeRecordIOWriterTell.restype = c.c_int64
+    lib.MXNativeRecordIOWriterTell.argtypes = [c.c_void_p]
+    lib.MXNativeRecordIOWriterClose.argtypes = [c.c_void_p]
+    lib.MXNativeRecordIOReaderCreate.restype = c.c_void_p
+    lib.MXNativeRecordIOReaderCreate.argtypes = [c.c_char_p]
+    lib.MXNativeRecordIOReaderRead.restype = c.c_int
+    # out pointer declared void* so ctypes doesn't NUL-truncate the buffer
+    lib.MXNativeRecordIOReaderRead.argtypes = [
+        c.c_void_p, ctypes.POINTER(c.c_void_p), ctypes.POINTER(c.c_uint64)]
+    lib.MXNativeRecordIOReaderSeek.restype = c.c_int
+    lib.MXNativeRecordIOReaderSeek.argtypes = [c.c_void_p, c.c_uint64]
+    lib.MXNativeRecordIOReaderTell.restype = c.c_int64
+    lib.MXNativeRecordIOReaderTell.argtypes = [c.c_void_p]
+    lib.MXNativeRecordIOReaderClose.argtypes = [c.c_void_p]
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MXNET_NO_NATIVE", "") in ("1", "true"):
+            return None
+        try:
+            if _needs_build():
+                subprocess.run(["make", "-C", _SRC,
+                                "OUT=" + _SO], check=True,
+                               capture_output=True, timeout=120)
+            loaded = ctypes.CDLL(_SO)
+            _declare(loaded)
+            _LIB = loaded
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            # AttributeError: stale .so missing newly added symbols — try
+            # one forced rebuild, else fall back to pure Python
+            try:
+                subprocess.run(["make", "-C", _SRC, "clean"],
+                               capture_output=True, timeout=30)
+                subprocess.run(["make", "-C", _SRC, "OUT=" + _SO],
+                               check=True, capture_output=True, timeout=120)
+                loaded = ctypes.CDLL(_SO)
+                _declare(loaded)
+                _LIB = loaded
+            except (OSError, subprocess.SubprocessError, AttributeError):
+                _LIB = None
+        return _LIB

@@ -241,22 +241,170 @@ class ThreadedEngine(Engine):
         self._pool.shutdown(wait=True)
 
 
+class NativeVar:
+    """A var owned by the C++ engine (wraps the native handle)."""
+
+    __slots__ = ("handle", "name", "exc")
+
+    def __init__(self, handle, name=""):
+        self.handle = handle
+        self.name = name
+        self.exc = None  # API parity; native errors surface at wait
+
+    def __repr__(self):
+        return "<NativeVar %s>" % (self.name or hex(self.handle or 0))
+
+
+class NativeThreadedEngine(Engine):
+    """The C++ threaded dependency engine (src/engine.cc) driven over the
+    ctypes C ABI — the default, ``ThreadedEnginePerDevice``-equivalent
+    backend.  Python callbacks run on the C++ worker threads (ctypes
+    acquires the GIL per call); exceptions are mapped to integer codes that
+    poison vars native-side and are re-raised at ``wait_for_var``."""
+
+    MAX_STORED_ERRORS = 1024  # bound on never-surfaced exception objects
+
+    def __init__(self, num_workers: Optional[int] = None):
+        import atexit
+        import ctypes
+        from . import _native
+        self._lib = _native.lib()
+        if self._lib is None:
+            raise RuntimeError("native engine library unavailable")
+        n = num_workers or get_env("MXNET_CPU_WORKER_NTHREADS",
+                                   min(16, os.cpu_count() or 4), int)
+        self._handle = self._lib.MXNativeEngineCreate(int(n))
+        self._errors = collections.OrderedDict()  # error code -> exception
+        self._pending = {}           # payload key -> (fn, done_event_or_None)
+        self._next = [1]
+        self._lock = threading.Lock()
+        eng = self
+
+        @ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64)
+        def _trampoline(key, prior_err):
+            # ALWAYS called — even when a poisoned dependency means the user
+            # fn is skipped — so closure state is released and push_sync
+            # waiters are woken (src/engine.cc Execute contract)
+            with eng._lock:
+                fn, done = eng._pending.pop(key)
+            code = int(prior_err)
+            if code == 0:
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001 - ref propagates
+                    with eng._lock:
+                        code = eng._next[0]
+                        eng._next[0] += 1
+                        eng._errors[code] = e
+                        while len(eng._errors) > eng.MAX_STORED_ERRORS:
+                            eng._errors.popitem(last=False)
+            if done is not None:
+                done.code = code
+                done.set()
+            return code
+
+        self._trampoline = _trampoline  # keep alive
+        self._fn_ptr = ctypes.cast(_trampoline, ctypes.c_void_p)
+        # drain pending host work before interpreter teardown: the C++
+        # workers are invisible to Python's threading shutdown, and a
+        # trampoline call after finalization would crash (the Python
+        # ThreadedEngine got this for free from ThreadPoolExecutor join)
+        atexit.register(self._drain_at_exit)
+
+    def _drain_at_exit(self):
+        if self._handle:
+            self._lib.MXNativeEngineWaitForAll(self._handle)
+            self.stop()
+
+    def new_variable(self, name: str = "") -> NativeVar:
+        return NativeVar(self._lib.MXNativeEngineNewVar(self._handle), name)
+
+    def _var_array(self, vars_):
+        import ctypes
+        arr = (ctypes.c_void_p * max(1, len(vars_)))()
+        for i, v in enumerate(vars_):
+            arr[i] = v.handle
+        return arr
+
+    def _push(self, fn, const_vars, mutable_vars, done=None, prio=0):
+        mvars = list(dict.fromkeys(mutable_vars))
+        cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
+        with self._lock:
+            key = self._next[0]
+            self._next[0] += 1
+            self._pending[key] = (fn, done)
+        self._lib.MXNativeEnginePush(
+            self._handle, self._fn_ptr, key,
+            self._var_array(cvars), len(cvars),
+            self._var_array(mvars), len(mvars), prio)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name=""):
+        self._push(fn, const_vars, mutable_vars)
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), name=""):
+        done = threading.Event()
+        done.code = 0
+        self._push(fn, const_vars, mutable_vars, done=done)
+        done.wait()
+        if done.code:
+            with self._lock:
+                # peek, don't pop: the poisoned var still owns this error
+                # until a wait_for_var surfaces (and clears) it
+                exc = self._errors.get(done.code)
+            if exc is not None:
+                raise exc
+
+    def wait_for_var(self, var: NativeVar):
+        code = self._lib.MXNativeEngineWaitForVar(self._handle, var.handle)
+        if code:
+            with self._lock:
+                # peek, don't pop: one failing op may have poisoned several
+                # vars sharing this code; entries age out of the bounded
+                # OrderedDict instead
+                exc = self._errors.get(code)
+            if exc is not None:
+                raise exc
+            raise RuntimeError("engine op failed (code %d; original "
+                               "exception aged out)" % code)
+
+    def wait_for_all(self):
+        self._lib.MXNativeEngineWaitForAll(self._handle)
+
+    def delete_variable(self, var: NativeVar):
+        self._lib.MXNativeEngineDeleteVar(self._handle, var.handle)
+        var.handle = None
+
+    def stop(self):
+        if self._handle:
+            self._lib.MXNativeEngineFree(self._handle)
+            self._handle = None
+
+
 _engine: Optional[Engine] = None
 _engine_lock = threading.Lock()
 
 
 def get() -> Engine:
     """Singleton accessor (reference ``Engine::Get``), selected by
-    ``MXNET_ENGINE_TYPE`` just like ``engine.cc:32-47``."""
+    ``MXNET_ENGINE_TYPE`` just like ``engine.cc:32-47``:
+    NaiveEngine | ThreadedEngine (python pool) | ThreadedEnginePerDevice
+    (default; the native C++ engine, falling back to the Python pool when
+    no toolchain is available)."""
     global _engine
     if _engine is None:
         with _engine_lock:
             if _engine is None:
                 kind = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
-                if "naive" in kind.lower():
+                lower = kind.lower()
+                if "naive" in lower:
                     _engine = NaiveEngine()
-                else:
+                elif lower == "threadedengine":
                     _engine = ThreadedEngine()
+                else:
+                    try:
+                        _engine = NativeThreadedEngine()
+                    except RuntimeError:
+                        _engine = ThreadedEngine()
     return _engine
 
 

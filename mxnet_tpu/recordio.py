@@ -24,27 +24,49 @@ _LEN_MASK = (1 << _LFLAG_BITS) - 1
 
 
 class MXRecordIO:
-    """Sequential RecordIO reader/writer (ref recordio.py:MXRecordIO)."""
+    """Sequential RecordIO reader/writer (ref recordio.py:MXRecordIO).
+
+    Backed by the native C++ reader/writer (src/recordio.cc via ctypes, the
+    dmlc-core RecordIO analog) when the native library is available; the
+    pure-Python code below is the byte-identical fallback.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
         self.writable = None
+        self._nlib = None
+        self._nhandle = None
         self.open()
 
     def open(self):
+        from . import _native
+        self._nlib = _native.lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("invalid flag %r" % self.flag)
+        if self._nlib is not None:
+            create = (self._nlib.MXNativeRecordIOWriterCreate if self.writable
+                      else self._nlib.MXNativeRecordIOReaderCreate)
+            self._nhandle = create(str(self.uri).encode())
+            if not self._nhandle:
+                raise MXNetError(
+                    self._nlib.MXNativeRecordIOGetLastError().decode())
+        else:
+            self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()
 
     def close(self):
+        if self._nhandle:
+            if self.writable:
+                self._nlib.MXNativeRecordIOWriterClose(self._nhandle)
+            else:
+                self._nlib.MXNativeRecordIOReaderClose(self._nhandle)
+            self._nhandle = None
         if self.handle is not None:
             self.handle.close()
             self.handle = None
@@ -55,6 +77,8 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["handle"] = None
+        d["_nlib"] = None       # ctypes objects are not picklable;
+        d["_nhandle"] = None    # __setstate__ reopens
         return d
 
     def __setstate__(self, d):
@@ -66,10 +90,28 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._nhandle:
+            if self.writable:
+                return int(self._nlib.MXNativeRecordIOWriterTell(
+                    self._nhandle))
+            return int(self._nlib.MXNativeRecordIOReaderTell(self._nhandle))
         return self.handle.tell()
+
+    def _seek(self, pos):
+        assert not self.writable
+        if self._nhandle:
+            self._nlib.MXNativeRecordIOReaderSeek(self._nhandle, int(pos))
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf: bytes):
         assert self.writable
+        if self._nhandle:
+            if self._nlib.MXNativeRecordIOWriterWrite(self._nhandle, buf,
+                                                      len(buf)) != 0:
+                raise MXNetError(
+                    self._nlib.MXNativeRecordIOGetLastError().decode())
+            return
         n = len(buf)
         self.handle.write(struct.pack("<II", _MAGIC, n & _LEN_MASK))
         self.handle.write(buf)
@@ -79,6 +121,17 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._nhandle:
+            buf = ctypes.c_void_p()
+            size = ctypes.c_uint64()
+            rc = self._nlib.MXNativeRecordIOReaderRead(
+                self._nhandle, ctypes.byref(buf), ctypes.byref(size))
+            if rc == 1:
+                return None
+            if rc != 0:
+                raise MXNetError(
+                    self._nlib.MXNativeRecordIOGetLastError().decode())
+            return ctypes.string_at(buf, size.value)
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -128,8 +181,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        assert not self.writable
-        self.handle.seek(self.idx[idx])
+        self._seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,311 @@
+// Threaded var-dependency engine — the native core of mxnet_tpu's host
+// scheduler (SURVEY.md N1).
+//
+// Reference analog: src/engine/threaded_engine.{h,cc} +
+// threaded_engine_perdevice.cc.  Semantics preserved:
+//  - ops declare const (read) and mutable (write) vars; an op runs when every
+//    var has granted its access (ThreadedVar queue protocol,
+//    threaded_engine.cc:51-143: FIFO queue per var; head write granted alone,
+//    head reads granted together).
+//  - worker thread pool executes ready ops; priority ops jump the queue
+//    (threaded_engine_perdevice.cc priority CPU queue).
+//  - errors: a failing op poisons its mutable vars; WaitForVar surfaces the
+//    error code at the next sync point (std::exception_ptr protocol,
+//    threaded_engine.cc:466-468 — here an int code the Python layer maps back
+//    to the stored exception).
+//  - WaitForAll drains everything.
+//
+// TPU-native division of labor: device async belongs to XLA/PjRt; this engine
+// schedules HOST work (IO decode, kvstore reductions, checkpoint writes,
+// custom-op callbacks) so it overlaps device compute with exact read/write
+// ordering — the part of the reference engine TPU still needs.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+typedef int64_t (*EngineFn)(void* payload, int64_t prior_err);  // 0 = ok
+typedef void* EngineHandle;
+typedef void* VarHandle;
+}
+
+namespace mxnet_tpu {
+
+struct Opr;
+
+struct Var {
+  // FIFO of pending requests (opr, is_write) — VersionedVarBlock analog
+  std::deque<std::pair<Opr*, bool>> queue;
+  int granted_reads = 0;
+  bool granted_write = false;
+  int64_t err_code = 0;   // poisoned-var error (0 = none)
+  bool to_delete = false;
+};
+
+struct Opr {
+  EngineFn fn = nullptr;
+  void* payload = nullptr;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  int pending = 0;        // grants still outstanding
+  bool prio = false;
+  Var* delete_var = nullptr;  // set for DeleteVariable sentinel ops
+  std::function<void()> wait_state;  // set for WaitForVar sentinel ops
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_ready_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  Var* NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    Var* v = new Var();
+    all_vars_.insert(v);
+    return v;
+  }
+
+  void Push(EngineFn fn, void* payload, Var** cvars, int nc, Var** mvars,
+            int nm, int prio) {
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->payload = payload;
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mutable_vars.assign(mvars, mvars + nm);
+    op->prio = prio != 0;
+    Schedule(op);
+  }
+
+  // DeleteVariable: reference semantics — the var dies after all previously
+  // pushed ops touching it complete (engine.h DeleteVariable).
+  void DeleteVar(Var* v) {
+    Opr* op = new Opr();
+    op->fn = nullptr;
+    op->delete_var = v;
+    op->mutable_vars.push_back(v);
+    Schedule(op);
+  }
+
+  // Returns the var's error code (0 = clean) after all its pending writes
+  // (and reads) complete.
+  int64_t WaitForVar(Var* v) {
+    struct WaitState {
+      std::mutex m;
+      std::condition_variable cv;
+      bool done = false;
+    } st;
+    Opr* op = new Opr();
+    op->fn = nullptr;
+    op->payload = &st;
+    op->const_vars.push_back(v);
+    op->wait_state = [&st] {
+      std::unique_lock<std::mutex> lk(st.m);
+      st.done = true;
+      st.cv.notify_all();
+    };
+    Schedule(op);
+    std::unique_lock<std::mutex> lk(st.m);
+    st.cv.wait(lk, [&st] { return st.done; });
+    std::unique_lock<std::mutex> elk(mu_);
+    int64_t e = v->err_code;
+    v->err_code = 0;  // reference clears the exception once surfaced
+    return e;
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_drained_.wait(lk, [this] { return inflight_ == 0; });
+  }
+
+ private:
+  void Schedule(Opr* op) {
+    std::vector<Opr*> ready;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      ++inflight_;
+      op->pending = static_cast<int>(op->const_vars.size() +
+                                     op->mutable_vars.size());
+      if (op->pending == 0) {
+        ready.push_back(op);
+      } else {
+        for (Var* v : op->const_vars) v->queue.emplace_back(op, false);
+        for (Var* v : op->mutable_vars) v->queue.emplace_back(op, true);
+        for (Var* v : op->const_vars) TryGrant(v, &ready);
+        for (Var* v : op->mutable_vars) TryGrant(v, &ready);
+      }
+    }
+    Enqueue(ready);
+  }
+
+  // grant accesses at the head of v's queue (scheduler lock held)
+  void TryGrant(Var* v, std::vector<Opr*>* ready) {
+    while (!v->queue.empty()) {
+      auto [op, is_write] = v->queue.front();
+      if (is_write) {
+        if (v->granted_reads > 0 || v->granted_write) return;
+        v->granted_write = true;
+      } else {
+        if (v->granted_write) return;
+        ++v->granted_reads;
+      }
+      v->queue.pop_front();
+      if (--op->pending == 0) ready->push_back(op);
+      if (is_write) return;  // a write blocks everything behind it
+    }
+  }
+
+  void Enqueue(const std::vector<Opr*>& ready) {
+    if (ready.empty()) return;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      for (Opr* op : ready) {
+        if (op->prio)
+          prio_queue_.push_back(op);
+        else
+          queue_.push_back(op);
+      }
+    }
+    cv_ready_.notify_all();
+  }
+
+  void Execute(Opr* op) {
+    int64_t err = 0;
+    // dependent-op propagation: an op touching a poisoned var forwards the
+    // error (threaded_engine.h:255-256 exception chaining).  The callback is
+    // STILL invoked with the prior error so the language binding can release
+    // its closure state (it skips the user fn itself on prior_err != 0).
+    if (op->fn) {
+      std::unique_lock<std::mutex> lk(mu_);
+      for (Var* v : op->const_vars)
+        if (v->err_code) err = v->err_code;
+      for (Var* v : op->mutable_vars)
+        if (v->err_code) err = v->err_code;
+    }
+    if (op->wait_state) {
+      op->wait_state();
+    } else if (op->fn) {
+      err = op->fn(op->payload, err);
+    }
+    std::vector<Opr*> ready;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (err != 0) {
+        // poison mutable vars (exception_ptr-on-var analog)
+        for (Var* v : op->mutable_vars) v->err_code = err;
+      }
+      for (Var* v : op->const_vars) {
+        --v->granted_reads;
+        TryGrant(v, &ready);
+      }
+      for (Var* v : op->mutable_vars) {
+        v->granted_write = false;
+        if (op->delete_var == v) {
+          all_vars_.erase(v);
+          delete v;
+          continue;
+        }
+        TryGrant(v, &ready);
+      }
+      --inflight_;
+      if (inflight_ == 0) cv_drained_.notify_all();
+    }
+    delete op;
+    Enqueue(ready);
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_ready_.wait(lk, [this] {
+          return shutdown_ || !prio_queue_.empty() || !queue_.empty();
+        });
+        if (shutdown_ && prio_queue_.empty() && queue_.empty()) return;
+        if (!prio_queue_.empty()) {
+          op = prio_queue_.front();
+          prio_queue_.pop_front();
+        } else {
+          op = queue_.front();
+          queue_.pop_front();
+        }
+      }
+      Execute(op);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_ready_;
+  std::condition_variable cv_drained_;
+  std::deque<Opr*> queue_;
+  std::deque<Opr*> prio_queue_;
+  std::vector<std::thread> workers_;
+  std::unordered_set<Var*> all_vars_;
+  int inflight_ = 0;
+  bool shutdown_ = false;
+};
+
+}  // namespace mxnet_tpu
+
+// ---------------------------------------------------------------------------
+// C ABI (the c_api.h waist, SURVEY.md N17 — engine section)
+// ---------------------------------------------------------------------------
+using mxnet_tpu::Engine;
+using mxnet_tpu::Var;
+
+extern "C" {
+
+EngineHandle MXNativeEngineCreate(int num_workers) {
+  return new Engine(num_workers);
+}
+
+void MXNativeEngineFree(EngineHandle h) { delete static_cast<Engine*>(h); }
+
+VarHandle MXNativeEngineNewVar(EngineHandle h) {
+  return static_cast<Engine*>(h)->NewVar();
+}
+
+void MXNativeEngineDeleteVar(EngineHandle h, VarHandle v) {
+  static_cast<Engine*>(h)->DeleteVar(static_cast<Var*>(v));
+}
+
+void MXNativeEnginePush(EngineHandle h, EngineFn fn, void* payload,
+                        VarHandle* cvars, int nc, VarHandle* mvars, int nm,
+                        int prio) {
+  static_cast<Engine*>(h)->Push(fn, payload,
+                                reinterpret_cast<Var**>(cvars), nc,
+                                reinterpret_cast<Var**>(mvars), nm, prio);
+}
+
+int64_t MXNativeEngineWaitForVar(EngineHandle h, VarHandle v) {
+  return static_cast<Engine*>(h)->WaitForVar(static_cast<Var*>(v));
+}
+
+void MXNativeEngineWaitForAll(EngineHandle h) {
+  static_cast<Engine*>(h)->WaitForAll();
+}
+
+}  // extern "C"

@@ -1,0 +1,140 @@
+// RecordIO reader/writer — native data-pipeline framing (SURVEY.md N14/N24).
+//
+// Reference analog: dmlc-core RecordIO (consumed by src/io/* and
+// tools/im2rec.cc): each record is framed as
+//   uint32 magic = 0xced7230a
+//   uint32 lrec  = (cflag << 29) | length      (cflag 0 for whole records)
+//   data bytes, zero-padded to a 4-byte boundary
+// — byte-compatible with mxnet_tpu/recordio.py's Python fallback so files
+// written by either are read by both.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Writer {
+  FILE* f = nullptr;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<char> buf;
+};
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+}  // namespace
+
+extern "C" {
+
+const char* MXNativeRecordIOGetLastError() { return g_last_error.c_str(); }
+
+void* MXNativeRecordIOWriterCreate(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    set_error(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  Writer* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int MXNativeRecordIOWriterWrite(void* h, const char* data, uint64_t size) {
+  Writer* w = static_cast<Writer*>(h);
+  if (size > kLenMask) {
+    set_error("record too large (> 2^29-1 bytes) for single-part framing");
+    return -1;
+  }
+  uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(size & kLenMask)};
+  if (std::fwrite(hdr, sizeof(hdr), 1, w->f) != 1) {
+    set_error("short write (header)");
+    return -1;
+  }
+  if (size && std::fwrite(data, 1, size, w->f) != size) {
+    set_error("short write (payload)");
+    return -1;
+  }
+  uint64_t pad = (4 - (size & 3)) & 3;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, w->f) != pad) {
+    set_error("short write (pad)");
+    return -1;
+  }
+  return 0;
+}
+
+int64_t MXNativeRecordIOWriterTell(void* h) {
+  return std::ftell(static_cast<Writer*>(h)->f);
+}
+
+void MXNativeRecordIOWriterClose(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->f) std::fclose(w->f);
+  delete w;
+}
+
+void* MXNativeRecordIOReaderCreate(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    set_error(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  Reader* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns: 0 ok (out/out_size set; buffer valid until next call),
+//          1 clean EOF, -1 error.
+int MXNativeRecordIOReaderRead(void* h, const char** out,
+                               uint64_t* out_size) {
+  Reader* r = static_cast<Reader*>(h);
+  uint32_t hdr[2];
+  size_t got = std::fread(hdr, sizeof(uint32_t), 2, r->f);
+  if (got == 0) return 1;  // EOF at a record boundary
+  if (got != 2) {
+    set_error("truncated record header");
+    return -1;
+  }
+  if (hdr[0] != kMagic) {
+    set_error("bad magic (corrupt recordio file)");
+    return -1;
+  }
+  uint64_t size = hdr[1] & kLenMask;
+  uint64_t padded = (size + 3) & ~uint64_t(3);
+  r->buf.resize(padded);
+  if (padded && std::fread(r->buf.data(), 1, padded, r->f) != padded) {
+    set_error("truncated record payload");
+    return -1;
+  }
+  *out = r->buf.data();
+  *out_size = size;
+  return 0;
+}
+
+int MXNativeRecordIOReaderSeek(void* h, uint64_t pos) {
+  return std::fseek(static_cast<Reader*>(h)->f, static_cast<long>(pos),
+                    SEEK_SET);
+}
+
+int64_t MXNativeRecordIOReaderTell(void* h) {
+  return std::ftell(static_cast<Reader*>(h)->f);
+}
+
+void MXNativeRecordIOReaderClose(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

@@ -122,4 +122,11 @@ def test_env_selects_engine(monkeypatch):
     assert isinstance(eng.get(), eng.NaiveEngine)
     eng.set_engine(None)
     monkeypatch.delenv("MXNET_ENGINE_TYPE")
-    assert isinstance(eng.get(), eng.ThreadedEngine)
+    # default = ThreadedEnginePerDevice: the native C++ engine when the
+    # library is built, the Python pool otherwise
+    assert isinstance(eng.get(), (eng.NativeThreadedEngine,
+                                  eng.ThreadedEngine))
+    eng.set_engine(None)
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "ThreadedEngine")
+    assert type(eng.get()) is eng.ThreadedEngine
+    eng.set_engine(None)
