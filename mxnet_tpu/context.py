@@ -89,10 +89,12 @@ class Context:
             dev_type = "cpu"
         if dev_type == "gpu":  # alias: accelerator of the platform
             dev_type = _accelerator_platform()
+        # multi-process: a context addresses THIS process's devices (the
+        # reference's per-worker device numbering)
         try:
-            devs = jax.devices(dev_type)
+            devs = jax.local_devices(backend=dev_type)
         except RuntimeError:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def empty_cache(self):

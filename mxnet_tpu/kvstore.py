@@ -39,7 +39,7 @@ class KVStore:
         self._store: Dict[str, NDArray] = {}
         self._updater = None
         self._optimizer = None
-        self._compression = {}
+        self._compression = None
 
     # ---- core API -------------------------------------------------------
     def init(self, key, value):
@@ -128,9 +128,20 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression (reference N13).  On TPU intra-host
-        reduction is exact; accepted for API parity, applied only on the
-        dist path (DCN) where bandwidth matters."""
-        self._compression = dict(compression_params)
+        reduction is exact; accepted for API parity, applied on the dist
+        path (DCN) where bandwidth matters."""
+        from .kvstore_compression import GradientCompression
+        params = dict(compression_params)
+        ctype = params.pop("type", "2bit")
+        threshold = float(params.pop("threshold", 0.5))
+        if params:
+            raise MXNetError("unknown compression params %s" % list(params))
+        self._compression = GradientCompression(type=ctype,
+                                                threshold=threshold)
+
+    @property
+    def gradient_compression(self):
+        return self._compression
 
     @property
     def type(self):
@@ -195,6 +206,7 @@ class DistKVStore(KVStore):
         return self._pg.size
 
     def push(self, key, value, priority=0):
+        from .ndarray.ndarray import NDArray
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vlist = v if isinstance(v, (list, tuple)) else [v]
@@ -203,6 +215,12 @@ class DistKVStore(KVStore):
                 agg = vlist[0].copy()
                 for x in vlist[1:]:
                     agg += x.as_in_context(agg.context)
+            if self._compression:
+                # each worker ships its quantized gradient (2-bit + error
+                # feedback, N13); summing dequantized streams across ranks
+                # == the reference PS aggregating decompressed pushes
+                agg = NDArray(self._compression.compress(k, agg._data),
+                              agg.context)
             agg = self._pg.allreduce(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])

@@ -1,0 +1,90 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Reference analog: ``src/kvstore/gradient_compression.{h,cc,cu}`` (SURVEY.md
+N13): ``kTwoBit`` stochastic-free threshold quantization — each gradient
+element becomes {+threshold, 0, -threshold}; the quantization error is kept
+in a per-key residual added to the next gradient (error feedback), so the
+compressed stream is unbiased over time.  Wire format: 16 two-bit codes per
+uint32 word (gradient_compression.cc quantize_2bit kernel).
+
+TPU-native: the quantize/dequantize math is an XLA elementwise program; the
+packed wire form is provided for DCN transport parity, while the in-process
+dist path compresses semantically (quantize → all-reduce of dequantized
+values), which is bit-equivalent to PS-side aggregation of decompressed
+pushes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    """Threshold 2-bit compressor (reference gradient_compression.h:38-133)."""
+
+    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002 - ref name
+        if type != "2bit":
+            raise MXNetError("unsupported compression type %r "
+                             "(reference supports kTwoBit only)" % type)
+        if threshold <= 0:
+            raise MXNetError("compression threshold must be > 0")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals: Dict[str, jax.Array] = {}
+
+    def get_params(self):
+        return {"type": self.type, "threshold": str(self.threshold)}
+
+    # ---- semantic compression (the dist push path) -----------------------
+    def compress(self, key: str, grad: jax.Array) -> jax.Array:
+        """Quantize grad+residual to {-t, 0, +t}, updating the residual
+        (error feedback — gradient_compression.cc quantize_2bit)."""
+        t = self.threshold
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad.shape:
+            res = jnp.zeros_like(grad)
+        acc = grad + res
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0)) \
+            .astype(grad.dtype)
+        self._residuals[key] = acc - q
+        return q
+
+    # ---- wire format (DCN transport parity) ------------------------------
+    @staticmethod
+    def pack(q: np.ndarray) -> np.ndarray:
+        """Pack quantized values into 2-bit sign codes, 16 per uint32
+        (codes: 0 = zero, 1 = positive, 2 = negative); magnitudes are
+        implied by the threshold used at unpack."""
+        flat = np.asarray(q, np.float32).ravel()
+        codes = np.zeros(flat.shape, np.uint32)
+        codes[flat > 0] = 1
+        codes[flat < 0] = 2
+        pad = (-len(codes)) % 16
+        if pad:
+            codes = np.concatenate([codes, np.zeros(pad, np.uint32)])
+        codes = codes.reshape(-1, 16)
+        words = np.zeros(codes.shape[0], np.uint32)
+        for i in range(16):
+            words |= codes[:, i] << np.uint32(2 * i)
+        return words
+
+    @staticmethod
+    def unpack(words: np.ndarray, n: int, threshold: float,
+               dtype=np.float32) -> np.ndarray:
+        """Inverse of :meth:`pack`: first ``n`` codes back to values."""
+        words = np.asarray(words, np.uint32)
+        codes = np.zeros((len(words), 16), np.uint32)
+        for i in range(16):
+            codes[:, i] = (words >> np.uint32(2 * i)) & np.uint32(3)
+        codes = codes.ravel()[:n]
+        out = np.zeros(n, dtype)
+        out[codes == 1] = threshold
+        out[codes == 2] = -threshold
+        return out

@@ -56,33 +56,47 @@ class ProcessGroup:
         self.rank = jax.process_index()
         self.size = jax.process_count()
         self._mesh = None
+        self._sum_fn = None
 
-    def _global_mesh(self):
+    def _proc_mesh(self):
+        """Mesh with ONE representative device per process — the DCN
+        collective group (multi-pod-slice axis of SURVEY.md §5.8)."""
         if self._mesh is None:
             from jax.sharding import Mesh
-            self._mesh = Mesh(np.asarray(jax.devices()), ("all",))
+            rep = {}
+            for d in jax.devices():
+                rep.setdefault(d.process_index, d)
+            devs = [rep[p] for p in sorted(rep)]
+            self._mesh = Mesh(np.asarray(devs), ("proc",))
         return self._mesh
 
     def allreduce(self, arr):
         """Cross-process sum.  Single-process: identity (local reduce
-        already happened).  Multi-process: psum over the global mesh via
-        shard_map (XLA collective over DCN/ICI)."""
+        already happened).  Multi-process: each process contributes its
+        value as one shard of a process-sharded global array; a jit sum
+        over the shard axis is XLA's all-reduce over DCN — the TPU
+        replacement for the ps-lite push/aggregate cycle."""
         if self.size == 1:
             return arr
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
         from ..ndarray.ndarray import NDArray
-        mesh = self._global_mesh()
-        data = arr._data if isinstance(arr, NDArray) else arr
-
-        @jax.jit
-        def _psum(x):
-            f = shard_map(lambda v: jax.lax.psum(v, "all"), mesh=mesh,
-                          in_specs=P(), out_specs=P())
-            return f(x)
-
-        out = _psum(data)
-        return NDArray(out, arr._ctx) if isinstance(arr, NDArray) else out
+        data = arr._data if isinstance(arr, NDArray) else \
+            jax.numpy.asarray(arr)
+        mesh = self._proc_mesh()
+        sharding = NamedSharding(mesh, P("proc"))
+        my_dev = mesh.devices.ravel()[self.rank]
+        local = jax.device_put(jax.numpy.asarray(data)[None], my_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (self.size,) + tuple(data.shape), sharding, [local])
+        if self._sum_fn is None:
+            # ONE jitted collective reused for every push — a fresh lambda
+            # per call would miss the jit cache and retrace each time
+            self._sum_fn = jax.jit(lambda x: x.sum(axis=0),
+                                   out_shardings=NamedSharding(mesh, P()))
+        out = self._sum_fn(garr)
+        result = jax.numpy.asarray(np.asarray(out))
+        return NDArray(result, arr._ctx) if isinstance(arr, NDArray) \
+            else result
 
     def broadcast(self, arr, root=0):
         if self.size == 1:

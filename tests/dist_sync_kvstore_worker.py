@@ -1,0 +1,62 @@
+"""Worker script for the multi-process dist kvstore test.
+
+Parity model: tests/nightly/dist_sync_kvstore.py — each of N forked workers
+pushes rank-dependent values and asserts the exact cross-rank sums, incl.
+a gradient-compression round and a barrier.  Launched by
+tools/launch.py-style env (DMLC_*) from tests/test_dist_kvstore.py.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    nw = kv.num_workers
+    assert nw == int(os.environ["DMLC_NUM_WORKER"]), (nw, os.environ)
+
+    # dense push/pull: sum of (rank+1)*ones across ranks
+    kv.init("dense", nd.zeros((4, 3)))
+    kv.push("dense", nd.ones((4, 3)) * (rank + 1))
+    out = nd.zeros((4, 3))
+    kv.pull("dense", out=out)
+    expect = sum(r + 1 for r in range(nw))
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+    kv.barrier()
+
+    # second round with an updater-free assign of a different key
+    kv.init("k2", nd.zeros((2,)))
+    kv.push("k2", nd.array([float(rank), 1.0]))
+    out2 = nd.zeros((2,))
+    kv.pull("k2", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(),
+                               [sum(range(nw)), float(nw)], rtol=1e-6)
+
+    # gradient compression: each worker pushes 0.9 with threshold 0.5 ->
+    # each contributes +0.5 -> sum = 0.5 * nw; residual 0.4 carries over
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("c", nd.zeros((3,)))
+    kv2.push("c", nd.ones((3,)) * 0.9)
+    outc = nd.zeros((3,))
+    kv2.pull("c", out=outc)
+    np.testing.assert_allclose(outc.asnumpy(), 0.5 * nw, rtol=1e-6)
+    # second push: residual 0.4 + 0.2 grad = 0.6 -> quantized +0.5 again
+    kv2.push("c", nd.ones((3,)) * 0.2)
+    kv2.pull("c", out=outc)
+    np.testing.assert_allclose(outc.asnumpy(), 0.5 * nw, rtol=1e-6)
+
+    print("WORKER_%d_OK" % rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Distributed job launcher (parity: tools/launch.py + dmlc_tracker local).
+
+Reference analog: ``tools/launch.py:29-50`` — starts a scheduler, S servers
+and W workers via dmlc_tracker (ssh/mpi/local).  TPU-native: there is no
+parameter server; this launcher starts W worker processes wired to one JAX
+distributed coordinator (rank 0).  The reference's env contract is kept so
+``launch.py -n 4 python train.py --kv-store dist_sync`` works unchanged:
+
+  DMLC_ROLE=worker  DMLC_NUM_WORKER=W  DMLC_WORKER_ID=rank
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> the JAX coordinator address
+
+``-s`` (server count) is accepted and ignored with a note: dist_sync rides
+XLA collectives over DCN, not ps-lite (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers: int, command, env_extra=None) -> int:
+    """Fork ``num_workers`` local processes (the dmlc_tracker 'local'
+    backend pattern of tests/nightly/test_all.sh:55)."""
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    # poll rather than wait serially: when one rank dies the others may be
+    # blocked in the coordinator rendezvous forever — kill them fast
+    import time
+    rc = 0
+    alive = list(procs)
+    while alive:
+        time.sleep(0.2)
+        for p in list(alive):
+            code = p.poll()
+            if code is None:
+                continue
+            alive.remove(p)
+            if code != 0 and rc == 0:
+                rc = code
+                for q in alive:
+                    q.terminate()
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference compatibility; ignored "
+                         "(no parameter server on the TPU backend)")
+    ap.add_argument("--launcher", choices=["local"], default="local",
+                    help="only the local (single-host fork) tracker is "
+                         "built in; multi-host uses the cluster scheduler's "
+                         "own launcher + JAX coordinator env")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the training command to run on every worker")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — dist kvstore uses XLA "
+              "collectives, not parameter servers", file=sys.stderr)
+    return launch_local(args.num_workers, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
