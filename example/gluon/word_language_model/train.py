@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Gluon LSTM word language model (parity: example/gluon/
+word_language_model/train.py — BASELINE.json config #3).
+
+Trains an embedding + LSTM + decoder on a text corpus with truncated BPTT,
+reporting perplexity.  Without --data it trains on a built-in toy corpus so
+the example runs with zero downloads.
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.gluon import nn, rnn  # noqa: E402
+
+TOY_CORPUS = ("the quick brown fox jumps over the lazy dog . "
+              "a stitch in time saves nine . "
+              "all that glitters is not gold . ") * 200
+
+
+class Corpus:
+    def __init__(self, text):
+        tokens = text.split()
+        self.vocab = sorted(set(tokens))
+        self.tok2id = {t: i for i, t in enumerate(self.vocab)}
+        self.data = np.asarray([self.tok2id[t] for t in tokens], np.float32)
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return mx.nd.array(
+        data[: n * batch_size].reshape(batch_size, n).T)  # (T, N)
+
+
+class RNNModel(gluon.Block):
+    def __init__(self, vocab_size, embed=128, hidden=256, layers=2,
+                 dropout=0.2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, embed)
+            self.rnn = rnn.LSTM(hidden, num_layers=layers, dropout=dropout,
+                                input_size=embed)
+            self.decoder = nn.Dense(vocab_size, in_units=hidden)
+            self.hidden = hidden
+
+    def forward(self, inputs, state):
+        emb = self.drop(self.encoder(inputs))
+        output, state = self.rnn(emb, state)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.hidden)))
+        return decoded, state
+
+    def begin_state(self, *a, **kw):
+        return self.rnn.begin_state(*a, **kw)
+
+
+def detach(state):
+    if isinstance(state, (list, tuple)):
+        return [detach(s) for s in state]
+    return state.detach()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="path to a text corpus")
+    ap.add_argument("--batch-size", type=int, default=20)
+    ap.add_argument("--bptt", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    args = ap.parse_args()
+
+    text = open(args.data).read() if args.data else TOY_CORPUS
+    corpus = Corpus(text)
+    data = batchify(corpus.data, args.batch_size)
+    ntokens = len(corpus.vocab)
+    model = RNNModel(ntokens)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_loss, total_tokens = 0.0, 0
+        state = model.begin_state(batch_size=args.batch_size)
+        tic = time.time()
+        for i in range(0, data.shape[0] - 1, args.bptt):
+            seq = min(args.bptt, data.shape[0] - 1 - i)
+            x = data[i:i + seq]
+            y = data[i + 1:i + 1 + seq].reshape((-1,))
+            state = detach(state)
+            with mx.autograd.record():
+                out, state = model(x, state)
+                loss = loss_fn(out, y)
+            loss.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads,
+                                         args.clip * seq * args.batch_size)
+            trainer.step(seq * args.batch_size)
+            total_loss += float(loss.sum().asnumpy())
+            total_tokens += seq * args.batch_size
+        ppl = math.exp(total_loss / total_tokens)
+        print("epoch %d: perplexity %.2f (%.0f tokens/s)"
+              % (epoch, ppl, total_tokens / (time.time() - tic)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark over the model zoo (parity:
+example/image-classification/benchmark_score.py — synthetic inputs,
+img/s per network/batch-size)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def score(net_name, batch_size, image_size=224, warmup=3, iters=10):
+    net = getattr(vision, net_name)()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(batch_size, 3, image_size, image_size))
+    for _ in range(warmup):
+        net(x).wait_to_read()
+    tic = time.time()
+    for _ in range(iters):
+        net(x).wait_to_read()
+    return iters * batch_size / (time.time() - tic)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", default="resnet18_v1,resnet50_v1,"
+                    "mobilenet1_0,squeezenet1_0")
+    ap.add_argument("--batch-sizes", default="1,32")
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+    for name in args.networks.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            print("network: %-16s batch %3d: %8.1f img/s"
+                  % (name, bs, score(name, bs, args.image_size)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""ImageNet training (parity: example/image-classification/train_imagenet.py
+— the north-star benchmark driver, BASELINE.json config #2).
+
+``--benchmark 1`` runs on synthetic data (the reference's common/data.py
+synthetic iterator) and reports img/s; real data comes from an
+ImageRecordIter .rec produced by tools/im2rec.py.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from symbols import resnet  # noqa: E402
+
+
+class SyntheticIter(mx.io.DataIter):
+    """Random device-resident batches (common/data.py --benchmark 1)."""
+
+    def __init__(self, data_shape, batch_size, num_classes, num_batches=50):
+        super().__init__(batch_size)
+        rng = np.random.RandomState(0)
+        self._data = mx.nd.array(
+            rng.rand(batch_size, *data_shape).astype(np.float32))
+        self._label = mx.nd.array(
+            rng.randint(0, num_classes, (batch_size,)).astype(np.float32))
+        self._num = num_batches
+        self._i = 0
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (batch_size,) + data_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (batch_size,))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self._num:
+            raise StopIteration
+        self._i += 1
+        return mx.io.DataBatch(data=[self._data], label=[self._label])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--benchmark", type=int, default=0)
+    ap.add_argument("--num-batches", type=int, default=50)
+    ap.add_argument("--data-train", default=None,
+                    help=".rec file from tools/im2rec.py")
+    args = ap.parse_args()
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    sym = resnet.get_symbol(args.num_classes, args.num_layers,
+                            args.image_shape)
+
+    if args.benchmark or not args.data_train:
+        train = SyntheticIter(shape, args.batch_size, args.num_classes,
+                              args.num_batches)
+    else:
+        train = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_mirror=True)
+
+    mod = mx.mod.Module(sym)
+    tic = [time.time()]
+
+    def speed_cb(param):
+        if param.nbatch and param.nbatch % 10 == 0:
+            dt = time.time() - tic[0]
+            print("epoch %d batch %d: %.1f img/s"
+                  % (param.epoch, param.nbatch,
+                     10 * args.batch_size / max(dt, 1e-9)))
+            tic[0] = time.time()
+
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            eval_metric="acc", num_epoch=args.num_epochs,
+            kvstore=args.kv_store, batch_end_callback=[speed_cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on MNIST (parity: example/image-classification/
+train_mnist.py — BASELINE.json config #1).
+
+Uses MNISTIter when the idx files exist under --data-dir; otherwise a
+synthetic stand-in iterator so the example runs anywhere (the reference's
+``--benchmark`` synthetic-data pattern, common/data.py).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_mlp():
+    data = mx.sym.var("data")
+    flat = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def get_lenet():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50)
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flat = mx.sym.Flatten(p2)
+    f1 = mx.sym.FullyConnected(flat, num_hidden=500)
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a3, num_hidden=10)
+    return mx.sym.SoftmaxOutput(f2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def get_iters(args, flat):
+    shape = (784,) if flat else (1, 28, 28)
+    train_img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(train_img):
+        train = mx.io.MNISTIter(
+            image=train_img,
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            data_shape=shape, batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            data_shape=shape, batch_size=args.batch_size)
+        return train, val
+    # synthetic learnable stand-in: 10 class prototypes + noise
+    rng = np.random.RandomState(0)
+    n = 2000
+    protos = rng.rand(10, int(np.prod(shape))).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    x = (protos[y.astype(int)] +
+         0.3 * rng.randn(n, protos.shape[1]).astype(np.float32))
+    x = x.reshape((n,) + shape)
+    split = int(n * 0.9)
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+    print("note: MNIST files not found under %s — training on a synthetic "
+          "stand-in" % args.data_dir)
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--ctx", default=None, choices=[None, "cpu", "tpu"])
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    ctx = {None: None, "cpu": mx.cpu(), "tpu": mx.tpu()}[args.ctx]
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_iters(args, flat=args.network == "mlp")
+
+    mod = mx.mod.Module(net, context=ctx or mx.context.current_context())
+    cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            eval_metric="acc", num_epoch=args.num_epochs,
+            kvstore=args.kv_store,
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs)
+    score = mod.score(val, mx.metric.Accuracy())
+    print("final validation accuracy:", dict(score))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""SSD object-detection training (parity: example/ssd/train.py —
+BASELINE.json config #4, compact form).
+
+A small VGG-style backbone with two multibox heads, trained on synthetic
+boxes: MultiBoxPrior anchors -> MultiBoxTarget assignment -> joint
+cls (SoftmaxOutput-style) + loc (smooth-L1) loss; inference decodes with
+MultiBoxDetection + box_nms.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class ToySSD(gluon.Block):
+    """Backbone + per-scale class/box predictors."""
+
+    def __init__(self, num_classes=2, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.sizes = [(0.2, 0.35), (0.4, 0.6)]
+        self.ratios = [(1.0, 2.0, 0.5)] * 2
+        self.anchors_per = len(self.sizes[0]) - 1 + len(self.ratios[0])
+        with self.name_scope():
+            self.body = nn.Sequential()
+            for f in (16, 32):
+                self.body.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
+                self.body.add(nn.MaxPool2D(2))
+            self.down = nn.Sequential()
+            self.down.add(nn.Conv2D(32, 3, padding=1, activation="relu"))
+            self.down.add(nn.MaxPool2D(2))
+            self.cls_preds = nn.Sequential()
+            self.box_preds = nn.Sequential()
+            for _ in range(2):
+                self.cls_preds.add(nn.Conv2D(
+                    self.anchors_per * (num_classes + 1), 3, padding=1))
+                self.box_preds.add(nn.Conv2D(self.anchors_per * 4, 3,
+                                             padding=1))
+
+    def forward(self, x):
+        feats = [self.body(x)]
+        feats.append(self.down(feats[0]))
+        anchors, cls_preds, box_preds = [], [], []
+        for i, f in enumerate(feats):
+            anchors.append(nd.contrib.MultiBoxPrior(
+                f, sizes=self.sizes[i], ratios=self.ratios[i]))
+            c = self.cls_preds[i](f)
+            cls_preds.append(
+                c.transpose((0, 2, 3, 1)).reshape((c.shape[0], -1)))
+            b = self.box_preds[i](f)
+            box_preds.append(
+                b.transpose((0, 2, 3, 1)).reshape((b.shape[0], -1)))
+        anchors = nd.concat(*anchors, dim=1)
+        cls_preds = nd.concat(*cls_preds, dim=1).reshape(
+            (x.shape[0], -1, self.num_classes + 1))
+        box_preds = nd.concat(*box_preds, dim=1)
+        return anchors, cls_preds, box_preds
+
+
+def synthetic_batch(batch_size, rng):
+    """Images with one bright square; label = its box, class 0."""
+    imgs = rng.rand(batch_size, 3, 64, 64).astype(np.float32) * 0.2
+    labels = np.full((batch_size, 1, 5), -1.0, np.float32)
+    for i in range(batch_size):
+        s = rng.randint(12, 28)
+        x0 = rng.randint(0, 64 - s)
+        y0 = rng.randint(0, 64 - s)
+        imgs[i, :, y0:y0 + s, x0:x0 + s] = 1.0
+        labels[i, 0] = [0, x0 / 64, y0 / 64, (x0 + s) / 64, (y0 + s) / 64]
+    return nd.array(imgs), nd.array(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-batches", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    net = ToySSD()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss()
+
+    tic = time.time()
+    for it in range(args.num_batches):
+        x, y = synthetic_batch(args.batch_size, rng)
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            box_t, box_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, y, cls_preds.transpose((0, 2, 1)),
+                negative_mining_ratio=3.0)
+            l_cls = cls_loss(cls_preds, cls_t)
+            l_box = box_loss(box_preds * box_m, box_t * box_m)
+            loss = l_cls + l_box
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it % 10 == 0:
+            print("batch %3d: cls %.4f box %.4f (%.1f img/s)"
+                  % (it, float(l_cls.mean().asnumpy()),
+                     float(l_box.mean().asnumpy()),
+                     args.batch_size * 10 / max(time.time() - tic, 1e-9)))
+            tic = time.time()
+
+    # inference: decode + NMS
+    x, y = synthetic_batch(2, rng)
+    anchors, cls_preds, box_preds = net(x)
+    probs = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    det = nd.contrib.MultiBoxDetection(probs, box_preds, anchors,
+                                       nms_threshold=0.45)
+    kept = det.asnumpy()[0]
+    kept = kept[kept[:, 0] >= 0][:3]
+    print("top detections (id, score, box):")
+    for row in kept:
+        print("  ", np.round(row, 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,8 @@ from . import visualization
 from . import visualization as viz
 from . import rtc
 from . import test_utils
+from . import predictor
+from .predictor import Predictor
 
 
 def kvstore_create(name="local"):

@@ -135,16 +135,18 @@ def _accelerator_platform() -> str:
 
 
 def num_gpus() -> int:
-    """Number of accelerator devices visible (reference: mx.context.num_gpus)."""
+    """Number of accelerator devices THIS process addresses (reference:
+    mx.context.num_gpus — per-worker device count, matching jax_device's
+    local resolution)."""
     plat = _accelerator_platform()
     if plat == "cpu":
         return 0
-    return len(jax.devices(plat))
+    return len(jax.local_devices(backend=plat))
 
 
 def num_tpus() -> int:
     try:
-        return len(jax.devices("tpu"))
+        return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return num_gpus()
 

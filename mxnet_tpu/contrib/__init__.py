@@ -1,3 +1,5 @@
 """Contrib package (parity: python/mxnet/contrib/): quantization,
 text utilities, ONNX import, experimental APIs."""
 from . import quantization  # noqa: F401
+from . import text          # noqa: F401
+from . import onnx          # noqa: F401

@@ -1,0 +1,254 @@
+"""ONNX model import.
+
+Reference analog: ``python/mxnet/contrib/onnx/`` (onnx2mx import_model /
+import_to_gluon — SURVEY.md §2.3 contrib): converts an ONNX GraphProto into
+a Symbol + parameter dict.
+
+The converter itself (:func:`import_graph`) is pure and duck-typed over the
+ONNX protobuf objects, so it needs only the ``onnx`` package for *loading*
+files (:func:`import_model`); environments without onnx installed can still
+convert in-memory graph objects (this is also how the unit tests exercise
+every op converter without the package).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["import_model", "import_graph", "get_model_metadata"]
+
+
+def _attrs_of(node) -> dict:
+    """AttributeProto list -> python dict (ints/floats/strings/tuples)."""
+    out = {}
+    for a in node.attribute:
+        name = a.name
+        # AttributeProto.type enum: 1=FLOAT 2=INT 3=STRING 4=TENSOR
+        # 6=FLOATS 7=INTS 8=STRINGS
+        if getattr(a, "type", None) == 1 or _has(a, "f"):
+            out[name] = float(a.f)
+        if getattr(a, "type", None) == 2 or _has(a, "i"):
+            out[name] = int(a.i)
+        if getattr(a, "type", None) == 3 or _has(a, "s"):
+            s = a.s
+            out[name] = s.decode() if isinstance(s, bytes) else s
+        if len(getattr(a, "ints", ())):
+            out[name] = tuple(int(x) for x in a.ints)
+        if len(getattr(a, "floats", ())):
+            out[name] = tuple(float(x) for x in a.floats)
+    return out
+
+
+def _has(obj, field):
+    try:
+        return obj.HasField(field)
+    except (AttributeError, ValueError):
+        return getattr(obj, field, None) not in (None, 0, 0.0, b"", "")
+
+
+def _tensor_to_np(t) -> np.ndarray:
+    """TensorProto -> numpy (float/int tensors; raw or field data)."""
+    shape = tuple(t.dims)
+    raw = getattr(t, "raw_data", b"")
+    # TensorProto.DataType: 1=FLOAT 6=INT32 7=INT64 11=DOUBLE
+    dt = {1: np.float32, 6: np.int32, 7: np.int64,
+          11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
+    if raw:
+        arr = np.frombuffer(raw, dtype=dt)
+    elif len(getattr(t, "float_data", ())):
+        arr = np.asarray(list(t.float_data), np.float32)
+    elif len(getattr(t, "int64_data", ())):
+        arr = np.asarray(list(t.int64_data), np.int64)
+    elif len(getattr(t, "int32_data", ())):
+        arr = np.asarray(list(t.int32_data), np.int32)
+    elif len(getattr(t, "double_data", ())):
+        arr = np.asarray(list(t.double_data), np.float64)
+    else:
+        arr = np.zeros(shape, dt)
+    return arr.reshape(shape) if shape else arr.reshape(())
+
+
+def _pool_attrs(attrs):
+    kernel = attrs.get("kernel_shape", (1, 1))
+    stride = attrs.get("strides", (1,) * len(kernel))
+    pads = attrs.get("pads", (0,) * 2 * len(kernel))
+    begin, end = tuple(pads[:len(kernel)]), tuple(pads[len(kernel):])
+    if end and begin != end:
+        raise MXNetError("asymmetric ONNX pads %s are unsupported "
+                         "(symmetric padding only)" % (pads,))
+    return kernel, stride, begin
+
+
+def import_graph(graph):
+    """Convert an ONNX GraphProto (duck-typed) -> (sym, arg_params,
+    aux_params)."""
+    from .. import ndarray as nd
+    from .. import symbol as S
+
+    params: Dict[str, np.ndarray] = {}
+    for init in graph.initializer:
+        params[init.name] = _tensor_to_np(init)
+
+    env: Dict[str, object] = {}
+    for inp in graph.input:
+        if inp.name not in params:
+            env[inp.name] = S.var(inp.name)
+    for name in params:
+        env[name] = S.var(name)
+
+    def conv(node):
+        attrs = _attrs_of(node)
+        kernel, stride, pad = _pool_attrs(attrs)
+        wname = node.input[1]
+        num_filter = params[wname].shape[0]
+        args = [env[i] for i in node.input]
+        return S.Convolution(*args, kernel=kernel, stride=stride, pad=pad,
+                             num_filter=num_filter,
+                             num_group=attrs.get("group", 1),
+                             dilate=attrs.get("dilations",
+                                              (1,) * len(kernel)),
+                             no_bias=len(node.input) < 3,
+                             name=node.name or node.output[0])
+
+    def gemm(node):
+        attrs = _attrs_of(node)
+        if attrs.get("transA", 0):
+            raise MXNetError("ONNX Gemm with transA=1 is unsupported")
+        a, w = env[node.input[0]], env[node.input[1]]
+        num_hidden = params[node.input[1]].shape[
+            1 if attrs.get("transB", 0) == 0 else 0]
+        if attrs.get("transB", 0) == 0:
+            # our FullyConnected expects (out, in): pre-transpose the param
+            params[node.input[1]] = params[node.input[1]].T
+        # fold alpha/beta scaling into the (initializer) params
+        alpha = attrs.get("alpha", 1.0)
+        beta = attrs.get("beta", 1.0)
+        if alpha != 1.0:
+            params[node.input[1]] = params[node.input[1]] * np.float32(alpha)
+        if beta != 1.0 and len(node.input) > 2:
+            params[node.input[2]] = params[node.input[2]] * np.float32(beta)
+        ins = [a, w] + ([env[node.input[2]]] if len(node.input) > 2 else [])
+        return S.FullyConnected(*ins, num_hidden=num_hidden,
+                                no_bias=len(node.input) < 3,
+                                name=node.name or node.output[0])
+
+    def pool(kind):
+        def f(node):
+            attrs = _attrs_of(node)
+            kernel, stride, pad = _pool_attrs(attrs)
+            return S.Pooling(env[node.input[0]], kernel=kernel,
+                             stride=stride, pad=pad, pool_type=kind,
+                             name=node.name or node.output[0])
+        return f
+
+    def global_pool(kind):
+        def f(node):
+            return S.Pooling(env[node.input[0]], global_pool=True,
+                             kernel=(1, 1), pool_type=kind,
+                             name=node.name or node.output[0])
+        return f
+
+    def batchnorm(node):
+        attrs = _attrs_of(node)
+        ins = [env[i] for i in node.input]
+        return S.BatchNorm(*ins, eps=attrs.get("epsilon", 1e-5),
+                           momentum=attrs.get("momentum", 0.9),
+                           fix_gamma=False,
+                           name=node.name or node.output[0])
+
+    def reshape(node):
+        shape = params.pop(node.input[1], None)
+        if shape is None:
+            raise MXNetError("ONNX Reshape with dynamic shape input is "
+                             "unsupported")
+        env.pop(node.input[1], None)
+        return S.Reshape(env[node.input[0]],
+                         shape=tuple(int(x) for x in shape))
+
+    simple = {
+        "Relu": lambda n: S.Activation(env[n.input[0]], act_type="relu"),
+        "Sigmoid": lambda n: S.Activation(env[n.input[0]],
+                                          act_type="sigmoid"),
+        "Tanh": lambda n: S.Activation(env[n.input[0]], act_type="tanh"),
+        # ONNX opset < 13 defines the default Softmax axis as 1
+        "Softmax": lambda n: S.softmax(env[n.input[0]],
+                                       axis=_attrs_of(n).get("axis", 1)),
+        "Flatten": lambda n: S.Flatten(env[n.input[0]]),
+        "Add": lambda n: env[n.input[0]] + env[n.input[1]],
+        "Sub": lambda n: env[n.input[0]] - env[n.input[1]],
+        "Mul": lambda n: env[n.input[0]] * env[n.input[1]],
+        "MatMul": lambda n: S.dot(env[n.input[0]], env[n.input[1]]),
+        "Identity": lambda n: env[n.input[0]],
+        "Dropout": lambda n: S.Dropout(env[n.input[0]],
+                                       p=_attrs_of(n).get("ratio", 0.5)),
+        "Concat": lambda n: S.concat(*[env[i] for i in n.input],
+                                     dim=_attrs_of(n).get("axis", 1)),
+        "Conv": conv,
+        "Gemm": gemm,
+        "MaxPool": pool("max"),
+        "AveragePool": pool("avg"),
+        "GlobalMaxPool": global_pool("max"),
+        "GlobalAveragePool": global_pool("avg"),
+        "BatchNormalization": batchnorm,
+        "Reshape": reshape,
+    }
+
+    for node in graph.node:
+        fn = simple.get(node.op_type)
+        if fn is None:
+            raise MXNetError("unsupported ONNX op %r (supported: %s)"
+                             % (node.op_type, sorted(simple)))
+        out_sym = fn(node)
+        outs = [out_sym] if node.output else []
+        for i, oname in enumerate(node.output):
+            env[oname] = out_sym[i] if len(node.output) > 1 else out_sym
+
+    out_names = [o.name for o in graph.output]
+    outs = [env[n] for n in out_names]
+    sym = outs[0] if len(outs) == 1 else S.Group(outs)
+
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: nd.array(v) for k, v in params.items()
+                  if k in arg_names}
+    aux_params = {k: nd.array(v) for k, v in params.items()
+                  if k in aux_names}
+    return sym, arg_params, aux_params
+
+
+def import_model(model_file):
+    """Load an .onnx file (requires the ``onnx`` package) and convert
+    (parity: contrib.onnx.import_model)."""
+    try:
+        import onnx
+    except ImportError as e:
+        raise ImportError(
+            "import_model requires the 'onnx' package to parse .onnx "
+            "files; in-memory graphs can be converted with import_graph"
+        ) from e
+    model = onnx.load(model_file)
+    return import_graph(model.graph)
+
+
+def get_model_metadata(model_file):
+    """Input/output descriptions of an .onnx file."""
+    try:
+        import onnx
+    except ImportError as e:
+        raise ImportError("get_model_metadata requires 'onnx'") from e
+    model = onnx.load(model_file)
+    g = model.graph
+    init = {i.name for i in g.initializer}
+
+    def shape_of(vi):
+        return tuple(d.dim_value for d in
+                     vi.type.tensor_type.shape.dim)
+
+    return {
+        "input_tensor_data": [(i.name, shape_of(i)) for i in g.input
+                              if i.name not in init],
+        "output_tensor_data": [(o.name, shape_of(o)) for o in g.output],
+    }

@@ -1,0 +1,190 @@
+"""Text utilities: vocabulary + token embeddings.
+
+Reference analog: ``python/mxnet/contrib/text/`` (vocab.py Vocabulary,
+embedding.py TokenEmbedding/CustomEmbedding, utils.py count_tokens_from_str)
+— SURVEY.md §2.3 contrib.  Pre-trained downloads are out of scope (no
+egress); ``CustomEmbedding`` loads any GloVe/word2vec-style text file.
+"""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str",
+           "get_pretrained_file_names"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (reference utils.count_tokens_from_str)."""
+    if to_lower:
+        source_str = source_str.lower()
+    tokens = [t for t in re.split(
+        "[%s%s]" % (re.escape(token_delim), re.escape(seq_delim)),
+        source_str) if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (reference vocab.py Vocabulary): tokens ordered by
+    descending frequency; index 0 is the unknown token; optional reserved
+    tokens follow it."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown token must not be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Tokens -> indices; unknown tokens map to index 0
+        (reference to_indices)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError("token index %d out of range" % i)
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class CustomEmbedding:
+    """Token embedding from a GloVe/word2vec-style text file
+    (reference embedding.py CustomEmbedding): each line
+    ``token v1 v2 ... vD``; unknown tokens get ``init_unknown_vec``."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, init_unknown_vec=None,
+                 vec_len=None, tokens_with_vecs=None):
+        from .. import ndarray as nd
+        vectors: Dict[str, np.ndarray] = {}
+        if pretrained_file_path is not None:
+            with open(pretrained_file_path, encoding=encoding) as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if len(parts) < 2:
+                        continue
+                    vec = np.asarray([float(x) for x in parts[1:]],
+                                     np.float32)
+                    if vec_len is None:
+                        vec_len = len(vec)
+                    elif len(vec) != vec_len:
+                        raise MXNetError(
+                            "inconsistent embedding dim at token %r"
+                            % parts[0])
+                    vectors[parts[0]] = vec
+        if tokens_with_vecs:
+            for tok, vec in tokens_with_vecs.items():
+                vec = np.asarray(vec, np.float32)
+                vec_len = vec_len or len(vec)
+                vectors[tok] = vec
+        if vec_len is None:
+            raise MXNetError("no embedding vectors given")
+        self.vec_len = vec_len
+        if vocabulary is None:
+            vocabulary = Vocabulary(
+                collections.Counter({t: 1 for t in vectors}))
+        self._vocab = vocabulary
+        init = init_unknown_vec or (lambda shape: np.zeros(shape,
+                                                           np.float32))
+        table = np.stack([
+            vectors.get(tok, np.asarray(init((vec_len,)), np.float32))
+            for tok in vocabulary.idx_to_token])
+        self._idx_to_vec = nd.array(table)
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._vocab.token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._vocab.idx_to_token
+
+    def __len__(self):
+        return len(self._vocab)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        from .. import ndarray as nd
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self.token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self.token_to_idx.get(t.lower())
+            idxs.append(0 if i is None else i)
+        vecs = nd.take(self._idx_to_vec, nd.array(idxs, dtype="int32"))
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        from .. import ndarray as nd
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors, np.float32)
+        arr = arr.reshape(len(toks), self.vec_len)
+        table = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, arr):
+            if t not in self.token_to_idx:
+                raise MXNetError("token %r not in vocabulary" % t)
+            table[self.token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(table)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference API shape; pre-trained downloads need egress, so none are
+    bundled — use CustomEmbedding with a local file."""
+    return {} if embedding_name is None else []

@@ -206,9 +206,10 @@ class DistKVStore(KVStore):
         return self._pg.size
 
     def push(self, key, value, priority=0):
-        from .ndarray.ndarray import NDArray
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
             vlist = v if isinstance(v, (list, tuple)) else [v]
             agg = vlist[0]
             if len(vlist) > 1:

@@ -796,5 +796,7 @@ def load(fname: str):
         return {p.split(":", 1)[1]: _load_entry(z, p) for p in prefixes}
 
 
-def _norm(fname: str) -> str:
+def _norm(fname):
+    if not isinstance(fname, str):
+        return fname  # file-like object (predictor bytes-params path)
     return fname if fname.endswith(".npz") else fname + ".npz"

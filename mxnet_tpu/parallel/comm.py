@@ -94,7 +94,9 @@ class ProcessGroup:
             self._sum_fn = jax.jit(lambda x: x.sum(axis=0),
                                    out_shardings=NamedSharding(mesh, P()))
         out = self._sum_fn(garr)
-        result = jax.numpy.asarray(np.asarray(out))
+        # fully replicated: take this process's shard directly — no
+        # device->host->device round-trip on the gradient hot path
+        result = out.addressable_data(0)
         return NDArray(result, arr._ctx) if isinstance(arr, NDArray) \
             else result
 

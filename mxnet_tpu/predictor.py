@@ -1,0 +1,134 @@
+"""Inference-only predict API.
+
+Reference analog: ``include/mxnet/c_predict_api.h:78-200`` +
+``src/c_api/c_predict_api.cc`` (SURVEY.md N18): create a predictor from a
+symbol JSON + a parameter blob + input shapes, then
+``set_input → forward → get_output`` — the minimal embedding surface used by
+the amalgamation/mobile builds.
+
+TPU-native: the bound graph compiles to ONE fused XLA inference program per
+input shape (the ``MXNET_PREDICT_ONLY`` engine fallback becomes simply "no
+gradient graph").
+"""
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(nd_bytes: bytes):
+    """Load a parameter blob (the MXNDArray save format) into a dict
+    (parity: MXNDListCreate in c_predict_api)."""
+    from . import ndarray as nd
+    bio = io.BytesIO(nd_bytes)
+    return nd.load(bio)
+
+
+class Predictor:
+    """Forward-only executor (parity: MXPredCreate family).
+
+    Parameters
+    ----------
+    symbol_json : str
+        Symbol JSON (the string itself or a path ending in .json).
+    params : bytes | dict | str
+        Parameter blob bytes (save format), a {name: NDArray} dict (with
+        optional ``arg:``/``aux:`` name prefixes, checkpoint convention),
+        or a path to a .params file.
+    ctx : Context, optional
+    input_shapes : dict of name -> shape
+    """
+
+    def __init__(self, symbol_json, params, ctx=None, input_shapes=None,
+                 dev_type=None, dev_id=0):
+        from . import context as _ctx_mod
+        from . import ndarray as nd
+        from . import symbol as sym_mod
+
+        if dev_type is not None:
+            ctx = _ctx_mod.Context(dev_type, dev_id)
+        self._ctx = ctx or _ctx_mod.current_context()
+
+        if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
+            with open(symbol_json) as f:
+                symbol_json = f.read()
+        self._symbol = sym_mod.load_json(symbol_json)
+
+        if isinstance(params, (bytes, bytearray)):
+            loaded = load_ndarray_file(bytes(params))
+        elif isinstance(params, str):
+            loaded = nd.load(params)
+        else:
+            loaded = dict(params)
+        self._arg_params = {}
+        self._aux_params = {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                self._arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self._aux_params[k[4:]] = v
+            else:
+                self._arg_params[k] = v
+
+        input_shapes = dict(input_shapes or {})
+        if not input_shapes:
+            raise MXNetError("Predictor needs input_shapes (e.g. "
+                             "{'data': (1, 3, 224, 224)})")
+        self._input_names = list(input_shapes)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in input_shapes:
+                args[name] = nd.zeros(input_shapes[name], ctx=self._ctx)
+            elif name in self._arg_params:
+                args[name] = self._arg_params[name].as_in_context(self._ctx)
+            else:
+                raise MXNetError("missing parameter %r" % name)
+        auxs = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in self._aux_params:
+                auxs[name] = self._aux_params[name].as_in_context(self._ctx)
+            else:
+                auxs[name] = nd.zeros(shape, ctx=self._ctx)
+        self._executor = self._symbol.bind(self._ctx, args, grad_req="null",
+                                           aux_states=auxs)
+        self._outputs = None
+
+    # ---- the C predict API surface ---------------------------------------
+    def set_input(self, name, value):
+        """MXPredSetInput."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r (have %s)"
+                             % (name, self._input_names))
+        self._executor.arg_dict[name][:] = np.asarray(
+            value.asnumpy() if hasattr(value, "asnumpy") else value)
+
+    def forward(self, **inputs):
+        """MXPredForward; keyword inputs are a convenience for set_input."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._outputs = self._executor.forward(is_train=False)
+        return self._outputs
+
+    def get_output(self, index=0):
+        """MXPredGetOutput."""
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output()")
+        return self._outputs[index]
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: rebind for new input shapes."""
+        return Predictor(self._symbol.tojson(),
+                         {**{"arg:" + k: v for k, v in
+                             self._arg_params.items()},
+                          **{"aux:" + k: v for k, v in
+                             self._aux_params.items()}},
+                         ctx=self._ctx, input_shapes=input_shapes)

@@ -1,0 +1,256 @@
+"""Tests for predict API, ONNX import, contrib.text, im2rec.
+
+Parity model: reference c_predict_api usage, tests/python-pytest/onnx,
+tests/python/unittest/test_contrib_text.py, tools/im2rec flows.
+"""
+import os
+import subprocess
+import sys
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestPredictor:
+    def _toy_model(self, tmp_path):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = sym.softmax(fc, name="softmax")
+        rng = np.random.RandomState(0)
+        params = {"arg:fc_weight": nd.array(rng.randn(4, 6)
+                                            .astype(np.float32)),
+                  "arg:fc_bias": nd.array(rng.randn(4).astype(np.float32))}
+        json_path = str(tmp_path / "m-symbol.json")
+        with open(json_path, "w") as f:
+            f.write(out.tojson())
+        params_path = str(tmp_path / "m-0001.params")
+        nd.save(params_path, params)
+        return out, params, json_path, params_path
+
+    def test_create_forward_get_output(self, tmp_path):
+        out, params, json_path, params_path = self._toy_model(tmp_path)
+        pred = mx.predictor.Predictor(json_path, params_path,
+                                      input_shapes={"data": (2, 6)})
+        x = np.random.RandomState(1).rand(2, 6).astype(np.float32)
+        pred.set_input("data", x)
+        pred.forward()
+        got = pred.get_output(0).asnumpy()
+        # reference executor answer
+        ex = out.bind(mx.cpu(), {"data": nd.array(x),
+                                 "fc_weight": params["arg:fc_weight"],
+                                 "fc_bias": params["arg:fc_bias"]})
+        np.testing.assert_allclose(got, ex.forward()[0].asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_reshape(self, tmp_path):
+        _, _, json_path, params_path = self._toy_model(tmp_path)
+        pred = mx.predictor.Predictor(json_path, params_path,
+                                      input_shapes={"data": (2, 6)})
+        pred2 = pred.reshape({"data": (5, 6)})
+        pred2.forward(data=np.zeros((5, 6), np.float32))
+        assert pred2.get_output(0).shape == (5, 4)
+
+    def test_errors(self, tmp_path):
+        _, _, json_path, params_path = self._toy_model(tmp_path)
+        with pytest.raises(mx.MXNetError):
+            mx.predictor.Predictor(json_path, params_path, input_shapes={})
+        pred = mx.predictor.Predictor(json_path, params_path,
+                                      input_shapes={"data": (1, 6)})
+        with pytest.raises(mx.MXNetError):
+            pred.get_output(0)
+        with pytest.raises(mx.MXNetError):
+            pred.set_input("bogus", np.zeros((1, 6)))
+
+
+# ---------------------------------------------------------------------------
+# ONNX import: duck-typed GraphProto mocks (no onnx package needed)
+# ---------------------------------------------------------------------------
+class _Attr:
+    def __init__(self, name, **kw):
+        self.name = name
+        self.type = kw.pop("type", 0)
+        self.f = kw.pop("f", 0.0)
+        self.i = kw.pop("i", 0)
+        self.s = kw.pop("s", b"")
+        self.ints = kw.pop("ints", ())
+        self.floats = kw.pop("floats", ())
+
+
+class _Node:
+    def __init__(self, op_type, inputs, outputs, name="", attrs=()):
+        self.op_type = op_type
+        self.input = list(inputs)
+        self.output = list(outputs)
+        self.name = name
+        self.attribute = list(attrs)
+
+
+class _Tensor:
+    def __init__(self, name, arr):
+        self.name = name
+        arr = np.asarray(arr, np.float32)
+        self.dims = list(arr.shape)
+        self.data_type = 1
+        self.raw_data = arr.tobytes()
+        self.float_data = ()
+        self.int64_data = ()
+        self.int32_data = ()
+        self.double_data = ()
+
+
+class _VI:
+    def __init__(self, name):
+        self.name = name
+
+
+class _Graph:
+    def __init__(self, nodes, inputs, outputs, initializers):
+        self.node = nodes
+        self.input = inputs
+        self.output = outputs
+        self.initializer = initializers
+
+
+class TestONNXImport:
+    def test_mlp_graph(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(6, 4).astype(np.float32)   # Gemm B, transB=0: (in,out)
+        b = rng.randn(4).astype(np.float32)
+        graph = _Graph(
+            nodes=[
+                _Node("Gemm", ["x", "w", "b"], ["h"], name="fc1"),
+                _Node("Relu", ["h"], ["a"]),
+                _Node("Softmax", ["a"], ["y"],
+                      attrs=[_Attr("axis", type=2, i=1)]),
+            ],
+            inputs=[_VI("x"), _VI("w"), _VI("b")],
+            outputs=[_VI("y")],
+            initializers=[_Tensor("w", w), _Tensor("b", b)])
+        s, args, auxs = mx.contrib.onnx.import_graph(graph)
+        x = rng.rand(2, 6).astype(np.float32)
+        ex = s.bind(mx.cpu(), {"x": nd.array(x), **args})
+        got = ex.forward()[0].asnumpy()
+        ref = x @ w + b
+        ref = np.maximum(ref, 0)
+        ref = np.exp(ref) / np.exp(ref).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_pool_graph(self):
+        rng = np.random.RandomState(1)
+        w = rng.randn(2, 3, 3, 3).astype(np.float32)
+        graph = _Graph(
+            nodes=[
+                _Node("Conv", ["x", "w"], ["c"], name="conv0", attrs=[
+                    _Attr("kernel_shape", ints=(3, 3)),
+                    _Attr("pads", ints=(1, 1, 1, 1)),
+                    _Attr("strides", ints=(1, 1))]),
+                _Node("Relu", ["c"], ["r"]),
+                _Node("MaxPool", ["r"], ["p"], attrs=[
+                    _Attr("kernel_shape", ints=(2, 2)),
+                    _Attr("strides", ints=(2, 2))]),
+                _Node("Flatten", ["p"], ["f"]),
+            ],
+            inputs=[_VI("x"), _VI("w")],
+            outputs=[_VI("f")],
+            initializers=[_Tensor("w", w)])
+        s, args, auxs = mx.contrib.onnx.import_graph(graph)
+        x = rng.rand(1, 3, 8, 8).astype(np.float32)
+        ex = s.bind(mx.cpu(), {"x": nd.array(x), **args})
+        out = ex.forward()[0]
+        assert out.shape == (1, 2 * 4 * 4)
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             pad=(1, 1), num_filter=2, no_bias=True)
+        ref = nd.Pooling(nd.relu(ref), kernel=(2, 2), stride=(2, 2),
+                         pool_type="max").asnumpy().reshape(1, -1)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_unsupported_op(self):
+        graph = _Graph(nodes=[_Node("NonMaxSuppression", ["x"], ["y"])],
+                       inputs=[_VI("x")], outputs=[_VI("y")],
+                       initializers=[])
+        with pytest.raises(mx.MXNetError, match="unsupported ONNX op"):
+            mx.contrib.onnx.import_graph(graph)
+
+
+class TestContribText:
+    def test_count_and_vocab(self):
+        counter = mx.contrib.text.count_tokens_from_str(
+            "a b b c c c\nd", to_lower=True)
+        assert counter == Counter({"c": 3, "b": 2, "a": 1, "d": 1})
+        vocab = mx.contrib.text.Vocabulary(counter, min_freq=2,
+                                           reserved_tokens=["<pad>"])
+        # <unk>, <pad>, then by frequency
+        assert vocab.idx_to_token == ["<unk>", "<pad>", "c", "b"]
+        assert vocab.to_indices(["c", "zzz"]) == [2, 0]
+        assert vocab.to_tokens(3) == "b"
+        assert len(vocab) == 4
+
+    def test_custom_embedding(self, tmp_path):
+        path = tmp_path / "emb.txt"
+        path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        emb = mx.contrib.text.CustomEmbedding(str(path))
+        assert emb.vec_len == 3
+        v = emb.get_vecs_by_tokens("world").asnumpy()
+        np.testing.assert_allclose(v, [4., 5., 6.])
+        unk = emb.get_vecs_by_tokens("zzz").asnumpy()
+        np.testing.assert_allclose(unk, [0., 0., 0.])
+        emb.update_token_vectors("hello", nd.array([[9., 9., 9.]]))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), 9.0)
+
+
+class TestIm2Rec:
+    def test_list_pack_read(self, tmp_path):
+        cv2 = pytest.importorskip("cv2")
+        root = tmp_path / "imgs"
+        for cls in ("cat", "dog"):
+            (root / cls).mkdir(parents=True)
+            for i in range(3):
+                img = np.random.RandomState(i).randint(
+                    0, 255, (16, 16, 3), np.uint8)
+                cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import im2rec
+        finally:
+            sys.path.pop(0)
+        prefix = str(tmp_path / "data")
+        classes = im2rec.make_list(prefix, str(root), shuffle=False)
+        assert len(classes) == 2
+        n = im2rec.pack(prefix, str(root))
+        assert n == 6
+        # read back through MXIndexedRecordIO + unpack_img
+        from mxnet_tpu import recordio
+        r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                       "r")
+        header, img = recordio.unpack_img(r.read_idx(r.keys[0]))
+        assert img.shape == (16, 16, 3)
+        assert header.label in (0.0, 1.0)
+        r.close()
+
+    def test_imagerecorditer_reads_packed(self, tmp_path):
+        cv2 = pytest.importorskip("cv2")
+        root = tmp_path / "imgs"
+        root.mkdir()
+        for i in range(4):
+            cv2.imwrite(str(root / ("%d.jpg" % i)),
+                        np.full((20, 20, 3), i * 40, np.uint8))
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import im2rec
+        finally:
+            sys.path.pop(0)
+        prefix = str(tmp_path / "flat")
+        im2rec.make_list(prefix, str(root), shuffle=False)
+        im2rec.pack(prefix, str(root))
+        it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                   data_shape=(3, 16, 16), batch_size=2)
+        batch = it.next()
+        assert batch.data[0].shape == (2, 3, 16, 16)

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (.rec + .idx + .lst).
+
+Reference analog: ``tools/im2rec.py`` / ``tools/im2rec.cc`` (SURVEY.md N24):
+builds the packed input format consumed by ImageRecordIter.  Uses the native
+RecordIO writer (src/recordio.cc) and OpenCV JPEG encoding.
+
+Usage:
+  python tools/im2rec.py --list prefix image_root      # make prefix.lst
+  python tools/im2rec.py prefix image_root             # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
+              chunks=1):
+    """Write prefix.lst: ``index \\t label \\t relpath`` per image; labels
+    are per-subdirectory class ids (reference im2rec.py --list)."""
+    entries = []
+    classes = {}
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        rel = os.path.relpath(dirpath, root)
+        for fname in sorted(filenames):
+            if not fname.lower().endswith(EXTS):
+                continue
+            label = classes.setdefault(rel, len(classes)) \
+                if rel != "." else 0
+            entries.append((label, os.path.join(rel, fname)
+                            if rel != "." else fname))
+        if not recursive:
+            break
+    if shuffle:
+        random.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    splits = [("", entries[:n_train])]
+    if train_ratio < 1.0:
+        splits = [("_train", entries[:n_train]), ("_val", entries[n_train:])]
+    for suffix, rows in splits:
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, (label, path) in enumerate(rows):
+                f.write("%d\t%f\t%s\n" % (i, float(label), path))
+    return classes
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, lst_path=None, quality=95, resize=0,
+         color=1, encoding=".jpg"):
+    """Pack images listed in prefix.lst into prefix.rec/.idx
+    (reference im2rec.py packing loop)."""
+    import cv2
+    import numpy as np
+    lst_path = lst_path or prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, relpath in read_list(lst_path):
+        path = os.path.join(root, relpath)
+        flag = cv2.IMREAD_COLOR if color else cv2.IMREAD_GRAYSCALE
+        img = cv2.imread(path, flag)
+        if img is None:
+            print("skip unreadable image:", path, file=sys.stderr)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            if h > w:
+                img = cv2.resize(img, (resize, int(h * resize / w)))
+            else:
+                img = cv2.resize(img, (int(w * resize / h), resize))
+        ok, buf = cv2.imencode(encoding, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            print("skip unencodable image:", path, file=sys.stderr)
+            continue
+        label = labels[0] if len(labels) == 1 else np.asarray(labels,
+                                                              np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf.tobytes()))
+        count += 1
+    rec.close()
+    return count
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="image dataset -> RecordIO")
+    ap.add_argument("prefix", help="output prefix (prefix.rec/.idx/.lst)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="only generate the .lst file")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1, choices=[0, 1])
+    args = ap.parse_args(argv)
+    if args.list:
+        classes = make_list(args.prefix, args.root,
+                            shuffle=not args.no_shuffle,
+                            train_ratio=args.train_ratio)
+        print("wrote %s.lst (%d classes)" % (args.prefix, len(classes)))
+        return 0
+    if not os.path.exists(args.prefix + ".lst"):
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+    n = pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, color=args.color)
+    print("packed %d records into %s.rec" % (n, args.prefix))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
