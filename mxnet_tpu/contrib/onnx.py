@@ -202,9 +202,14 @@ def import_graph(graph):
             raise MXNetError("unsupported ONNX op %r (supported: %s)"
                              % (node.op_type, sorted(simple)))
         out_sym = fn(node)
-        outs = [out_sym] if node.output else []
+        avail = len(out_sym.list_outputs())
         for i, oname in enumerate(node.output):
-            env[oname] = out_sym[i] if len(node.output) > 1 else out_sym
+            if i >= avail:
+                # training-form extras (Dropout mask, BatchNorm saved
+                # stats) have no symbol counterpart; consumers of output 0
+                # are unaffected
+                continue
+            env[oname] = out_sym[i] if avail > 1 else out_sym
 
     out_names = [o.name for o in graph.output]
     outs = [env[n] for n in out_names]

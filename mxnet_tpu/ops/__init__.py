@@ -24,3 +24,4 @@ from . import spatial       # noqa: F401  (STN/correlation/SVM ops)
 from . import control_flow  # noqa: F401  (_foreach scan op)
 from . import quantization  # noqa: F401  (INT8 quantize/quantized_* ops)
 from . import image_ops     # noqa: F401  (_image_* transform ops)
+from . import misc_parity   # noqa: F401  (histogram/ravel/scatter/… tails)

@@ -1,0 +1,282 @@
+"""Remaining op-registry parity: histogram, ravel, slice-assign, scatter,
+sampling tails, square_sum, sparse adagrad, KL sparse-reg, aliases.
+
+Reference analogs: src/operator/tensor/histogram.cc (_histogram),
+ravel.cc (_ravel_multi_index/_unravel_index), matrix_op.cc
+(_slice_assign/_slice_assign_scalar, the ``x[a:b] = y`` lowering),
+indexing_op.cc (_scatter_set_nd), elemwise_binary_op_basic.cc (_grad_add),
+elemwise ops' sparse "scatter" variants (_scatter_plus_scalar etc. — on the
+dense TPU representation these coincide with the dense ops),
+square_sum.cc (_square_sum), optimizer_op.cc (_sparse_adagrad_update),
+identity_attach_KL_sparse_reg.cc (IdentityAttachKLSparseReg),
+multisample_op.cc (_sample_exponential/_sample_poisson/
+_sample_negative_binomial/_sample_generalized_negative_binomial).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register, param, OPS
+
+
+@register("_grad_add", nin=2)
+def _grad_add(attrs, lhs, rhs):
+    """Gradient accumulation add (elemwise_binary_op_basic.cc)."""
+    return lhs + rhs
+
+
+@register("_identity_with_attr_like_rhs", nin=2)
+def _identity_with_attr_like_rhs(attrs, lhs, rhs):
+    """Identity on lhs, attrs (storage/shape) taken from rhs — graph-pass
+    helper (elemwise_op_common.h)."""
+    return lhs
+
+
+@register("_histogram", nin=-1, nout=2,
+          params={"bin_cnt": param(int, None),
+                  "range": param("floats", None)})
+def _histogram(attrs, data, *maybe_bins):
+    """Histogram (histogram.cc): either uniform bins from
+    (bin_cnt, range) or explicit bin-edge input."""
+    flat = data.reshape(-1)
+    if attrs["bin_cnt"] is not None:
+        lo, hi = attrs["range"]
+        cnt = attrs["bin_cnt"]
+        edges = jnp.linspace(lo, hi, cnt + 1)
+    elif maybe_bins:
+        edges = maybe_bins[0]
+        cnt = edges.shape[0] - 1
+        lo, hi = edges[0], edges[-1]
+    else:
+        raise MXNetError("_histogram needs bin_cnt+range or a bins input")
+    idx = jnp.clip(jnp.searchsorted(edges, flat, side="right") - 1, 0,
+                   cnt - 1)
+    inb = (flat >= edges[0]) & (flat <= edges[-1])
+    counts = jnp.zeros((cnt,), jnp.int32).at[idx].add(
+        inb.astype(jnp.int32))
+    return counts, edges.astype(data.dtype)
+
+
+@register("_ravel_multi_index", nin=1, aliases=("ravel_multi_index",),
+          params={"shape": param("shape", None, required=True)})
+def _ravel_multi_index(attrs, data):
+    """(N, K) coordinate rows -> flat indices (ravel.cc)."""
+    shape = attrs["shape"]
+    strides = np.cumprod([1] + list(shape[::-1][:-1]))[::-1]
+    return jnp.sum(data * jnp.asarray(strides.copy(), data.dtype)[:, None],
+                   axis=0)
+
+
+@register("_unravel_index", nin=1, aliases=("unravel_index",),
+          params={"shape": param("shape", None, required=True)})
+def _unravel_index(attrs, data):
+    """Flat indices -> (K, N) coordinates (ravel.cc)."""
+    shape = attrs["shape"]
+    idx = data.astype(jnp.int32)
+    coords = []
+    for dim in reversed(shape):
+        coords.append(idx % dim)
+        idx = idx // dim
+    return jnp.stack(coords[::-1], axis=0).astype(data.dtype)
+
+
+def _slice_tuple(attrs, ndim):
+    begin = attrs["begin"]
+    end = attrs["end"]
+    step = attrs.get("step") or ()
+    out = []
+    for i in range(len(begin)):
+        st = step[i] if i < len(step) and step[i] else 1
+        out.append(slice(begin[i], None if end[i] is None else end[i], st))
+    return tuple(out)
+
+
+@register("_slice_assign", nin=2,
+          params={"begin": param("shape", None, required=True),
+                  "end": param("shape", None, required=True),
+                  "step": param("shape", ())})
+def _slice_assign(attrs, lhs, rhs):
+    """out = lhs with lhs[begin:end:step] = rhs (matrix_op.cc
+    _slice_assign — the functional form of ``x[a:b] = y``)."""
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(rhs)
+
+
+@register("_slice_assign_scalar", nin=1,
+          params={"scalar": param(float, 0.0),
+                  "begin": param("shape", None, required=True),
+                  "end": param("shape", None, required=True),
+                  "step": param("shape", ())})
+def _slice_assign_scalar(attrs, lhs):
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(
+        jnp.asarray(attrs["scalar"], lhs.dtype))
+
+
+@register("_scatter_set_nd", nin=2,
+          params={"shape": param("shape", None, required=True)})
+def _scatter_set_nd(attrs, rhs, indices):
+    """Scatter rhs into zeros(shape) at indices (indexing_op.cc analog of
+    scatter_nd with set semantics)."""
+    shape = attrs["shape"]
+    out = jnp.zeros(shape, rhs.dtype)
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return out.at[idx].set(rhs)
+
+
+@register("_square_sum", nin=1, aliases=("square_sum",),
+          params={"axis": param("shape", None),
+                  "keepdims": param(bool, False),
+                  "exclude": param(bool, False)})
+def _square_sum(attrs, data):
+    """sum(data²) over axis (square_sum.cc — the row_sparse-optimized
+    reduction; dense XLA form here).  Axis semantics shared with the
+    reduce family (including ``exclude``)."""
+    from .reduce import _resolve_axes
+    axes = _resolve_axes(attrs, data.ndim)
+    return jnp.sum(data * data, axis=axes, keepdims=attrs["keepdims"])
+
+
+@register("_sparse_adagrad_update", nin=3, nout=2, visible=1,
+          aux_writeback={1: 2},
+          params={"lr": param(float, None, required=True),
+                  "epsilon": param(float, 1e-7),
+                  "wd": param(float, 0.0),
+                  "rescale_grad": param(float, 1.0),
+                  "clip_gradient": param(float, -1.0)})
+def _sparse_adagrad_update(attrs, weight, grad, history):
+    """AdaGrad update (optimizer_op.cc _sparse_adagrad_update): on TPU the
+    row-sparse update is a dense masked update (rows with zero grad are
+    untouched by construction)."""
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] >= 0:   # >= 0, the *_update op convention
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    new_hist = history + g * g
+    upd = attrs["lr"] * (g / (jnp.sqrt(new_hist) + attrs["epsilon"]) +
+                         attrs["wd"] * weight)
+    return weight - upd, new_hist
+
+
+@register("IdentityAttachKLSparseReg", nin=-1, nout=2, visible=1,
+          aux_writeback={1: 1},
+          params={"sparseness_target": param(float, 0.1),
+                  "penalty": param(float, 0.001),
+                  "momentum": param(float, 0.9)})
+def _identity_attach_kl_sparse_reg(attrs, data, *maybe_avg):
+    """Identity forward with a KL-sparseness gradient penalty
+    (identity_attach_KL_sparse_reg.cc): moving average of the mean
+    activation rho_hat; backward adds penalty * (-target/rho_hat +
+    (1-target)/(1-rho_hat))."""
+    rho = attrs["sparseness_target"]
+    penalty = attrs["penalty"]
+    mom = attrs["momentum"]
+    avg = maybe_avg[0] if maybe_avg else jnp.full((1,), rho, data.dtype)
+
+    rho_hat = jnp.clip(jnp.mean(data), 1e-6, 1 - 1e-6)
+    new_avg = mom * avg + (1 - mom) * rho_hat
+
+    @jax.custom_vjp
+    def _fwd(d):
+        return d
+
+    def _fwd_fwd(d):
+        return d, jnp.clip(jnp.mean(d), 1e-6, 1 - 1e-6)
+
+    def _fwd_bwd(rh, g):
+        grad_reg = penalty * (-rho / rh + (1 - rho) / (1 - rh))
+        return (g + grad_reg,)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data), new_avg
+
+
+@register("cast_storage", nin=1, aliases=("_cast_storage",),
+          params={"stype": param(["default", "row_sparse", "csr"],
+                                 "default")})
+def _cast_storage_op(attrs, data):
+    """Storage-type cast (cast_storage.cc).  Dense XLA arrays are the
+    device representation for every stype (SURVEY.md §7.3 sparse note);
+    the sparse *container* conversion happens at the NDArray layer
+    (ndarray.sparse.cast_storage) — as a graph op this is identity."""
+    return data
+
+
+def _samplers():
+    """Per-row sampling tails (multisample_op.cc): each row of the param
+    tensor(s) draws ``shape`` samples."""
+    from jax import random as jrand
+
+    def sample_exponential(attrs, key, lam):
+        shape = attrs["shape"] or ()
+        out_shape = tuple(lam.shape) + tuple(shape)
+        u = jrand.uniform(key, out_shape, minval=1e-7, maxval=1.0)
+        return -jnp.log(u) / lam.reshape(
+            lam.shape + (1,) * len(tuple(shape)))
+
+    def sample_poisson(attrs, key, lam):
+        shape = attrs["shape"] or ()
+        out_shape = tuple(lam.shape) + tuple(shape)
+        lam_b = jnp.broadcast_to(
+            lam.reshape(lam.shape + (1,) * len(tuple(shape))), out_shape)
+        return jrand.poisson(key, lam_b, out_shape).astype(jnp.float32)
+
+    def sample_negative_binomial(attrs, key, k, p):
+        shape = attrs["shape"] or ()
+        kk, kg = jrand.split(key)
+        out_shape = tuple(k.shape) + tuple(shape)
+        kb = jnp.broadcast_to(
+            k.reshape(k.shape + (1,) * len(tuple(shape))), out_shape)
+        pb = jnp.broadcast_to(
+            p.reshape(p.shape + (1,) * len(tuple(shape))), out_shape)
+        # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+        lam = jrand.gamma(kg, kb, out_shape) * (1 - pb) / pb
+        return jrand.poisson(kk, lam, out_shape).astype(jnp.float32)
+
+    def sample_generalized_negative_binomial(attrs, key, mu, alpha):
+        shape = attrs["shape"] or ()
+        kk, kg = jrand.split(key)
+        out_shape = tuple(mu.shape) + tuple(shape)
+        mub = jnp.broadcast_to(
+            mu.reshape(mu.shape + (1,) * len(tuple(shape))), out_shape)
+        ab = jnp.broadcast_to(
+            alpha.reshape(alpha.shape + (1,) * len(tuple(shape))),
+            out_shape)
+        # GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha))
+        r = 1.0 / jnp.maximum(ab, 1e-8)
+        lam = jrand.gamma(kg, r, out_shape) * mub * ab
+        return jrand.poisson(kk, lam, out_shape).astype(jnp.float32)
+
+    shape_p = {"shape": param("shape", ())}
+    register("_sample_exponential", nin=1, needs_rng=True,
+             aliases=("sample_exponential",),
+             params=dict(shape_p))(sample_exponential)
+    register("_sample_poisson", nin=1, needs_rng=True,
+             aliases=("sample_poisson",),
+             params=dict(shape_p))(sample_poisson)
+    register("_sample_negative_binomial", nin=2, needs_rng=True,
+             aliases=("sample_negative_binomial",),
+             params=dict(shape_p))(sample_negative_binomial)
+    register("_sample_generalized_negative_binomial", nin=2, needs_rng=True,
+             aliases=("sample_generalized_negative_binomial",),
+             params=dict(shape_p))(sample_generalized_negative_binomial)
+
+
+_samplers()
+
+# ---------------------------------------------------------------------------
+# pure aliases for reference registration names
+# ---------------------------------------------------------------------------
+_ALIASES = {
+    "MakeLoss": "make_loss",
+    "Reorg": "reorg",
+    "NewReorg": "newreorg",
+    "_scatter_plus_scalar": "_plus_scalar",
+    "_scatter_minus_scalar": "_minus_scalar",
+    "_scatter_elemwise_div": "elemwise_div",
+    "_sparse_retain": None,  # handled at the NDArray layer (sparse.retain)
+}
+for alias, target in _ALIASES.items():
+    if target is not None and alias not in OPS:
+        OPS[alias] = OPS[target]

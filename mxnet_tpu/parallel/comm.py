@@ -94,9 +94,10 @@ class ProcessGroup:
             self._sum_fn = jax.jit(lambda x: x.sum(axis=0),
                                    out_shardings=NamedSharding(mesh, P()))
         out = self._sum_fn(garr)
-        # fully replicated: take this process's shard directly — no
-        # device->host->device round-trip on the gradient hot path
-        result = out.addressable_data(0)
+        # fully replicated: take this process's shard and co-locate it with
+        # the input (no host round-trip; no foreign device commitment)
+        result = jax.device_put(out.addressable_data(0),
+                                next(iter(data.devices())))
         return NDArray(result, arr._ctx) if isinstance(arr, NDArray) \
             else result
 

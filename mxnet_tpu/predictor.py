@@ -97,7 +97,9 @@ class Predictor:
             if name in self._aux_params:
                 auxs[name] = self._aux_params[name].as_in_context(self._ctx)
             else:
-                auxs[name] = nd.zeros(shape, ctx=self._ctx)
+                # zero-filling e.g. BatchNorm moving_var would silently
+                # produce garbage inference — fail like the arg path does
+                raise MXNetError("missing auxiliary state %r" % name)
         self._executor = self._symbol.bind(self._ctx, args, grad_req="null",
                                            aux_states=auxs)
         self._outputs = None

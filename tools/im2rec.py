@@ -35,8 +35,8 @@ def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
         for fname in sorted(filenames):
             if not fname.lower().endswith(EXTS):
                 continue
-            label = classes.setdefault(rel, len(classes)) \
-                if rel != "." else 0
+            # loose root images form their own class like any directory
+            label = classes.setdefault(rel, len(classes))
             entries.append((label, os.path.join(rel, fname)
                             if rel != "." else fname))
         if not recursive:
@@ -118,11 +118,18 @@ def main(argv=None):
                             train_ratio=args.train_ratio)
         print("wrote %s.lst (%d classes)" % (args.prefix, len(classes)))
         return 0
-    if not os.path.exists(args.prefix + ".lst"):
+    # pack every list matching the prefix: prefix.lst, or the
+    # prefix_train.lst/prefix_val.lst pair from --list --train-ratio
+    lsts = [suf for suf in ("", "_train", "_val")
+            if os.path.exists(args.prefix + suf + ".lst")]
+    if not lsts:
         make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
-    n = pack(args.prefix, args.root, resize=args.resize,
-             quality=args.quality, color=args.color)
-    print("packed %d records into %s.rec" % (n, args.prefix))
+        lsts = [""]
+    for suf in lsts:
+        n = pack(args.prefix + suf, args.root,
+                 lst_path=args.prefix + suf + ".lst", resize=args.resize,
+                 quality=args.quality, color=args.color)
+        print("packed %d records into %s.rec" % (n, args.prefix + suf))
     return 0
 
 
