@@ -43,6 +43,8 @@ def _histogram(attrs, data, *maybe_bins):
     (bin_cnt, range) or explicit bin-edge input."""
     flat = data.reshape(-1)
     if attrs["bin_cnt"] is not None:
+        if attrs["range"] is None:
+            raise MXNetError("_histogram: bin_cnt requires range=(lo, hi)")
         lo, hi = attrs["range"]
         cnt = attrs["bin_cnt"]
         edges = jnp.linspace(lo, hi, cnt + 1)
@@ -114,16 +116,15 @@ def _slice_assign_scalar(attrs, lhs):
         jnp.asarray(attrs["scalar"], lhs.dtype))
 
 
-@register("_scatter_set_nd", nin=2,
+@register("_scatter_set_nd", nin=3,
           params={"shape": param("shape", None, required=True)})
-def _scatter_set_nd(attrs, rhs, indices):
-    """Scatter rhs into zeros(shape) at indices (indexing_op.cc analog of
-    scatter_nd with set semantics)."""
-    shape = attrs["shape"]
-    out = jnp.zeros(shape, rhs.dtype)
+def _scatter_set_nd(attrs, lhs, rhs, indices):
+    """The ``x[idx] = y`` lowering (indexing_op.cc:680 _scatter_set_nd,
+    3 inputs): set rhs into LHS at indices, leaving non-indexed elements
+    of lhs untouched."""
     idx = tuple(indices[i].astype(jnp.int32)
                 for i in range(indices.shape[0]))
-    return out.at[idx].set(rhs)
+    return lhs.at[idx].set(rhs)
 
 
 @register("_square_sum", nin=1, aliases=("square_sum",),
@@ -150,12 +151,15 @@ def _sparse_adagrad_update(attrs, weight, grad, history):
     """AdaGrad update (optimizer_op.cc _sparse_adagrad_update): on TPU the
     row-sparse update is a dense masked update (rows with zero grad are
     untouched by construction)."""
+    if attrs["wd"] != 0.0:
+        # reference optimizer_op-inl.h:1751: CHECK(wd == 0) — decay would
+        # also touch zero-gradient rows, breaking the sparse invariant
+        raise MXNetError("sparse adagrad_update does not support wd")
     g = grad * attrs["rescale_grad"]
     if attrs["clip_gradient"] >= 0:   # >= 0, the *_update op convention
         g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
     new_hist = history + g * g
-    upd = attrs["lr"] * (g / (jnp.sqrt(new_hist) + attrs["epsilon"]) +
-                         attrs["wd"] * weight)
+    upd = attrs["lr"] * g / (jnp.sqrt(new_hist) + attrs["epsilon"])
     return weight - upd, new_hist
 
 
@@ -172,24 +176,41 @@ def _identity_attach_kl_sparse_reg(attrs, data, *maybe_avg):
     rho = attrs["sparseness_target"]
     penalty = attrs["penalty"]
     mom = attrs["momentum"]
-    avg = maybe_avg[0] if maybe_avg else jnp.full((1,), rho, data.dtype)
-
-    rho_hat = jnp.clip(jnp.mean(data), 1e-6, 1 - 1e-6)
+    nunit = data.shape[1] if data.ndim > 1 else data.shape[0]
+    if maybe_avg:
+        avg = maybe_avg[0].reshape(-1)
+    else:
+        avg = jnp.full((nunit,), rho, data.dtype)
+    # per-HIDDEN-UNIT mean activation (reference sums all dims except 1)
+    unit_axes = tuple(a for a in range(data.ndim) if a != 1) \
+        if data.ndim > 1 else ()
+    rho_hat = jnp.clip(jnp.mean(data, axis=unit_axes), 1e-6, 1 - 1e-6)
     new_avg = mom * avg + (1 - mom) * rho_hat
 
+    bshape = [1] * data.ndim
+    if data.ndim > 1:
+        bshape[1] = -1
+    else:
+        bshape[0] = -1
+
     @jax.custom_vjp
-    def _fwd(d):
+    def _fwd(d, a):
         return d
 
-    def _fwd_fwd(d):
-        return d, jnp.clip(jnp.mean(d), 1e-6, 1 - 1e-6)
+    def _fwd_fwd(d, a):
+        # gradient uses the UPDATED per-unit moving average (reference
+        # identity_attach_KL_sparse_reg-inl.h backward); recomputed inside
+        # the vjp so no outer tracer is captured
+        rh = jnp.clip(jnp.mean(d, axis=unit_axes), 1e-6, 1 - 1e-6)
+        na = mom * a + (1 - mom) * rh
+        return d, jnp.clip(na, 1e-6, 1 - 1e-6)
 
     def _fwd_bwd(rh, g):
         grad_reg = penalty * (-rho / rh + (1 - rho) / (1 - rh))
-        return (g + grad_reg,)
+        return g + grad_reg.reshape(bshape), jnp.zeros_like(rh)
 
     _fwd.defvjp(_fwd_fwd, _fwd_bwd)
-    return _fwd(data), new_avg
+    return _fwd(data, avg), new_avg
 
 
 @register("cast_storage", nin=1, aliases=("_cast_storage",),
@@ -205,50 +226,49 @@ def _cast_storage_op(attrs, data):
 
 def _samplers():
     """Per-row sampling tails (multisample_op.cc): each row of the param
-    tensor(s) draws ``shape`` samples."""
+    tensor(s) draws ``shape`` samples.  Shares the shape-broadcast + dtype
+    idiom of the init_random sample_* family."""
     from jax import random as jrand
+    from .init_random import _dt
+
+    def _bcast(arr, shape):
+        out_shape = tuple(arr.shape) + tuple(shape)
+        return jnp.broadcast_to(
+            arr.reshape(arr.shape + (1,) * len(tuple(shape))),
+            out_shape), out_shape
 
     def sample_exponential(attrs, key, lam):
         shape = attrs["shape"] or ()
-        out_shape = tuple(lam.shape) + tuple(shape)
+        lam_b, out_shape = _bcast(lam, shape)
         u = jrand.uniform(key, out_shape, minval=1e-7, maxval=1.0)
-        return -jnp.log(u) / lam.reshape(
-            lam.shape + (1,) * len(tuple(shape)))
+        return (-jnp.log(u) / lam_b).astype(_dt(attrs))
 
     def sample_poisson(attrs, key, lam):
         shape = attrs["shape"] or ()
-        out_shape = tuple(lam.shape) + tuple(shape)
-        lam_b = jnp.broadcast_to(
-            lam.reshape(lam.shape + (1,) * len(tuple(shape))), out_shape)
-        return jrand.poisson(key, lam_b, out_shape).astype(jnp.float32)
+        lam_b, out_shape = _bcast(lam, shape)
+        return jrand.poisson(key, lam_b, out_shape).astype(_dt(attrs))
 
     def sample_negative_binomial(attrs, key, k, p):
         shape = attrs["shape"] or ()
         kk, kg = jrand.split(key)
-        out_shape = tuple(k.shape) + tuple(shape)
-        kb = jnp.broadcast_to(
-            k.reshape(k.shape + (1,) * len(tuple(shape))), out_shape)
-        pb = jnp.broadcast_to(
-            p.reshape(p.shape + (1,) * len(tuple(shape))), out_shape)
+        kb, out_shape = _bcast(k, shape)
+        pb, _ = _bcast(p, shape)
         # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
         lam = jrand.gamma(kg, kb, out_shape) * (1 - pb) / pb
-        return jrand.poisson(kk, lam, out_shape).astype(jnp.float32)
+        return jrand.poisson(kk, lam, out_shape).astype(_dt(attrs))
 
     def sample_generalized_negative_binomial(attrs, key, mu, alpha):
         shape = attrs["shape"] or ()
         kk, kg = jrand.split(key)
-        out_shape = tuple(mu.shape) + tuple(shape)
-        mub = jnp.broadcast_to(
-            mu.reshape(mu.shape + (1,) * len(tuple(shape))), out_shape)
-        ab = jnp.broadcast_to(
-            alpha.reshape(alpha.shape + (1,) * len(tuple(shape))),
-            out_shape)
+        mub, out_shape = _bcast(mu, shape)
+        ab, _ = _bcast(alpha, shape)
         # GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha))
         r = 1.0 / jnp.maximum(ab, 1e-8)
         lam = jrand.gamma(kg, r, out_shape) * mub * ab
-        return jrand.poisson(kk, lam, out_shape).astype(jnp.float32)
+        return jrand.poisson(kk, lam, out_shape).astype(_dt(attrs))
 
-    shape_p = {"shape": param("shape", ())}
+    shape_p = {"shape": param("shape", ()),
+               "dtype": param("dtype", None)}
     register("_sample_exponential", nin=1, needs_rng=True,
              aliases=("sample_exponential",),
              params=dict(shape_p))(sample_exponential)

@@ -11,3 +11,5 @@ from .comm import ProcessGroup, process_group, init_distributed
 from .data_parallel import DataParallelTrainer, dp_train_step
 from . import tensor_parallel
 from . import ring_attention
+from . import pipeline
+from .pipeline import Pipeline, pipeline_apply

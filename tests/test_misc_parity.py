@@ -47,10 +47,12 @@ def test_slice_assign():
 
 
 def test_scatter_set_nd():
+    lhs = nd.ones((3, 3))
     idx = nd.array([[0., 2.], [1., 0.]])
-    out = nd._scatter_set_nd(nd.array([5., 6.]), idx, shape=(3, 3))
+    out = nd._scatter_set_nd(lhs, nd.array([5., 6.]), idx, shape=(3, 3))
     o = out.asnumpy()
-    assert o[0, 1] == 5 and o[2, 0] == 6 and o.sum() == 11
+    # indexed cells set, everything else KEPT (indexing_op.cc:680)
+    assert o[0, 1] == 5 and o[2, 0] == 6 and o.sum() == 11 + 7
 
 
 def test_square_sum():
@@ -58,6 +60,12 @@ def test_square_sum():
     np.testing.assert_allclose(
         nd._square_sum(x, axis=(1,)).asnumpy(), [5., 25.])
     np.testing.assert_allclose(float(nd._square_sum(x).asnumpy()), 30.)
+
+
+def test_sparse_adagrad_rejects_wd():
+    with pytest.raises(mx.MXNetError, match="does not support wd"):
+        nd._sparse_adagrad_update(nd.ones((2,)), nd.ones((2,)),
+                                  nd.zeros((2,)), lr=0.1, wd=1e-4)
 
 
 def test_sparse_adagrad_update_writeback():
@@ -90,15 +98,24 @@ def test_sampling_tails():
 
 
 def test_kl_sparse_reg_gradient():
-    x = nd.array(np.full((2, 4), 0.5, np.float32))
+    # momentum=0 -> updated moving avg == this batch's per-unit mean
+    x = nd.array(np.stack([np.full(4, 0.5, np.float32),
+                           np.full(4, 0.25, np.float32)], axis=1))  # (4, 2)
     x.attach_grad()
+    avg = nd.array([0.1, 0.1])
     with autograd.record():
-        y = nd.IdentityAttachKLSparseReg(x, nd.array([0.1]),
-                                         sparseness_target=0.1, penalty=1.0)
+        y = nd.IdentityAttachKLSparseReg(x, avg, sparseness_target=0.1,
+                                         penalty=1.0, momentum=0.0)
         s = y.sum()
     s.backward()
-    # rho_hat=0.5 -> extra grad = -0.1/0.5 + 0.9/0.5 = 1.6 on top of ones
-    np.testing.assert_allclose(x.grad.asnumpy(), 1.0 + 1.6, rtol=1e-4)
+    g = x.grad.asnumpy()
+    # per-unit penalties: unit0 rho=0.5 -> 1.6; unit1 rho=0.25 -> 0.8
+    np.testing.assert_allclose(g[:, 0], 1.0 + (-0.1 / 0.5 + 0.9 / 0.5),
+                               rtol=1e-4)
+    np.testing.assert_allclose(g[:, 1], 1.0 + (-0.1 / 0.25 + 0.9 / 0.75),
+                               rtol=1e-4)
+    # aux moving average written back per unit
+    np.testing.assert_allclose(avg.asnumpy(), [0.5, 0.25], rtol=1e-5)
 
 
 def test_reference_name_aliases():

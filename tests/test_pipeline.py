@@ -1,0 +1,60 @@
+"""Tests for GPipe pipeline parallelism (parallel/pipeline.py).
+
+Beyond-parity feature (SURVEY.md §2.2); validated on the virtual CPU mesh
+like the other multi-device paths.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import make_mesh, pipeline_apply
+
+
+def _stage_fn(W, x):
+    return jax.nn.relu(x @ W)
+
+
+def _ref(Ws, x):
+    out = x
+    for i in range(Ws.shape[0]):
+        out = jax.nn.relu(out @ Ws[i])
+    return out
+
+
+@pytest.mark.parametrize("stages,n_micro", [(4, 4), (4, 8), (8, 8)])
+def test_pipeline_matches_sequential(stages, n_micro):
+    rng = np.random.RandomState(0)
+    mesh = make_mesh({"pp": stages}, devices=jax.devices()[:stages])
+    Ws = jnp.asarray(rng.randn(stages, 16, 16).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(16, 16).astype(np.float32))
+    y = pipeline_apply(mesh, "pp", _stage_fn, Ws, x, n_micro=n_micro)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ref(Ws, x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_gradients_flow():
+    rng = np.random.RandomState(1)
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws = jnp.asarray(rng.randn(4, 8, 8).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+
+    def loss(ws):
+        return pipeline_apply(mesh, "pp", _stage_fn, ws, x, n_micro=4).sum()
+
+    def loss_ref(ws):
+        return _ref(ws, x).sum()
+
+    g = jax.grad(loss)(Ws)
+    g_ref = jax.grad(loss_ref)(Ws)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_pipeline_bad_microbatch_count():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws = jnp.zeros((4, 8, 8), jnp.float32)
+    x = jnp.zeros((10, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_apply(mesh, "pp", _stage_fn, Ws, x, n_micro=4)
