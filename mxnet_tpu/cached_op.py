@@ -82,8 +82,13 @@ class CachedOp:
                         av, dict(zip(aux_names, aux_list)), keys)
                     return outs
 
-                _, vjp = jax.vjp(pure, *[base[n] for n in diff_names])
-                return list(vjp(list(ograds)))
+                outs, vjp = jax.vjp(pure, *[base[n] for n in diff_names])
+                # head gradients may arrive in a different dtype than the
+                # recorded outputs (e.g. an f32 loss on a bf16 net) — vjp
+                # requires exact cotangent dtypes
+                cots = [jnp.asarray(g, o.dtype)
+                        for g, o in zip(ograds, outs)]
+                return list(vjp(cots))
 
             self._jitted[key] = jax.jit(fn)
         return self._jitted[key]

@@ -61,8 +61,10 @@ def _convolution(attrs, data, weight, *maybe_bias):
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=_conv_dnums(nd),
-        feature_group_count=attrs["num_group"],
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+        feature_group_count=attrs["num_group"])
+    # NOTE: no preferred_element_type here — the MXU accumulates bf16 convs
+    # in f32 natively, and an explicit f32 preference breaks the conv
+    # transpose rule (mixed-dtype cotangents) under jax.vjp
     out = out.astype(data.dtype)
     if not attrs["no_bias"] and maybe_bias:
         bias = maybe_bias[0].reshape((1, -1) + (1,) * nd)

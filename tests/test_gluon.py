@@ -277,3 +277,37 @@ def test_zero_grad():
     assert p.grad().asnumpy().sum() != 0
     p.zero_grad()
     assert p.grad().asnumpy().sum() == 0
+
+
+def test_bfloat16_training_step():
+    """bf16 end-to-end: cast net, hybridize, fwd+bwd+mp-SGD (the conv
+    transpose used to break on mixed-dtype cotangents)."""
+    import numpy as np
+    from mxnet_tpu import gluon, nd, autograd
+    import mxnet_tpu as mx
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(3))
+    net.initialize()
+    x32 = nd.random.uniform(shape=(2, 3, 8, 8))
+    net(x32)                       # materialize params
+    net.cast("bfloat16")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1,
+                             "multi_precision": True})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = x32.astype("bfloat16")
+    y = nd.array(np.array([0, 1], np.float32))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.mean().astype("float32").asnumpy()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
