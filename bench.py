@@ -20,8 +20,8 @@ import numpy as np
 def main():
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
