@@ -211,3 +211,15 @@ class TestNativeRecordIO:
         r = recordio.MXRecordIO(path, "r")
         h, s = recordio.unpack(r.read())
         assert h.label == 3.0 and h.id == 7 and s == b"payload"
+
+
+def test_cpp_unit_tests_pass():
+    """Build + run the native C++ test binary (the tests/cpp analog,
+    SURVEY.md §4 item 3)."""
+    import subprocess
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+    r = subprocess.run(["make", "-C", src, "test"], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL ENGINE TESTS PASSED" in r.stdout
