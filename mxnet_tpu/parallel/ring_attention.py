@@ -80,9 +80,13 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
         m, l, o = _attn_block(q, kblk, vblk, bias, m, l, o, scale)
         return (m, l, o), None
 
-    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    # derive the carry from q so it inherits q's device-varying axes when
+    # this runs inside shard_map (e.g. the Ulysses all-to-all path) — a
+    # plain zeros() carry would mismatch the varying scan inputs
+    zero = (q[..., 0] * 0).astype(jnp.float32)          # [B,H,T]
+    m0 = zero - jnp.inf
+    l0 = zero
+    o0 = (q * 0).astype(jnp.float32)
     (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0),
                                 (kb, vb, jnp.arange(nblocks)))
     out = o / jnp.maximum(l[..., None], 1e-37)
