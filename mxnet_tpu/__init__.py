@@ -52,6 +52,7 @@ from . import visualization
 from . import visualization as viz
 from . import rtc
 from . import test_utils
+from . import storage
 from . import predictor
 from .predictor import Predictor
 

@@ -1,0 +1,46 @@
+"""Storage inspection (parity shim for SURVEY.md N2).
+
+Reference analog: ``include/mxnet/storage.h`` + ``src/storage/
+pooled_storage_manager.h`` — per-device memory pools with env-tunable
+reserve/page knobs.  On TPU, device memory is owned by PjRt/XLA (its own
+HBM pooling), so the *management* half has no user surface; what remains
+useful is the *inspection* half: per-device usage stats for the profiler
+and OOM debugging.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["memory_stats", "bytes_allocated", "bytes_limit", "report"]
+
+
+def memory_stats(device: Optional[object] = None) -> Dict:
+    """Raw allocator stats of a device (PjRt ``memory_stats``); {} when the
+    backend doesn't expose them (e.g. CPU)."""
+    dev = device or jax.devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except (AttributeError, jax.errors.JaxRuntimeError):
+        return {}
+
+
+def bytes_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def bytes_limit(device=None) -> int:
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def report() -> str:
+    """Human-readable per-device memory table (the
+    ``MXAggregateProfileStatsPrint`` memory-section analog)."""
+    lines = ["%-24s %14s %14s %14s" % ("Device", "InUse", "Peak", "Limit")]
+    for d in jax.local_devices():
+        st = memory_stats(d)
+        lines.append("%-24s %14d %14d %14d" % (
+            str(d), st.get("bytes_in_use", 0),
+            st.get("peak_bytes_in_use", 0), st.get("bytes_limit", 0)))
+    return "\n".join(lines)

@@ -148,3 +148,14 @@ class TestPallasRTC:
     def test_cuda_module_stub(self):
         with pytest.raises(mx.MXNetError):
             mx.rtc.CudaModule("__global__ void f(){}")
+
+
+class TestStorage:
+    def test_memory_stats_api(self):
+        import mxnet_tpu as mx
+        stats = mx.storage.memory_stats()
+        assert isinstance(stats, dict)
+        assert mx.storage.bytes_allocated() >= 0
+        rep = mx.storage.report()
+        assert rep.splitlines()[0].startswith("Device")
+        assert len(rep.splitlines()) >= 2

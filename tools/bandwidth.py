@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Measure kvstore/collective communication bandwidth.
+
+Reference analog: ``tools/bandwidth/`` (SURVEY.md §6 benchmark harnesses) —
+measures the gradient-aggregation path's throughput.  Here: the XLA
+all-reduce over the device mesh (ICI) and, under a multi-process launch,
+the cross-process DCN all-reduce used by dist_sync.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def bench_device_allreduce(size_mb: float, iters: int) -> float:
+    """All-reduce over all local devices via psum (the kvstore 'device'
+    path); returns GB/s of algorithmic bandwidth."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.local_devices()
+    n = len(devs)
+    elems = int(size_mb * 1e6 / 4)
+    mesh = Mesh(np.asarray(devs), ("d",))
+    x = jnp.ones((n, elems), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("d")))
+    f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+                          in_specs=P("d"), out_specs=P("d")))
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    # ring all-reduce moves 2(n-1)/n of the data per device
+    gbytes = iters * elems * 4 * 2 * (n - 1) / n / 1e9
+    return gbytes / dt
+
+
+def bench_dist_allreduce(size_mb: float, iters: int) -> float:
+    """Cross-process all-reduce (the dist_sync path); run under
+    tools/launch.py -n W."""
+    from mxnet_tpu.parallel import process_group
+    import jax.numpy as jnp
+
+    pg = process_group()
+    elems = int(size_mb * 1e6 / 4)
+    x = jnp.ones((elems,), jnp.float32)
+    pg.allreduce(x)                       # warm the compiled collective
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pg.allreduce(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    n = pg.size
+    gbytes = iters * elems * 4 * 2 * max(n - 1, 1) / max(n, 1) / 1e9
+    return gbytes / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64.0)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--mode", choices=["device", "dist"], default="device")
+    args = ap.parse_args()
+    if args.mode == "device":
+        bw = bench_device_allreduce(args.size_mb, args.iters)
+        print("device all-reduce (%g MB x %d): %.2f GB/s"
+              % (args.size_mb, args.iters, bw))
+    else:
+        bw = bench_dist_allreduce(args.size_mb, args.iters)
+        print("dist all-reduce (%g MB x %d): %.2f GB/s"
+              % (args.size_mb, args.iters, bw))
+
+
+if __name__ == "__main__":
+    main()
