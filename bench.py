@@ -5,9 +5,14 @@ Primary BASELINE metric (BASELINE.json / SURVEY.md §6): the reference's
 published ResNet-50 training number is 363.69 img/s on 1xV100 at batch 128
 (docs/faq/perf.md:208-218); ``vs_baseline`` is measured img/s / 363.69.
 
-Runs the hybridized Gluon ResNet-50 v1 forward+backward+SGD step as ONE
-fused XLA program per batch (CachedOp fwd + fused fwd/bwd; bf16 matmuls via
-jax default on TPU).  Prints exactly one JSON line.
+Runs the FusedTrainer path: the whole training step — ResNet-50 v1 forward,
+softmax-CE loss, backward, SGD-momentum update over all 161 parameters —
+compiled into ONE donated-buffer XLA executable (mxnet_tpu/fused.py; the
+TPU answer to the reference's engine bulking + CachedOp amortizers).
+Prints exactly one JSON line.
+
+Set BENCH_PATH=gluon to measure the eager Gluon Trainer path instead
+(per-op CachedOp dispatch + per-parameter updates).
 """
 import json
 import os
@@ -22,6 +27,7 @@ def main():
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
+    path = os.environ.get("BENCH_PATH", "fused")
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
@@ -37,21 +43,30 @@ def main():
     net = vision.resnet50_v1()
     net.initialize(ctx=ctx)
     net.hybridize()
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1, "momentum": 0.9})
 
     x = mx.nd.random.uniform(shape=(batch_size, 3, image_size, image_size),
                              ctx=ctx)
     y = mx.nd.array(np.random.randint(0, 1000, (batch_size,)), ctx=ctx)
 
-    def step():
-        with mx.autograd.record():
-            out = net(x)
-            loss = loss_fn(out, y)
-        loss.backward()
-        trainer.step(batch_size)
-        return loss
+    if path == "fused":
+        net(x).wait_to_read()          # materialize parameters
+        ft = mx.FusedTrainer(net, "softmax_cross_entropy", "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+
+        def step():
+            return ft.step(x, y)
+    else:
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+
+        def step():
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(batch_size)
+            return loss
 
     for _ in range(warmup):
         step().wait_to_read()

@@ -53,6 +53,8 @@ from . import visualization as viz
 from . import rtc
 from . import test_utils
 from . import storage
+from . import fused
+from .fused import FusedTrainer
 from . import predictor
 from .predictor import Predictor
 

@@ -1,0 +1,165 @@
+"""FusedTrainer: whole-train-step compilation (forward + backward +
+optimizer in ONE XLA program).
+
+TPU-native answer to the reference's dispatch-overhead amortizers
+(SURVEY.md §7.3 "eager per-op dispatch cost": CachedOp + engine bulking,
+``MXNET_EXEC_BULK_EXEC_*`` of graph_executor.cc:1463-1483).  Where the
+reference bulks engine segments, the TPU design compiles the ENTIRE
+training step — model forward, loss, gradients, and the optimizer update
+over every parameter — into a single donated-buffer XLA executable: zero
+per-op and per-parameter dispatch, buffers reused in place.
+
+    net = vision.resnet50_v1(); net.initialize(); net.hybridize()
+    ft = FusedTrainer(net, "softmax_cross_entropy", "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    for x, y in batches:
+        loss = ft.step(x, y)
+    ft.sync_params()           # write trained values back into the Block
+
+Supported optimizers: sgd (momentum/wd/nesterov-free form).  Learning rate
+is a traced scalar, so schedules don't retrace.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["FusedTrainer"]
+
+
+def _softmax_ce(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None],
+                                 axis=-1)
+    return -jnp.mean(picked)
+
+
+_LOSSES: Dict[str, Callable] = {"softmax_cross_entropy": _softmax_ce}
+
+
+class FusedTrainer:
+    """One-executable training step over a hybridizable Gluon block."""
+
+    def __init__(self, net, loss: Union[str, Callable] = "softmax_cross_entropy",
+                 optimizer: str = "sgd", optimizer_params: Optional[dict] = None):
+        from . import symbol as sym_mod
+        from .executor import _Plan
+
+        p = dict(optimizer_params or {})
+        self._lr = float(p.pop("learning_rate", 0.01))
+        self._momentum = float(p.pop("momentum", 0.0))
+        self._wd = float(p.pop("wd", 0.0))
+        if optimizer != "sgd" or p:
+            raise MXNetError(
+                "FusedTrainer supports optimizer='sgd' with learning_rate/"
+                "momentum/wd; use gluon.Trainer for other optimizers "
+                "(got %r with extras %s)" % (optimizer, sorted(p)))
+        if isinstance(loss, str):
+            if loss not in _LOSSES:
+                raise MXNetError("unknown loss %r (built-ins: %s; or pass "
+                                 "a callable(logits, labels) -> scalar)"
+                                 % (loss, sorted(_LOSSES)))
+            loss = _LOSSES[loss]
+        self._loss = loss
+
+        self._net = net
+        out_sym = net(sym_mod.var("data"))
+        self._plan = _Plan(out_sym, train=True)
+        params = net.collect_params()
+        self._arg_names = [n for n in self._plan.arg_names if n != "data"]
+        # private COPIES: step() donates these buffers to XLA, and donating
+        # the arrays still referenced by the Block's Parameters would leave
+        # the net holding deleted buffers
+        args = {}
+        for n in self._arg_names:
+            try:
+                args[n] = jnp.array(params[n].data()._data, copy=True)
+            except Exception as e:
+                raise MXNetError(
+                    "FusedTrainer needs materialized parameters — run one "
+                    "forward batch (or initialize with known shapes) "
+                    "first: %s" % e) from e
+        auxs = {n: jnp.array(params[n].data()._data, copy=True)
+                for n in self._plan.aux_names}
+        moms = ({k: jnp.zeros_like(v) for k, v in args.items()}
+                if self._momentum != 0.0 else {})
+        self._state = (args, auxs, moms)
+        self._params = params
+        n_rng = max(1, self._plan.n_rng)
+        self._keys = jnp.zeros((n_rng, 2), jnp.uint32)
+
+        plan = self._plan
+        loss_fn = self._loss
+        momentum, wd = self._momentum, self._wd
+        # gluon.Trainer parity: weight decay applies only to weights/gammas
+        # (optimizer.py wd_mult convention — biases/betas are exempt)
+        wd_mult = {n: (1.0 if n.endswith(("_weight", "_gamma")) else 0.0)
+                   for n in self._arg_names}
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _step(args, auxs, moms, data, labels, lr, keys):
+            def loss_of(a):
+                outs, new_aux = plan.execute({**a, "data": data}, auxs,
+                                             keys)
+                return loss_fn(outs[0], labels), new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(args)
+            new_args, new_moms = {}, {}
+            for k in args:
+                g = grads[k].astype(args[k].dtype)
+                if wd:
+                    g = g + (wd * wd_mult[k]) * args[k]
+                if momentum != 0.0:
+                    m2 = momentum * moms[k] - lr * g
+                    new_args[k] = args[k] + m2
+                    new_moms[k] = m2
+                else:
+                    new_args[k] = args[k] - lr * g
+            return new_args, new_aux, new_moms, loss
+
+        self._jstep = _step
+
+    @property
+    def learning_rate(self):
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        """Traced scalar — no recompilation on schedule changes."""
+        self._lr = float(lr)
+
+    def step(self, data, labels):
+        """One fused train step; returns the (device-async) loss NDArray."""
+        from .ndarray.ndarray import NDArray
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = labels._data if isinstance(labels, NDArray) \
+            else jnp.asarray(labels)
+        args, auxs, moms = self._state
+        if self._plan.n_rng:
+            # fresh threefry keys per step (CachedOp parity) — a constant
+            # key would freeze every dropout mask for the whole run
+            from . import random as _random
+            keys = jnp.stack([_random.next_key()
+                              for _ in range(self._plan.n_rng)])
+        else:
+            keys = self._keys
+        args, auxs, moms, loss = self._jstep(
+            args, auxs, moms, d, l, jnp.float32(self._lr), keys)
+        self._state = (args, auxs, moms)
+        ctx = data.context if isinstance(data, NDArray) else None
+        return NDArray(loss, ctx)
+
+    def sync_params(self):
+        """Write the trained values back into the Block's Parameters
+        (for checkpointing / switching back to eager)."""
+        args, auxs, _ = self._state
+        for n in self._arg_names:
+            self._params[n].data()._data = args[n]
+        for n in self._plan.aux_names:
+            self._params[n].data()._data = auxs[n]

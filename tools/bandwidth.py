@@ -28,6 +28,10 @@ def bench_device_allreduce(size_mb: float, iters: int) -> float:
 
     devs = jax.local_devices()
     n = len(devs)
+    if n < 2:
+        raise SystemExit("device all-reduce needs >= 2 devices (have %d); "
+                         "use XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N on CPU" % n)
     elems = int(size_mb * 1e6 / 4)
     mesh = Mesh(np.asarray(devs), ("d",))
     x = jnp.ones((n, elems), jnp.float32)
@@ -52,6 +56,10 @@ def bench_dist_allreduce(size_mb: float, iters: int) -> float:
     import jax.numpy as jnp
 
     pg = process_group()
+    if pg.size < 2:
+        raise SystemExit("dist all-reduce needs >= 2 processes — run under "
+                         "tools/launch.py -n W (single-process allreduce "
+                         "is an identity; there is nothing to measure)")
     elems = int(size_mb * 1e6 / 4)
     x = jnp.ones((elems,), jnp.float32)
     pg.allreduce(x)                       # warm the compiled collective
