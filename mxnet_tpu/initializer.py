@@ -237,6 +237,56 @@ class LSTMBias(Initializer):
     _init_bias = _init_weight
 
 
+class FusedRNN(Initializer):
+    """Initialize the fused packed RNN parameter vector (ref
+    initializer.py:FusedRNN): weights via ``init``, biases zero with the
+    LSTM forget gate set to ``forget_bias`` (packed layout of
+    ops/rnn.py: all [i2h_W, h2h_W] blocks, then all [i2h_b, h2h_b])."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn import _NGATES
+        ng = _NGATES[self._mode]
+        h = self._num_hidden
+        dirs = 2 if self._bidirectional else 1
+        total = arr.size
+        n_bias = self._num_layers * dirs * 2 * ng * h
+        n_weight = total - n_bias
+        flat = np.zeros(total, np.float32)
+        if self._init is not None:
+            from . import ndarray as nd
+            wnd = nd.zeros((1, n_weight))
+            self._init._init_weight(desc, wnd)
+            flat[:n_weight] = wnd.asnumpy().reshape(-1)
+        if self._mode == "lstm":
+            # bias region: per (layer, dir), [i2h_b, h2h_b] each ng*h long;
+            # forget gate is gate index 1 of [i, f, g, o]
+            bias = np.zeros(n_bias, np.float32)
+            per = 2 * ng * h
+            for blk in range(self._num_layers * dirs):
+                for half in range(2):
+                    off = blk * per + half * ng * h
+                    bias[off + h:off + 2 * h] = self._forget_bias
+            flat[n_weight:] = bias
+        arr[:] = flat.reshape(arr.shape)
+
+    _init_default = _init_weight
+
+
 class Load:
     """Init from a dict of arrays, fall back to default (ref Load)."""
 

@@ -91,3 +91,25 @@ def test_lr_mult_from_attrs():
                    param_idx2name={0: "fc_weight"})
     o.set_lr_mult({})
     assert o._get_lr(0) == 0.0
+
+
+def test_fused_rnn_initializer():
+    """FusedRNN init (ref initializer.py:377-678): weights via inner init,
+    LSTM forget-gate biases = forget_bias, everything else zero."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    n = rnn_param_size(2, 8, 4, False, "lstm")
+    arr = nd.zeros((n,))
+    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=8, num_layers=2,
+                            mode="lstm", forget_bias=2.0)
+    init("rnn_params", arr)
+    a = arr.asnumpy()
+    n_bias = 2 * 1 * 2 * 4 * 8
+    w, b = a[:n - n_bias], a[n - n_bias:].reshape(-1, 4 * 8)
+    assert np.abs(w).sum() > 0
+    np.testing.assert_allclose(b[:, 8:16], 2.0)
+    np.testing.assert_allclose(b[:, :8], 0.0)
+    np.testing.assert_allclose(b[:, 16:], 0.0)
