@@ -53,6 +53,7 @@ from . import visualization as viz
 from . import rtc
 from . import test_utils
 from . import storage
+from . import checkpoint
 from . import fused
 from .fused import FusedTrainer
 from . import predictor
