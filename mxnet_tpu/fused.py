@@ -157,9 +157,14 @@ class FusedTrainer:
 
     def sync_params(self):
         """Write the trained values back into the Block's Parameters
-        (for checkpointing / switching back to eager)."""
+        (for checkpointing / switching back to eager).
+
+        Writes COPIES: the next step() donates this trainer's state buffers
+        to XLA, and handing the Parameters the originals would leave the
+        Block holding deleted arrays after a mid-training sync.
+        """
         args, auxs, _ = self._state
         for n in self._arg_names:
-            self._params[n].data()._data = args[n]
+            self._params[n].data()._data = jnp.array(args[n], copy=True)
         for n in self._plan.aux_names:
-            self._params[n].data()._data = auxs[n]
+            self._params[n].data()._data = jnp.array(auxs[n], copy=True)

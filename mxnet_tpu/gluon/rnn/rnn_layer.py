@@ -140,7 +140,12 @@ class _RNNLayer(HybridBlock):
                     self.params.get("r0_i2h_weight").shape = (
                         self._gates * self._hidden_size, inputs.shape[2])
             self._input_size = inputs.shape[2]
-        skip_states = states == (None,)
+        # deferred init resolves here, not in HybridBlock.__call__: this
+        # class overrides __call__/forward, so finish explicitly once the
+        # input size fixes every shape (ref rnn_layer.py:176-191)
+        for p in self.params.values():
+            p._finish_deferred_init()
+        skip_states = states in ((), (None,))
         if skip_states:
             states = []
         if isinstance(states, tuple) and len(states) == 1 and \
@@ -152,7 +157,10 @@ class _RNNLayer(HybridBlock):
             states = self.begin_state(batch_size, ctx=inputs.context)
         if isinstance(states, NDArray):
             states = [states]
-        return super().__call__(inputs, states)
+        out = super().__call__(inputs, states)
+        # reference contract (rnn_layer.py:198): output only when the caller
+        # passed no initial state, (output, new_states) otherwise
+        return out[0] if skip_states else out
 
     def forward(self, inputs, states=None):
         if isinstance(states, NDArray):

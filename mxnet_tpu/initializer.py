@@ -15,7 +15,7 @@ from . import ndarray as nd
 
 __all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
            "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
-           "LSTMBias", "Mixed", "Load", "register"]
+           "LSTMBias", "FusedRNN", "Mixed", "Load", "register"]
 
 _registry = Registry("initializer")
 
@@ -237,6 +237,7 @@ class LSTMBias(Initializer):
     _init_bias = _init_weight
 
 
+@register
 class FusedRNN(Initializer):
     """Initialize the fused packed RNN parameter vector (ref
     initializer.py:FusedRNN): weights via ``init``, biases zero with the
@@ -246,7 +247,15 @@ class FusedRNN(Initializer):
     def __init__(self, init, num_hidden, num_layers, mode,
                  bidirectional=False, forget_bias=1.0):
         if isinstance(init, str):
-            init = create(init)
+            # reference parity (initializer.py FusedRNN.__init__): a string
+            # init is the dumps() format '["klass", {kwargs}]', so
+            # FusedRNN(Xavier().dumps(), ...) round-trips; a bare registry
+            # name is accepted too
+            if init.startswith("["):
+                klass, kwargs = json.loads(init)
+                init = create(klass, **kwargs)
+            else:
+                init = create(init)
         super().__init__(init=init.dumps() if init is not None else None,
                          num_hidden=num_hidden, num_layers=num_layers,
                          mode=mode, bidirectional=bidirectional,

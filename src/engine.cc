@@ -59,7 +59,10 @@ struct Opr {
   int pending = 0;        // grants still outstanding
   bool prio = false;
   Var* delete_var = nullptr;  // set for DeleteVariable sentinel ops
-  std::function<void()> wait_state;  // set for WaitForVar sentinel ops
+  // WaitForVar sentinel: invoked with a snapshot of the var's error taken
+  // under mu_ BEFORE this op's read grant is released — a write queued
+  // behind the wait must not be able to poison the var first
+  std::function<void(int64_t)> wait_state;
 };
 
 class Engine {
@@ -116,23 +119,21 @@ class Engine {
       std::mutex m;
       std::condition_variable cv;
       bool done = false;
+      int64_t err = 0;
     } st;
     Opr* op = new Opr();
     op->fn = nullptr;
-    op->payload = &st;
     op->const_vars.push_back(v);
-    op->wait_state = [&st] {
+    op->wait_state = [&st](int64_t e) {
       std::unique_lock<std::mutex> lk(st.m);
+      st.err = e;
       st.done = true;
       st.cv.notify_all();
     };
     Schedule(op);
     std::unique_lock<std::mutex> lk(st.m);
     st.cv.wait(lk, [&st] { return st.done; });
-    std::unique_lock<std::mutex> elk(mu_);
-    int64_t e = v->err_code;
-    v->err_code = 0;  // reference clears the exception once surfaced
-    return e;
+    return st.err;
   }
 
   void WaitForAll() {
@@ -205,7 +206,17 @@ class Engine {
         if (v->err_code) err = v->err_code;
     }
     if (op->wait_state) {
-      op->wait_state();
+      int64_t werr;
+      {
+        // snapshot + clear the error while this wait op still holds its read
+        // grant: no write queued behind the wait can have run yet, so the
+        // snapshot can only contain errors from ops pushed before the wait
+        std::unique_lock<std::mutex> lk(mu_);
+        Var* v = op->const_vars.front();
+        werr = v->err_code;
+        v->err_code = 0;  // reference clears the exception once surfaced
+      }
+      op->wait_state(werr);
     } else if (op->fn) {
       err = op->fn(op->payload, err);
     }

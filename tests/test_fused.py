@@ -110,3 +110,20 @@ def test_fused_sync_params_back_to_eager():
     w_after = net.collect_params()[name].data().asnumpy()
     assert not np.allclose(w_before, w_after)
     net(x)  # eager forward works with synced params
+
+
+def test_fused_sync_then_continue_training():
+    """Regression: sync_params must write COPIES — step() donates the state
+    buffers, so handing Parameters the originals leaves the Block holding
+    deleted XLA arrays after sync -> step -> read (advisor round-1 high)."""
+    net = _net()
+    x = nd.random.uniform(shape=(4, 5))
+    net(x)
+    ft = mx.FusedTrainer(net, optimizer_params={"learning_rate": 0.1})
+    y = nd.array(np.zeros(4, np.float32))
+    ft.step(x, y)
+    ft.sync_params()          # mid-training sync (e.g. checkpoint)
+    ft.step(x, y)             # donates the state buffers again
+    for p in net.collect_params().values():
+        p.data().asnumpy()    # must not raise 'Array has been deleted'
+    net(x)

@@ -32,7 +32,7 @@ def test_fused_matches_unfused():
     layer = rnn.LSTM(8, num_layers=2, input_size=5)
     layer.initialize()
     x = mx.nd.random.uniform(shape=(4, 3, 5))  # TNC
-    out, states = layer(x)
+    out = layer(x)  # no initial state -> output only (ref rnn_layer.py:198)
     assert out.shape == (4, 3, 8)
     stack = layer._unfuse()
     outs, _ = stack.unroll(4, mx.nd.swapaxes(x, 0, 1), layout="NTC",
@@ -46,7 +46,7 @@ def test_gru_fused_matches_unfused():
     layer = rnn.GRU(8, input_size=5)
     layer.initialize()
     x = mx.nd.random.uniform(shape=(4, 3, 5))
-    out, _ = layer(x)
+    out = layer(x)
     outs, _ = layer._unfuse().unroll(
         4, mx.nd.swapaxes(x, 0, 1), layout="NTC", merge_outputs=True)
     np.testing.assert_allclose(
@@ -58,7 +58,7 @@ def test_bidirectional_fused():
     layer = rnn.LSTM(8, num_layers=2, bidirectional=True, input_size=5)
     layer.initialize()
     x = mx.nd.random.uniform(shape=(4, 3, 5))
-    out, states = layer(x)
+    out, states = layer(x, layer.begin_state(3))
     assert out.shape == (4, 3, 16)
     assert states[0].shape == (4, 3, 8)
 
@@ -68,7 +68,7 @@ def test_rnn_layer_backward():
     layer.initialize()
     x = mx.nd.random.uniform(shape=(2, 4, 5))
     with mx.autograd.record():
-        out, _ = layer(x)
+        out = layer(x)
         loss = out.sum()
     loss.backward()
     g = layer.l0_i2h_weight.grad().asnumpy()
@@ -136,5 +136,5 @@ def test_ntc_layout_layer():
     layer = rnn.LSTM(6, input_size=4, layout="NTC")
     layer.initialize()
     x = mx.nd.random.uniform(shape=(3, 5, 4))
-    out, states = layer(x)
+    out, states = layer(x, layer.begin_state(3))
     assert out.shape == (3, 5, 6)

@@ -113,3 +113,19 @@ def test_fused_rnn_initializer():
     np.testing.assert_allclose(b[:, 8:16], 2.0)
     np.testing.assert_allclose(b[:, :8], 0.0)
     np.testing.assert_allclose(b[:, 16:], 0.0)
+
+
+def test_fused_rnn_init_dumps_roundtrip():
+    """Regression (advisor round-1): string init is the dumps() format
+    '["klass", {kwargs}]' (ref initializer.py FusedRNN.__init__), FusedRNN
+    is registered, and its own dumps() round-trips through create()."""
+    init = mx.init.FusedRNN(mx.init.Xavier().dumps(), num_hidden=4,
+                            num_layers=1, mode="lstm")
+    arr = mx.nd.zeros((1, 4 * (5 + 4 + 2) * 4))
+    init("rnn_parameters", arr)
+    assert np.isfinite(arr.asnumpy()).all()
+    # registry + dumps round-trip
+    import json
+    klass, kwargs = json.loads(init.dumps())
+    again = mx.init.create(klass, **kwargs)
+    assert isinstance(again, mx.init.FusedRNN)
