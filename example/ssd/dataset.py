@@ -1,0 +1,58 @@
+"""Synthetic detection dataset -> packed RecordIO (zero-egress stand-in
+for VOC: colored shapes on textured backgrounds, 3 classes).
+
+Produces the same artifact a user would build with tools/im2rec.py from a
+.lst of real images + det labels (wire format of
+src/io/iter_image_det_recordio.cc): each record is a JPEG plus the label
+``[header_width, obj_width, objs...]`` with normalized corners.
+"""
+import os
+
+import numpy as np
+
+from mxnet_tpu import recordio
+
+CLASS_NAMES = ["circle", "square", "triangle"]
+
+
+def _draw_sample(rng, size):
+    import cv2
+
+    img = rng.randint(0, 80, (size, size, 3), np.uint8) + \
+        rng.randint(0, 40, (size, size, 1), np.uint8)
+    n_obj = rng.randint(1, 4)
+    boxes = []
+    for _ in range(n_obj):
+        cls = rng.randint(0, 3)
+        s = rng.randint(size // 6, size // 3)          # half-extent
+        cx = rng.randint(s + 1, size - s - 1)
+        cy = rng.randint(s + 1, size - s - 1)
+        color = tuple(int(c) for c in rng.randint(140, 255, 3))
+        if cls == 0:
+            cv2.circle(img, (cx, cy), s, color, -1)
+        elif cls == 1:
+            cv2.rectangle(img, (cx - s, cy - s), (cx + s, cy + s), color, -1)
+        else:
+            pts = np.array([[cx, cy - s], [cx - s, cy + s], [cx + s, cy + s]])
+            cv2.fillPoly(img, [pts], color)
+        boxes.append([cls, (cx - s) / size, (cy - s) / size,
+                      (cx + s) / size, (cy + s) / size])
+    return img, boxes
+
+
+def build_rec(path_prefix, num_images=200, size=128, seed=0):
+    """Write {prefix}.rec/.idx; returns (rec_path, idx_path)."""
+    rec_path, idx_path = path_prefix + ".rec", path_prefix + ".idx"
+    if os.path.exists(rec_path) and os.path.exists(idx_path):
+        return rec_path, idx_path
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(num_images):
+        img, boxes = _draw_sample(rng, size)
+        label = [2.0, 5.0]
+        for b in boxes:
+            label.extend(b)
+        header = recordio.IRHeader(0, np.array(label, np.float32), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=90))
+    rec.close()
+    return rec_path, idx_path

@@ -1,0 +1,137 @@
+"""Mean-average-precision metrics for SSD eval (ref
+example/ssd/evaluate/eval_metric.py: MApMetric / VOC07MApMetric).
+
+update() consumes (labels, preds) where preds[0] is the MultiBoxDetection
+output (batch, num_det, 6) rows ``[cls_id, score, x1, y1, x2, y2]`` (cls_id
+-1 = suppressed) and labels[0] is the padded ground truth (batch, num_obj,
+5+) rows ``[cls_id, x1, y1, x2, y2, (difficult)]`` padded with -1.
+"""
+import numpy as np
+
+from mxnet_tpu import metric as metric_mod
+
+
+def _iou(box, boxes):
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    iw = np.maximum(0, ix2 - ix1)
+    ih = np.maximum(0, iy2 - iy1)
+    inter = iw * ih
+    area = (box[2] - box[0]) * (box[3] - box[1])
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = area + areas - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+class MApMetric(metric_mod.EvalMetric):
+    """VOC mean average precision (all-points interpolation)."""
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0):
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = pred_idx
+        if class_names is None:
+            name = "mAP"
+        else:
+            name = [c + "_AP" for c in class_names] + ["mAP"]
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        # per-class: list of (score, tp) records + gt count
+        self._records = {}
+        self._gt_counts = {}
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        labels = labels[0].asnumpy() if hasattr(labels[0], "asnumpy") \
+            else np.asarray(labels[0])
+        dets = preds[self.pred_idx]
+        dets = dets.asnumpy() if hasattr(dets, "asnumpy") \
+            else np.asarray(dets)
+        for b in range(labels.shape[0]):
+            gt = labels[b]
+            gt = gt[gt[:, 0] >= 0]
+            difficult = gt[:, 5].astype(bool) if (
+                gt.shape[1] > 5 and not self.use_difficult) \
+                else np.zeros(len(gt), bool)
+            det = dets[b]
+            det = det[det[:, 0] >= 0]
+            for cid in np.unique(np.concatenate(
+                    [gt[:, 0], det[:, 0]])).astype(int):
+                cls_gt = gt[gt[:, 0] == cid]
+                cls_dif = difficult[gt[:, 0] == cid]
+                self._gt_counts[cid] = self._gt_counts.get(cid, 0) + \
+                    int((~cls_dif).sum())
+                cls_det = det[det[:, 0] == cid]
+                order = np.argsort(-cls_det[:, 1])
+                matched = np.zeros(len(cls_gt), bool)
+                recs = self._records.setdefault(cid, [])
+                for d in cls_det[order]:
+                    if len(cls_gt) == 0:
+                        recs.append((d[1], 0))
+                        continue
+                    ious = _iou(d[2:6], cls_gt[:, 1:5])
+                    j = int(np.argmax(ious))
+                    if ious[j] >= self.ovp_thresh and not matched[j]:
+                        matched[j] = True
+                        if not cls_dif[j]:
+                            recs.append((d[1], 1))
+                        # difficult matches are ignored entirely
+                    else:
+                        recs.append((d[1], 0))
+
+    def _average_precision(self, rec, prec):
+        """All-points AP (ref eval_metric.py:66)."""
+        mrec = np.concatenate(([0.0], rec, [1.0]))
+        mpre = np.concatenate(([0.0], prec, [0.0]))
+        for i in range(mpre.size - 1, 0, -1):
+            mpre[i - 1] = max(mpre[i - 1], mpre[i])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1])
+
+    def _class_ap(self, cid):
+        recs = self._records.get(cid, [])
+        n_gt = self._gt_counts.get(cid, 0)
+        if n_gt == 0:
+            return None
+        if not recs:
+            return 0.0
+        arr = np.array(sorted(recs, key=lambda r: -r[0]))
+        tp = np.cumsum(arr[:, 1])
+        fp = np.cumsum(1 - arr[:, 1])
+        rec = tp / n_gt
+        prec = tp / np.maximum(tp + fp, 1e-12)
+        return self._average_precision(rec, prec)
+
+    def get(self):
+        cids = sorted(self._gt_counts)
+        aps = {c: self._class_ap(c) for c in cids}
+        valid = [v for v in aps.values() if v is not None]
+        mean_ap = float(np.mean(valid)) if valid else 0.0
+        if self.class_names is None:
+            return ("mAP", mean_ap)
+        names, values = [], []
+        for i, cname in enumerate(self.class_names):
+            names.append(cname + "_AP")
+            values.append(aps.get(i) if aps.get(i) is not None else 0.0)
+        names.append("mAP")
+        values.append(mean_ap)
+        return (names, values)
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (ref eval_metric.py:VOC07MApMetric)."""
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            mask = rec >= t
+            p = np.max(prec[mask]) if mask.any() else 0.0
+            ap += p / 11.0
+        return ap

@@ -1,0 +1,101 @@
+"""SSD head builders (ref example/ssd/symbol/common.py:96-301):
+multi-scale feature extraction + per-scale loc/cls/anchor heads.
+"""
+import numpy as np
+
+from mxnet_tpu import init
+from mxnet_tpu import symbol as sym
+
+
+def conv_act_layer(from_layer, name, num_filter, kernel=(1, 1), pad=(0, 0),
+                   stride=(1, 1), act_type="relu"):
+    net = sym.Convolution(from_layer, kernel=kernel, pad=pad, stride=stride,
+                          num_filter=num_filter, name="%s_conv" % name)
+    return sym.Activation(net, act_type=act_type, name="%s_%s" % (name,
+                                                                  act_type))
+
+
+def multi_layer_feature(body, from_layers, num_filters, strides, pads,
+                        min_filter=128):
+    """Pick named feature maps from the backbone; append 1x1->3x3 extra
+    stages for '' entries (ref common.py:96)."""
+    assert from_layers and from_layers[0].strip()
+    assert len(from_layers) == len(num_filters) == len(strides) == len(pads)
+    internals = body.get_internals()
+    layers = []
+    for k, (name, nf, s, p) in enumerate(
+            zip(from_layers, num_filters, strides, pads)):
+        if name.strip():
+            layers.append(internals[name.strip() + "_output"])
+        else:
+            assert layers and nf > 0
+            num_1x1 = max(min_filter, nf // 2)
+            c1 = conv_act_layer(layers[-1], "multi_feat_%d_conv_1x1" % k,
+                                num_1x1)
+            c3 = conv_act_layer(c1, "multi_feat_%d_conv_3x3" % k, nf,
+                                kernel=(3, 3), pad=(p, p), stride=(s, s))
+            layers.append(c3)
+    return layers
+
+
+def multibox_layer(from_layers, num_classes, sizes=(0.2, 0.95), ratios=(1,),
+                   normalization=-1, num_channels=(), clip=False, steps=()):
+    """Attach loc/cls prediction convs + anchor generators to each feature
+    scale; concat into [loc_preds, cls_preds, anchor_boxes]
+    (ref common.py:153)."""
+    n = len(from_layers)
+    assert n > 0 and num_classes > 0
+    if not isinstance(ratios[0], (list, tuple)):
+        ratios = [ratios] * n
+    if len(sizes) == 2 and not isinstance(sizes[0], (list, tuple)):
+        assert 0 < sizes[0] < 1 and sizes[0] < sizes[1] < 1
+        start = sizes[0] / 2.0
+        tmp = np.linspace(sizes[0], sizes[1], num=n - 1)
+        sizes = list(zip([start] + tmp.tolist(),
+                         tmp.tolist() + [tmp[-1] + start]))
+    assert len(sizes) == n and len(ratios) == n
+    if not isinstance(normalization, (list, tuple)):
+        normalization = [normalization] * n
+    num_channels = list(num_channels)
+    num_cls = num_classes + 1            # background = class 0
+
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    for k, layer in enumerate(from_layers):
+        name = layer.name
+        if normalization[k] > 0:
+            layer = sym.L2Normalization(layer, mode="channel",
+                                        name="%s_norm" % name)
+            scale = sym.var("%s_scale" % name,
+                            shape=(1, num_channels.pop(0), 1, 1),
+                            init=init.Constant(normalization[k]),
+                            attr={"__wd_mult__": "0.1"})
+            layer = sym.broadcast_mul(scale, layer)
+        size, ratio = sizes[k], ratios[k]
+        num_anchors = len(size) - 1 + len(ratio)
+
+        loc = sym.Convolution(layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=num_anchors * 4,
+                              name="%s_loc_pred_conv" % name)
+        loc = sym.Flatten(sym.transpose(loc, axes=(0, 2, 3, 1)))
+        loc_layers.append(loc)
+
+        cls = sym.Convolution(layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=num_anchors * num_cls,
+                              name="%s_cls_pred_conv" % name)
+        cls = sym.Flatten(sym.transpose(cls, axes=(0, 2, 3, 1)))
+        cls_layers.append(cls)
+
+        step = (steps[k], steps[k]) if steps else (-1.0, -1.0)
+        anchors = sym.contrib.MultiBoxPrior(
+            layer, sizes=tuple(size), ratios=tuple(ratio), clip=clip,
+            steps=step, name="%s_anchors" % name)
+        anchor_layers.append(sym.Flatten(anchors))
+
+    loc_preds = sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_preds = sym.Concat(*cls_layers, dim=1)
+    cls_preds = sym.Reshape(cls_preds, shape=(0, -1, num_cls))
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1),
+                              name="multibox_cls_pred")
+    anchors = sym.Concat(*anchor_layers, dim=1)
+    anchors = sym.Reshape(anchors, shape=(0, -1, 4), name="multibox_anchors")
+    return [loc_preds, cls_preds, anchors]

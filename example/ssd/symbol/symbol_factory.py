@@ -1,0 +1,110 @@
+"""SSD network factory (ref example/ssd/symbol/symbol_factory.py +
+symbol_builder.py): presets per backbone/input-size, train and deploy
+symbol builders wired to the MultiBox op trio.
+"""
+import importlib
+
+from mxnet_tpu import symbol as sym
+
+from .common import multi_layer_feature, multibox_layer
+
+_CONFIGS = {
+    ("vgg16_reduced", 300): dict(
+        from_layers=["relu4_3", "relu7", "", "", "", ""],
+        num_filters=[512, -1, 512, 256, 256, 256],
+        strides=[-1, -1, 2, 2, 1, 1],
+        pads=[-1, -1, 1, 1, 0, 0],
+        sizes=[[0.1, 0.141], [0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+               [0.71, 0.79], [0.88, 0.961]],
+        ratios=[[1, 2, 0.5]] * 2 + [[1, 2, 0.5, 3, 1.0 / 3]] * 2 +
+               [[1, 2, 0.5]] * 2,
+        normalizations=[20, -1, -1, -1, -1, -1],
+        num_channels=[512],
+        steps=[x / 300.0 for x in (8, 16, 32, 64, 100, 300)],
+    ),
+    # small config for tests/smoke runs (64px, 3 scales)
+    ("vgg16_reduced", 64): dict(
+        from_layers=["relu4_3", "relu7", ""],
+        num_filters=[512, -1, 256],
+        strides=[-1, -1, 2],
+        pads=[-1, -1, 1],
+        sizes=[[0.2, 0.272], [0.45, 0.55], [0.8, 0.9]],
+        ratios=[[1, 2, 0.5]] * 3,
+        normalizations=[20, -1, -1],
+        num_channels=[512],
+        steps=[],
+    ),
+}
+
+
+def get_config(network, data_shape, **kwargs):
+    key = (network, int(data_shape))
+    if key not in _CONFIGS:
+        raise NotImplementedError(
+            "no SSD preset for %s-%d (have: %s)" %
+            (network, data_shape, sorted(_CONFIGS)))
+    cfg = dict(_CONFIGS[key])
+    cfg.update(network=network, data_shape=data_shape)
+    cfg.update(kwargs)
+    return cfg
+
+
+def _features(network, num_classes, cfg):
+    mod = importlib.import_module("symbol." + network) \
+        if __package__ in (None, "") else \
+        importlib.import_module("." + network, package=__package__)
+    body = mod.get_symbol(num_classes)
+    return multi_layer_feature(body, cfg["from_layers"], cfg["num_filters"],
+                               cfg["strides"], cfg["pads"])
+
+
+def get_symbol_train(network, data_shape, num_classes, nms_thresh=0.5,
+                     force_suppress=False, nms_topk=400, **kwargs):
+    """Training symbol: multibox target assignment + losses + monitoring
+    detection branch (ref symbol_builder.py:29)."""
+    cfg = get_config(network, data_shape, **kwargs)
+    label = sym.var("label")
+    layers = _features(network, num_classes, cfg)
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, sizes=cfg["sizes"], ratios=cfg["ratios"],
+        normalization=cfg["normalizations"],
+        num_channels=cfg["num_channels"], clip=False, steps=cfg["steps"])
+
+    tmp = sym.contrib.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5, ignore_label=-1,
+        negative_mining_ratio=3, minimum_negative_samples=0,
+        negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2),
+        name="multibox_target")
+    loc_target, loc_target_mask, cls_target = tmp[0], tmp[1], tmp[2]
+
+    cls_prob = sym.SoftmaxOutput(cls_preds, cls_target, ignore_label=-1,
+                                 use_ignore=True, grad_scale=1.0,
+                                 multi_output=True, normalization="valid",
+                                 name="cls_prob")
+    loc_loss_ = sym.smooth_l1(loc_target_mask * (loc_preds - loc_target),
+                              scalar=1.0, name="loc_loss_")
+    loc_loss = sym.MakeLoss(loc_loss_, grad_scale=1.0,
+                            normalization="valid", name="loc_loss")
+    cls_label = sym.MakeLoss(cls_target, grad_scale=0, name="cls_label")
+    det = sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk)
+    det = sym.MakeLoss(det, grad_scale=0, name="det_out")
+    return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(network, data_shape, num_classes, nms_thresh=0.5,
+               force_suppress=False, nms_topk=400, **kwargs):
+    """Deploy symbol: detections only (ref symbol_builder.py:118)."""
+    cfg = get_config(network, data_shape, **kwargs)
+    layers = _features(network, num_classes, cfg)
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, sizes=cfg["sizes"], ratios=cfg["ratios"],
+        normalization=cfg["normalizations"],
+        num_channels=cfg["num_channels"], clip=False, steps=cfg["steps"])
+    cls_prob = sym.softmax(cls_preds, axis=1, name="cls_prob")
+    return sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk)

@@ -1,0 +1,35 @@
+"""VGG16-reduced backbone for SSD (ref example/ssd/symbol/vgg16_reduced.py:
+fc6/fc7 replaced by dilated conv6 / 1x1 conv7; pool5 is 3x3 stride-1).
+
+Written config-driven rather than unrolled: the topology is the published
+VGG16-SSD architecture; the code is original.
+"""
+from mxnet_tpu import symbol as sym
+
+# (layers_in_group, channels); pool after each group
+_GROUPS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    net = sym.var("data")
+    for g, (n_layers, ch) in enumerate(_GROUPS, start=1):
+        for i in range(1, n_layers + 1):
+            net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=ch, name="conv%d_%d" % (g, i))
+            net = sym.Activation(net, act_type="relu",
+                                 name="relu%d_%d" % (g, i))
+        if g == 5:
+            # pool5: 3x3 stride 1 keeps fc6's receptive field growable
+            net = sym.Pooling(net, pool_type="max", kernel=(3, 3),
+                              stride=(1, 1), pad=(1, 1), name="pool5")
+        else:
+            conv = {"pooling_convention": "full"} if g == 3 else {}
+            net = sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                              stride=(2, 2), name="pool%d" % g, **conv)
+    # fc6 as dilated 3x3 conv, fc7 as 1x1 conv
+    net = sym.Convolution(net, kernel=(3, 3), pad=(6, 6), dilate=(6, 6),
+                          num_filter=1024, name="fc6")
+    net = sym.Activation(net, act_type="relu", name="relu6")
+    net = sym.Convolution(net, kernel=(1, 1), num_filter=1024, name="fc7")
+    net = sym.Activation(net, act_type="relu", name="relu7")
+    return net

@@ -1,132 +1,128 @@
 #!/usr/bin/env python
-"""SSD object-detection training (parity: example/ssd/train.py —
-BASELINE.json config #4, compact form).
+"""VGG16-SSD training end to end from packed RecordIO detection data
+(ref example/ssd/train.py + train/train_net.py).
 
-A small VGG-style backbone with two multibox heads, trained on synthetic
-boxes: MultiBoxPrior anchors -> MultiBoxTarget assignment -> joint
-cls (SoftmaxOutput-style) + loc (smooth-L1) loss; inference decodes with
-MultiBoxDetection + box_nms.
+Pipeline: .rec (det wire format) -> mx.io.ImageDetRecordIter (IoU-crop /
+pad / flip augmentation, padded labels) -> SSD train symbol (MultiBoxTarget
+assignment, softmax + smooth-L1 losses) -> Module.fit -> VOC07 mAP eval.
+
+With no arguments it trains on a generated synthetic shapes dataset
+(dataset.py; zero-egress stand-in for VOC — point --train-rec/--val-rec at
+real im2rec output to train on actual data).
 """
 import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", ".."))
+import numpy as np
 
-import numpy as np  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import autograd, gluon, nd  # noqa: E402
-from mxnet_tpu.gluon import nn  # noqa: E402
+import mxnet_tpu as mx
 
-
-class ToySSD(gluon.Block):
-    """Backbone + per-scale class/box predictors."""
-
-    def __init__(self, num_classes=2, **kwargs):
-        super().__init__(**kwargs)
-        self.num_classes = num_classes
-        self.sizes = [(0.2, 0.35), (0.4, 0.6)]
-        self.ratios = [(1.0, 2.0, 0.5)] * 2
-        self.anchors_per = len(self.sizes[0]) - 1 + len(self.ratios[0])
-        with self.name_scope():
-            self.body = nn.Sequential()
-            for f in (16, 32):
-                self.body.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
-                self.body.add(nn.MaxPool2D(2))
-            self.down = nn.Sequential()
-            self.down.add(nn.Conv2D(32, 3, padding=1, activation="relu"))
-            self.down.add(nn.MaxPool2D(2))
-            self.cls_preds = nn.Sequential()
-            self.box_preds = nn.Sequential()
-            for _ in range(2):
-                self.cls_preds.add(nn.Conv2D(
-                    self.anchors_per * (num_classes + 1), 3, padding=1))
-                self.box_preds.add(nn.Conv2D(self.anchors_per * 4, 3,
-                                             padding=1))
-
-    def forward(self, x):
-        feats = [self.body(x)]
-        feats.append(self.down(feats[0]))
-        anchors, cls_preds, box_preds = [], [], []
-        for i, f in enumerate(feats):
-            anchors.append(nd.contrib.MultiBoxPrior(
-                f, sizes=self.sizes[i], ratios=self.ratios[i]))
-            c = self.cls_preds[i](f)
-            cls_preds.append(
-                c.transpose((0, 2, 3, 1)).reshape((c.shape[0], -1)))
-            b = self.box_preds[i](f)
-            box_preds.append(
-                b.transpose((0, 2, 3, 1)).reshape((b.shape[0], -1)))
-        anchors = nd.concat(*anchors, dim=1)
-        cls_preds = nd.concat(*cls_preds, dim=1).reshape(
-            (x.shape[0], -1, self.num_classes + 1))
-        box_preds = nd.concat(*box_preds, dim=1)
-        return anchors, cls_preds, box_preds
+from dataset import build_rec, CLASS_NAMES
+from eval_metric import VOC07MApMetric
+from symbol.symbol_factory import get_symbol_train
 
 
-def synthetic_batch(batch_size, rng):
-    """Images with one bright square; label = its box, class 0."""
-    imgs = rng.rand(batch_size, 3, 64, 64).astype(np.float32) * 0.2
-    labels = np.full((batch_size, 1, 5), -1.0, np.float32)
-    for i in range(batch_size):
-        s = rng.randint(12, 28)
-        x0 = rng.randint(0, 64 - s)
-        y0 = rng.randint(0, 64 - s)
-        imgs[i, :, y0:y0 + s, x0:x0 + s] = 1.0
-        labels[i, 0] = [0, x0 / 64, y0 / 64, (x0 + s) / 64, (y0 + s) / 64]
-    return nd.array(imgs), nd.array(labels)
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Training-loss monitor: CE over matched anchors + smooth-L1
+    (ref example/ssd/train/metric.py:22)."""
+
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+        super().__init__(["CrossEntropy", "SmoothL1"])
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0, 0]
+        self.sum_metric = [0.0, 0.0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()
+        valid = np.sum(cls_label >= 0)
+        flat = cls_label.flatten()
+        mask = np.where(flat >= 0)[0]
+        idx = np.int64(flat[mask])
+        prob = cls_prob.transpose(0, 2, 1).reshape(-1, cls_prob.shape[1])
+        self.sum_metric[0] += (-np.log(prob[mask, idx] + self.eps)).sum()
+        self.num_inst[0] += valid
+        self.sum_metric[1] += np.sum(loc_loss)
+        self.num_inst[1] += valid
+
+    def get(self):
+        return (self.name, [s / n if n else float("nan")
+                            for s, n in zip(self.sum_metric, self.num_inst)])
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="vgg16_reduced")
+    ap.add_argument("--data-shape", type=int, default=64,
+                    help="input size (64 = small preset; 300 = full SSD300)")
     ap.add_argument("--batch-size", type=int, default=8)
-    ap.add_argument("--num-batches", type=int, default=60)
-    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.004)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=5e-4)
+    ap.add_argument("--train-rec", default="")
+    ap.add_argument("--val-rec", default="")
+    ap.add_argument("--num-images", type=int, default=160,
+                    help="synthetic dataset size when no --train-rec given")
+    ap.add_argument("--prefix", default="/tmp/ssd_model")
     args = ap.parse_args()
 
-    rng = np.random.RandomState(0)
-    net = ToySSD()
-    net.initialize(mx.init.Xavier())
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": args.lr})
-    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    box_loss = gluon.loss.HuberLoss()
+    if args.train_rec:
+        train_rec, val_rec = args.train_rec, args.val_rec or args.train_rec
+        train_idx = val_idx = None
+        num_classes = 20                       # VOC default
+        class_names = None
+    else:
+        root = os.path.join("/tmp", "ssd_shapes")
+        os.makedirs(root, exist_ok=True)
+        train_rec, train_idx = build_rec(os.path.join(root, "train"),
+                                         num_images=args.num_images, seed=0)
+        val_rec, val_idx = build_rec(os.path.join(root, "val"),
+                                     num_images=max(32, args.num_images // 4),
+                                     seed=1)
+        num_classes = len(CLASS_NAMES)
+        class_names = CLASS_NAMES
 
-    tic = time.time()
-    for it in range(args.num_batches):
-        x, y = synthetic_batch(args.batch_size, rng)
-        with autograd.record():
-            anchors, cls_preds, box_preds = net(x)
-            box_t, box_m, cls_t = nd.contrib.MultiBoxTarget(
-                anchors, y, cls_preds.transpose((0, 2, 1)),
-                negative_mining_ratio=3.0)
-            l_cls = cls_loss(cls_preds, cls_t)
-            l_box = box_loss(box_preds * box_m, box_t * box_m)
-            loss = l_cls + l_box
-        loss.backward()
-        trainer.step(args.batch_size)
-        if it % 10 == 0:
-            print("batch %3d: cls %.4f box %.4f (%.1f img/s)"
-                  % (it, float(l_cls.mean().asnumpy()),
-                     float(l_box.mean().asnumpy()),
-                     args.batch_size * 10 / max(time.time() - tic, 1e-9)))
-            tic = time.time()
+    shape = (3, args.data_shape, args.data_shape)
+    train_iter = mx.io.ImageDetRecordIter(
+        train_rec, shape, args.batch_size, path_imgidx=train_idx,
+        shuffle=True, label_pad_width=24, mean_r=123.68, mean_g=116.78,
+        mean_b=103.94, rand_crop=0.5, rand_pad=0.5, rand_mirror=True)
+    val_iter = mx.io.ImageDetRecordIter(
+        val_rec, shape, args.batch_size, path_imgidx=val_idx,
+        label_pad_width=24, mean_r=123.68, mean_g=116.78, mean_b=103.94)
 
-    # inference: decode + NMS
-    x, y = synthetic_batch(2, rng)
-    anchors, cls_preds, box_preds = net(x)
-    probs = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
-    det = nd.contrib.MultiBoxDetection(probs, box_preds, anchors,
-                                       nms_threshold=0.45)
-    kept = det.asnumpy()[0]
-    kept = kept[kept[:, 0] >= 0][:3]
-    print("top detections (id, score, box):")
-    for row in kept:
-        print("  ", np.round(row, 3))
+    net = get_symbol_train(args.network, args.data_shape, num_classes)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+
+    mod.fit(train_iter,
+            eval_data=val_iter,
+            eval_metric=MultiBoxMetric(),
+            validation_metric=VOC07MApMetric(ovp_thresh=0.5,
+                                             class_names=class_names,
+                                             pred_idx=3),
+            num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum, "wd": args.wd},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    metric = VOC07MApMetric(ovp_thresh=0.5, class_names=class_names,
+                            pred_idx=3)
+    for name, value in mod.score(val_iter, metric):
+        print("%s=%f" % (name, value))
+    mod.save_checkpoint(args.prefix, args.epochs)
+    print("saved %s-%04d.params" % (args.prefix, args.epochs))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

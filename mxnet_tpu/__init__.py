@@ -51,6 +51,8 @@ from .monitor import Monitor
 from . import visualization
 from . import visualization as viz
 from . import rtc
+from . import image
+from . import image as img
 from . import test_utils
 from . import storage
 from . import checkpoint

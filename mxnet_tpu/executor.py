@@ -67,8 +67,17 @@ class _Plan:
             self.steps.append((node, attrs, rng_slot, wb))
 
     def execute(self, arg_vals: Dict[str, Any], aux_vals: Dict[str, Any],
-                keys, monitor=None):
-        """Run the plan on jax values (traceable under jit)."""
+                keys, monitor=None, placements=None):
+        """Run the plan on jax values (traceable under jit).
+
+        ``placements`` maps node ids to jax devices (coarse model parallel,
+        the AssignContext pass of graph_executor.cc:315): inputs of a placed
+        node are device_put there first — the reference's
+        ``kCrossDeviceCopy`` nodes become explicit transfers.  Only valid in
+        eager execution (one XLA program runs on one device).
+        """
+        import jax as _jax
+
         env: Dict[Tuple[int, int], Any] = {}
         for node in self.topo:
             if node.is_var:
@@ -81,6 +90,11 @@ class _Plan:
         new_aux = dict(aux_vals)
         for node, attrs, rng_slot, wb in self.steps:
             ins = [env[(id(p), i)] for p, i in node.inputs]
+            if placements and id(node) in placements:
+                # device_put is traceable (works on vjp tracers) and a
+                # no-op for values already on the target device
+                dev = placements[id(node)]
+                ins = [_jax.device_put(x, dev) for x in ins]
             if rng_slot is not None:
                 ins = [keys[rng_slot]] + ins
             res = node.op.fn(attrs, *ins)
@@ -105,9 +119,10 @@ class Executor:
 
     def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
                  args_grad: Dict[str, Any], grad_req: Dict[str, str],
-                 aux_states: Dict[str, Any]):
+                 aux_states: Dict[str, Any], group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        self._group2ctx = dict(group2ctx or {})
         self.arg_dict = args
         self.grad_dict = args_grad
         self.aux_dict = aux_states
@@ -136,6 +151,20 @@ class Executor:
             self._plans[train] = _Plan(self._symbol, train)
         return self._plans[train]
 
+    def _placements(self, plan: _Plan):
+        """node-id -> jax.Device from ctx_group attrs + the bind-time
+        group2ctx map (AssignContext, graph_executor.cc:315,:1176)."""
+        if not self._group2ctx:
+            return None
+        out = {}
+        for node in plan.topo:
+            if node.is_var:
+                continue
+            group = node.attrs.get("ctx_group")
+            if group is not None and group in self._group2ctx:
+                out[id(node)] = self._group2ctx[group].jax_device
+        return out or None
+
     def _keys(self, plan: _Plan):
         if plan.n_rng == 0:
             return jnp.zeros((0, 2), np.uint32)
@@ -147,14 +176,18 @@ class Executor:
         if key not in self._jitted:
             plan = self._plan(train)
             arg_names, aux_names = plan.arg_names, plan.aux_names
+            placements = self._placements(plan)
 
             def fn(arg_list, aux_list, keys):
                 outs, new_aux = plan.execute(
                     dict(zip(arg_names, arg_list)),
-                    dict(zip(aux_names, aux_list)), keys)
+                    dict(zip(aux_names, aux_list)), keys,
+                    placements=placements)
                 return outs, [new_aux[n] for n in aux_names]
 
-            self._jitted[key] = jax.jit(fn)
+            # coarse model parallel runs eagerly: one XLA program executes
+            # on one device, so cross-group transfers preclude whole-plan jit
+            self._jitted[key] = fn if placements else jax.jit(fn)
         return self._jitted[key]
 
     def _fwd_bwd_fn(self):
@@ -163,6 +196,7 @@ class Executor:
             plan = self._plan(True)
             arg_names, aux_names = plan.arg_names, plan.aux_names
             grad_args = self._grad_args
+            placements = self._placements(plan)
 
             def fn(arg_list, aux_list, keys, ograds):
                 base = dict(zip(arg_names, arg_list))
@@ -171,7 +205,8 @@ class Executor:
                     av = dict(base)
                     av.update(dict(zip(grad_args, gvals)))
                     outs, new_aux = plan.execute(
-                        av, dict(zip(aux_names, aux_list)), keys)
+                        av, dict(zip(aux_names, aux_list)), keys,
+                        placements=placements)
                     return outs, [new_aux[n] for n in aux_names]
 
                 gvals = [base[n] for n in grad_args]
@@ -182,7 +217,7 @@ class Executor:
                 grads = vjp(cots)
                 return outs, new_aux, list(grads)
 
-            self._jitted[("fwdbwd",)] = jax.jit(fn)
+            self._jitted[("fwdbwd",)] = fn if placements else jax.jit(fn)
         return self._jitted[("fwdbwd",)]
 
     def _gather(self):

@@ -47,9 +47,20 @@ class FusedTrainer:
     """One-executable training step over a hybridizable Gluon block."""
 
     def __init__(self, net, loss: Union[str, Callable] = "softmax_cross_entropy",
-                 optimizer: str = "sgd", optimizer_params: Optional[dict] = None):
+                 optimizer: str = "sgd", optimizer_params: Optional[dict] = None,
+                 dtype: str = "float32"):
         from . import symbol as sym_mod
         from .executor import _Plan
+
+        if dtype not in ("float32", "bfloat16", "float16"):
+            raise MXNetError("FusedTrainer dtype must be float32/bfloat16/"
+                             "float16, got %r" % dtype)
+        # mixed precision (reference analog: optimizer.py multi_precision
+        # SGD fp16 master weights): master params/momenta stay f32, the
+        # forward/backward computes in `dtype`; the cast sits inside the
+        # differentiated function so grads arrive f32 automatically
+        self._compute_dtype = None if dtype == "float32" \
+            else jnp.dtype(dtype)
 
         p = dict(optimizer_params or {})
         self._lr = float(p.pop("learning_rate", 0.01))
@@ -97,6 +108,7 @@ class FusedTrainer:
         plan = self._plan
         loss_fn = self._loss
         momentum, wd = self._momentum, self._wd
+        cdt = self._compute_dtype
         # gluon.Trainer parity: weight decay applies only to weights/gammas
         # (optimizer.py wd_mult convention — biases/betas are exempt)
         wd_mult = {n: (1.0 if n.endswith(("_weight", "_gamma")) else 0.0)
@@ -105,8 +117,17 @@ class FusedTrainer:
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def _step(args, auxs, moms, data, labels, lr, keys):
             def loss_of(a):
-                outs, new_aux = plan.execute({**a, "data": data}, auxs,
+                if cdt is not None:
+                    a = {k: v.astype(cdt) for k, v in a.items()}
+                    d = data.astype(cdt)
+                else:
+                    d = data
+                outs, new_aux = plan.execute({**a, "data": d}, auxs,
                                              keys)
+                # keep aux (BN moving stats) dtype stable across steps:
+                # donated buffers must keep their f32 layout
+                new_aux = {k: v.astype(auxs[k].dtype)
+                           for k, v in new_aux.items()}
                 return loss_fn(outs[0], labels), new_aux
 
             (loss, new_aux), grads = jax.value_and_grad(

@@ -547,3 +547,11 @@ class ImageIter(_io.DataIter):
         return _io.DataBatch(
             [nd.array(batch_data)], [nd.array(batch_label)],
             pad=self.batch_size - i)
+
+
+# detection pipeline lives in image_detection.py; re-exported here for the
+# reference namespace layout (mx.image.ImageDetIter, mx.image.CreateDetAugmenter)
+from .image_detection import (  # noqa: E402,F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter)

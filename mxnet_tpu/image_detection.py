@@ -1,0 +1,563 @@
+"""Object-detection data pipeline: det augmenters + ImageDetIter.
+
+Parity targets:
+  - python/mxnet/image/detection.py:625 (``ImageDetIter`` — variable-count
+    padded label format, IoU-constrained random crop, geometric label
+    updates, label-shape estimation/sync)
+  - src/io/iter_image_det_recordio.cc:582 (``ImageDetRecordIter`` — the
+    C++ record iterator; here the same record format is served by
+    :class:`ImageDetIter` over ``.rec`` + a padded-width variant in io.py)
+
+Label wire format (reference detection.py:710 ``_parse_label``)::
+
+    [header_width, obj_width, (extra header...), obj0..., obj1..., ...]
+
+where each object is ``[class_id, xmin, ymin, xmax, ymax, ...]`` with
+coordinates normalized to [0, 1].  Batch labels are padded with -1 rows to
+the estimated max object count.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import random
+
+import numpy as np
+
+from .base import MXNetError
+from . import io as _io
+from . import ndarray as nd
+from .image import (Augmenter, ResizeAug, ForceResizeAug, CastAug,
+                    ColorJitterAug, HueJitterAug, LightingAug, RandomGrayAug,
+                    ColorNormalizeAug, fixed_crop, ImageIter)
+from .ndarray.ndarray import NDArray
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Detection augmenter base: __call__(src, label) -> (src, label)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            if isinstance(v, np.ndarray):
+                v = v.tolist()
+            self._kwargs[k] = v
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a classification augmenter that cannot affect labels
+    (ref detection.py:74)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("Borrowing from invalid Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly apply exactly one augmenter from a list, or skip all
+    (ref detection.py:100)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        if not aug_list:
+            skip_prob = 1
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if random.random() < self.skip_prob:
+            return src, label
+        return random.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Random horizontal flip, mirroring xmin/xmax (ref detection.py:128)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() < self.p:
+            src = nd.array(np.ascontiguousarray(_asnp(src)[:, ::-1]))
+            tmp = 1.0 - label[:, 1]
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+def _asnp(src):
+    return src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+
+
+def _box_areas(boxes):
+    """(N,4+) normalized [xmin,ymin,xmax,ymax] -> areas."""
+    h = np.maximum(0, boxes[:, 3] - boxes[:, 1])
+    w = np.maximum(0, boxes[:, 2] - boxes[:, 0])
+    return h * w
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (ref detection.py:152 — SSD-style
+    sampling: every surviving object must be covered at least
+    ``min_object_covered``; objects reduced below ``min_eject_coverage``
+    of their original area are ejected)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = (area_range[1] > 0 and
+                        area_range[0] <= area_range[1] and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        crop = self._random_crop_proposal(label, src.shape[0], src.shape[1])
+        if crop:
+            x, y, w, h, label = crop
+            src = fixed_crop(src, x, y, w, h, None)
+        return src, label
+
+    def _intersect(self, boxes, xmin, ymin, xmax, ymax):
+        left = np.maximum(boxes[:, 0], xmin)
+        right = np.minimum(boxes[:, 2], xmax)
+        top = np.maximum(boxes[:, 1], ymin)
+        bot = np.minimum(boxes[:, 3], ymax)
+        invalid = np.where(np.logical_or(left >= right, top >= bot))[0]
+        out = boxes.copy()
+        out[:, 0], out[:, 1], out[:, 2], out[:, 3] = left, top, right, bot
+        out[invalid, :] = 0
+        return out
+
+    def _check_satisfy_constraints(self, label, xmin, ymin, xmax, ymax,
+                                   width, height):
+        if (xmax - xmin) * (ymax - ymin) < 2:
+            return False
+        x1, y1 = float(xmin) / width, float(ymin) / height
+        x2, y2 = float(xmax) / width, float(ymax) / height
+        object_areas = _box_areas(label[:, 1:])
+        valid_objects = np.where(object_areas * width * height > 2)[0]
+        if valid_objects.size < 1:
+            return False
+        intersects = self._intersect(label[valid_objects, 1:], x1, y1, x2, y2)
+        coverages = _box_areas(intersects) / object_areas[valid_objects]
+        coverages = coverages[np.where(coverages > 0)[0]]
+        return coverages.size > 0 and \
+            np.amin(coverages) > self.min_object_covered
+
+    def _update_labels(self, label, crop_box, height, width):
+        xmin = float(crop_box[0]) / width
+        ymin = float(crop_box[1]) / height
+        w = float(crop_box[2]) / width
+        h = float(crop_box[3]) / height
+        out = label.copy()
+        out[:, (1, 3)] -= xmin
+        out[:, (2, 4)] -= ymin
+        out[:, (1, 3)] /= w
+        out[:, (2, 4)] /= h
+        out[:, 1:5] = np.clip(out[:, 1:5], 0, 1)
+        coverage = _box_areas(out[:, 1:]) * w * h / _box_areas(label[:, 1:])
+        valid = np.logical_and(out[:, 3] > out[:, 1], out[:, 4] > out[:, 2])
+        valid = np.logical_and(valid, coverage > self.min_eject_coverage)
+        valid = np.where(valid)[0]
+        if valid.size < 1:
+            return None
+        return out[valid, :]
+
+    def _random_crop_proposal(self, label, height, width):
+        from math import sqrt
+
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = random.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = int(round(sqrt(max_area / ratio)))
+            if round(max_h * ratio) > width:
+                max_h = int((width + 0.4999999) / ratio)
+            max_h = min(max_h, height)
+            h = min(h, max_h)
+            if h < max_h:
+                h = random.randint(h, max_h)
+            w = int(round(h * ratio))
+            if w > width:
+                continue
+            area = w * h
+            if area < min_area:
+                h += 1
+                w = int(round(h * ratio))
+                area = w * h
+            if area > max_area:
+                h -= 1
+                w = int(round(h * ratio))
+                area = w * h
+            if (area < min_area or area > max_area or w > width or
+                    h > height or w <= 0 or h <= 0):
+                continue
+            y = random.randint(0, max(0, height - h))
+            x = random.randint(0, max(0, width - w))
+            if self._check_satisfy_constraints(label, x, y, x + w, y + h,
+                                               width, height):
+                new_label = self._update_labels(label, (x, y, w, h),
+                                                height, width)
+                if new_label is not None:
+                    return (x, y, w, h, new_label)
+        return ()
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding (ref detection.py:338 — place the image in
+    a larger canvas, rescaling labels; SSD zoom-out augmentation)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0 and
+                        area_range[0] <= area_range[1] and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        height, width = src.shape[0], src.shape[1]
+        pad = self._random_pad_proposal(label, height, width)
+        if pad:
+            x, y, w, h, label = pad
+            arr = _asnp(src)
+            canvas = np.empty((h, w, arr.shape[2]), arr.dtype)
+            canvas[...] = np.asarray(
+                self.pad_val, arr.dtype)[:arr.shape[2]]
+            canvas[y:y + height, x:x + width] = arr
+            src = nd.array(canvas)
+        return src, label
+
+    def _update_labels(self, label, pad_box, height, width):
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * width + pad_box[0]) / pad_box[2]
+        out[:, (2, 4)] = (out[:, (2, 4)] * height + pad_box[1]) / pad_box[3]
+        return out
+
+    def _random_pad_proposal(self, label, height, width):
+        from math import sqrt
+
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = random.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = int(round(sqrt(max_area / ratio)))
+            if round(h * ratio) < width:
+                h = int((width + 0.499999) / ratio)
+            h = max(h, height)
+            h = min(h, max_h)
+            if h < max_h:
+                h = random.randint(h, max_h)
+            w = int(round(h * ratio))
+            if (h - height) < 2 or (w - width) < 2:
+                continue
+            y = random.randint(0, max(0, h - height))
+            x = random.randint(0, max(0, w - width))
+            new_label = self._update_labels(label, (x, y, w, h),
+                                            height, width)
+            return (x, y, w, h, new_label)
+        return ()
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """List-valued params broadcast into several crop augmenters, one of
+    which is randomly selected per image (ref detection.py:418)."""
+    def align(params):
+        out, num = [], 1
+        for p in params:
+            p = p if isinstance(p, list) else [p]
+            out.append(p)
+            num = max(num, len(p))
+        for k, p in enumerate(out):
+            if len(p) != num:
+                assert len(p) == 1, "cannot broadcast param of len %d" % len(p)
+                out[k] = p * num
+        return out
+
+    aligned = align([min_object_covered, aspect_ratio_range, area_range,
+                     min_eject_coverage, max_attempts])
+    augs = [DetRandomCropAug(min_object_covered=moc, aspect_ratio_range=arr,
+                             area_range=ar, min_eject_coverage=mec,
+                             max_attempts=ma)
+            for moc, arr, ar, mec, ma in zip(*aligned)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Detection augmenter pipeline factory (ref detection.py:483)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, area_range,
+            min_eject_coverage, max_attempts, skip_prob=(1 - rand_crop)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(aspect_ratio_range, (1.0, area_range[1]),
+                                  max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection image iterator over .rec/.lst sources (ref
+    detection.py:625): parses the header-prefixed variable-count label
+    format, applies det augmenters, and pads batch labels with -1."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name)
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.label_pad_value = -1.0
+        self.label_shape = self._estimate_label_shape()
+
+    # parent exposes provide_label as a property; detection labels are
+    # (batch, max_objects, obj_width)
+    @property
+    def provide_label(self):
+        return [_io.DataDesc(
+            self._label_name,
+            (self.batch_size,) + tuple(self.label_shape), np.float32)]
+
+    @provide_label.setter
+    def provide_label(self, descs):
+        (name, shape) = descs[0][:2]
+        self._label_name = name
+        self.label_shape = tuple(shape[1:])
+
+    def _check_valid_label(self, label):
+        if len(label.shape) != 2 or label.shape[1] < 5:
+            raise MXNetError("Label with shape (1+, 5+) required, %s "
+                             "received." % str(label))
+        valid = np.where(np.logical_and(label[:, 0] >= 0,
+                                        np.logical_and(
+                                            label[:, 3] > label[:, 1],
+                                            label[:, 4] > label[:, 2])))[0]
+        if valid.size < 1:
+            raise MXNetError("Invalid label occurs.")
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                label = self._parse_label(label)
+                max_count = max(max_count, label.shape[0])
+                width = label.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, width)
+
+    def _parse_label(self, label):
+        """[header_width, obj_width, ...header, objs...] -> (N, obj_width)
+        with degenerate boxes removed (ref detection.py:710)."""
+        if isinstance(label, NDArray):
+            label = label.asnumpy()
+        raw = np.asarray(label, np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("Label shape is invalid: " + str(raw.shape))
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise MXNetError("Label shape %s inconsistent with annotation "
+                             "width %d." % (str(raw.shape), obj_width))
+        out = np.reshape(raw[header_width:], (-1, obj_width))
+        valid = np.where(np.logical_and(out[:, 3] > out[:, 1],
+                                        out[:, 4] > out[:, 2]))[0]
+        if valid.size < 1:
+            raise MXNetError("Encounter sample with no valid label.")
+        return out[valid, :]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.check_data_shape(data_shape)
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.label_shape = tuple(label_shape)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), np.float32)
+        batch_label = np.full((batch_size,) + tuple(self.label_shape),
+                              self.label_pad_value, np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                from .image import imdecode
+                data = imdecode(s)
+                try:
+                    label = self._parse_label(label)
+                    data, label = self.augmentation_transform(data, label)
+                    self._check_valid_label(label)
+                except MXNetError as e:
+                    logging.debug("Invalid image, skipping: %s", str(e))
+                    continue
+                arr = _asnp(data)
+                batch_data[i] = arr.transpose(2, 0, 1)
+                num_object = min(label.shape[0], self.label_shape[0])
+                batch_label[i, :num_object, :label.shape[1]] = \
+                    label[:num_object]
+                i += 1
+        except StopIteration:
+            if not i:
+                raise
+        return _io.DataBatch([nd.array(batch_data)],
+                             [nd.array(batch_label)],
+                             pad=batch_size - i)
+
+    __next__ = next
+
+    def augmentation_transform(self, data, label):
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return data, label
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects RGB data (3 channels)")
+
+    def check_label_shape(self, label_shape):
+        if not len(label_shape) == 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[0] < self.label_shape[0]:
+            raise ValueError(
+                "Attempts to reduce label count from %d to %d, not allowed."
+                % (self.label_shape[0], label_shape[0]))
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize label padding between train/val iterators
+        (ref detection.py:901)."""
+        assert isinstance(it, ImageDetIter), "only applies to ImageDetIter"
+        train_shape = self.label_shape
+        val_shape = it.label_shape
+        assert train_shape[1] == val_shape[1], "object widths mismatch"
+        max_count = max(train_shape[0], val_shape[0])
+        if max_count > train_shape[0]:
+            self.reshape(None, (max_count, train_shape[1]))
+        if max_count > val_shape[0]:
+            it.reshape(None, (max_count, val_shape[1]))
+        if verbose and max_count > min(train_shape[0], val_shape[0]):
+            logging.info("Resized label_shape to (%d, %d).",
+                         max_count, train_shape[1])
+        return self

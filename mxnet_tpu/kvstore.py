@@ -197,6 +197,17 @@ class DistKVStore(KVStore):
         from . import parallel
         self._pg = parallel.process_group()
 
+    def init(self, key, value):
+        """Rank 0's value wins everywhere (reference semantics: worker 0
+        initializes the parameter server, kvstore_dist.h InitImpl — other
+        ranks' init values are discarded)."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % k)
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = self._pg.broadcast(v0.copy(), root=0)
+
     @property
     def rank(self):
         return self._pg.rank

@@ -36,7 +36,10 @@ def check_label_shapes(labels, preds, shape=False):
 
 class EvalMetric:
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
-        self.name = str(name)
+        # list names stay lists (multi-value metrics like the SSD MultiBox
+        # CE+SmoothL1 pair; get_name_value zips them)
+        self.name = list(name) if isinstance(name, (list, tuple)) \
+            else str(name)
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs

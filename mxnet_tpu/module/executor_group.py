@@ -38,7 +38,8 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts: List[Context], workload,
                  data_shapes, label_shapes, param_names,
                  for_training, inputs_need_grad, shared_group=None,
-                 fixed_param_names=None, grad_req="write", state_names=None):
+                 fixed_param_names=None, grad_req="write", state_names=None,
+                 group2ctxs=None):
         self.symbol = symbol
         self.contexts = contexts
         self.workload = workload or [1.0] * len(contexts)
@@ -64,13 +65,20 @@ class DataParallelExecutorGroup:
                 req[n] = grad_req if isinstance(grad_req, str) \
                     else grad_req.get(n, "write")
         self.grad_req = req
-        for ctx, slc in zip(contexts, self.slices):
+        # group2ctxs: coarse model-parallel placement per data-parallel
+        # replica (ref module.py:31 + AssignContext) — a dict applies to
+        # every replica, a list gives one dict per context
+        if isinstance(group2ctxs, dict) or group2ctxs is None:
+            group2ctxs = [group2ctxs] * len(contexts)
+        assert len(group2ctxs) == len(contexts), \
+            "group2ctxs must match the number of contexts"
+        for ctx, slc, g2c in zip(contexts, self.slices, group2ctxs):
             n_i = slc.stop - slc.start
             shapes = {d.name: (n_i,) + d.shape[1:] for d in data_shapes}
             for l in (label_shapes or []):
                 shapes[l.name] = (n_i,) + l.shape[1:]
             self.execs.append(symbol.simple_bind(ctx=ctx, grad_req=req,
-                                                 **shapes))
+                                                 group2ctx=g2c, **shapes))
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
 

@@ -54,6 +54,7 @@ class Module(BaseModule):
         self._updater = None
         self._update_on_kvstore = False
         self._grad_req = "write"
+        self._group2ctxs = group2ctxs
 
     # ---- info -----------------------------------------------------------
     @property
@@ -102,7 +103,8 @@ class Module(BaseModule):
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
-            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req,
+            group2ctxs=self._group2ctxs)
         self.binded = True
         if self._arg_params is not None:
             self._exec_group.set_params(self._arg_params, self._aux_params,
@@ -177,6 +179,13 @@ class Module(BaseModule):
                 kv.set_optimizer(optimizer)
             for i, name in enumerate(self._param_names):
                 kv.init(name, self._arg_params[name])
+                # sync back: on dist stores rank 0's init wins, so every
+                # rank must start from the store's value (reference
+                # model.py _initialize_kvstore pulls after init)
+                if kv.type.startswith("dist"):
+                    weights = self._exec_group.param_arrays[i]
+                    kv.pull(name, out=weights)
+                    kv.pull(name, out=self._arg_params[name])
         self.optimizer_initialized = True
         preload = getattr(self, "_preload_opt_states", None)
         if preload is not None and self._updater is not None:

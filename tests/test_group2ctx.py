@@ -1,0 +1,83 @@
+"""Coarse model parallelism via ctx_group/group2ctx (ref:
+AssignContext graph_executor.cc:315 + tests/python/unittest/
+test_model_parallel.py): node groups execute on their assigned devices,
+with explicit transfers at group boundaries."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _two_group_mlp():
+    data = sym.var("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        h = sym.FullyConnected(h, num_hidden=8, name="fc2")
+        out = sym.SoftmaxOutput(h, name="softmax")
+    return out
+
+
+def test_group2ctx_places_and_computes():
+    import jax
+    assert len(jax.devices()) >= 2
+    net = _two_group_mlp()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    ex = net.simple_bind(ctx=mx.cpu(0), group2ctx=g2c, data=(4, 12))
+    for n, arr in ex.arg_dict.items():
+        if n != "data":
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    x = np.random.uniform(size=(4, 12)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=mx.nd.array(x))
+    # output produced by the dev2 group lives on cpu(1)
+    out_dev = list(outs[0]._data.devices())[0]
+    assert out_dev == mx.cpu(1).jax_device, out_dev
+
+    # numerics match the ungrouped single-device executor
+    ex1 = net.simple_bind(ctx=mx.cpu(0), data=(4, 12))
+    for n in ex.arg_dict:
+        if n != "data":
+            ex1.arg_dict[n][:] = ex.arg_dict[n].asnumpy()
+    outs1 = ex1.forward(is_train=True, data=mx.nd.array(x))
+    np.testing.assert_allclose(outs[0].asnumpy(), outs1[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_backward_matches():
+    import jax
+    assert len(jax.devices()) >= 2
+    net = _two_group_mlp()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    ex = net.simple_bind(ctx=mx.cpu(0), group2ctx=g2c, data=(4, 12))
+    ex1 = net.simple_bind(ctx=mx.cpu(0), data=(4, 12))
+    rng = np.random.RandomState(0)
+    for n in ex.arg_dict:
+        v = rng.uniform(-0.1, 0.1, ex.arg_dict[n].shape) \
+            if n != "data" else rng.uniform(size=ex.arg_dict[n].shape)
+        ex.arg_dict[n][:] = v
+        ex1.arg_dict[n][:] = v
+    y = rng.randint(0, 8, size=(4,)).astype(np.float32)
+    ex.arg_dict.get("softmax_label", ex.arg_dict["data"])  # label exists?
+    for e in (ex, ex1):
+        if "softmax_label" in e.arg_dict:
+            e.arg_dict["softmax_label"][:] = y
+        e.forward(is_train=True)
+        e.backward()
+    for n in ex.grad_dict:
+        np.testing.assert_allclose(ex.grad_dict[n].asnumpy(),
+                                   ex1.grad_dict[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_module_api_accepted():
+    """Module(group2ctxs=...) runs a fit step without silently ignoring
+    placement (the round-1 silent no-op finding)."""
+    net = _two_group_mlp()
+    mod = mx.mod.Module(net, label_names=("softmax_label",),
+                        group2ctxs={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    X = np.random.uniform(size=(32, 12)).astype(np.float32)
+    y = np.random.randint(0, 8, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    assert dict(mod.score(it, "acc"))  # runs end to end

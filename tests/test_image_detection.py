@@ -1,0 +1,162 @@
+"""Detection data pipeline tests (ref: ImageDetIter in
+python/mxnet/image/detection.py:625, ImageDetRecordIter in
+src/io/iter_image_det_recordio.cc:582)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image_detection import (
+    DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    CreateDetAugmenter, CreateMultiRandCropAugmenter, ImageDetIter)
+
+
+def _det_label(boxes, header_extra=()):
+    """Build the wire-format label: [hw, ow, extra..., objs...]."""
+    hw = 2 + len(header_extra)
+    flat = [hw, 5.0] + list(header_extra)
+    for b in boxes:
+        flat.extend(b)
+    return np.array(flat, np.float32)
+
+
+def _make_rec(tmp_path, n=12, size=48, max_boxes=4, seed=5):
+    """Pack synthetic images + variable-count det labels into a .rec."""
+    rng = np.random.RandomState(seed)
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    counts = []
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        k = rng.randint(1, max_boxes + 1)
+        boxes = []
+        for _ in range(k):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            x2, y2 = x1 + rng.uniform(0.2, 0.5), y1 + rng.uniform(0.2, 0.5)
+            boxes.append([rng.randint(0, 3), x1, y1, min(x2, 1.0),
+                          min(y2, 1.0)])
+        counts.append(k)
+        header = recordio.IRHeader(0, _det_label(boxes), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=90))
+    rec.close()
+    return rec_path, idx_path, counts
+
+
+def test_parse_label_and_padding(tmp_path):
+    rec_path, idx_path, counts = _make_rec(tmp_path)
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      path_imgrec=rec_path, path_imgidx=idx_path)
+    # label shape estimated over the dataset: (max boxes, 5)
+    assert it.label_shape == (max(counts), 5)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4, max(counts), 5)
+    for i in range(4):
+        n_real = (lab[i, :, 0] >= 0).sum()
+        assert n_real == counts[i]
+        # padding rows are -1
+        assert (lab[i, n_real:] == -1).all()
+        # coordinates normalized
+        real = lab[i, :n_real]
+        assert (real[:, 1:] >= 0).all() and (real[:, 1:] <= 1).all()
+        assert (real[:, 3] > real[:, 1]).all()
+
+
+def test_header_extra_fields_are_stripped(tmp_path):
+    rng = np.random.RandomState(0)
+    rec_path = str(tmp_path / "h.rec")
+    idx_path = str(tmp_path / "h.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+    label = _det_label([[1, 0.1, 0.1, 0.6, 0.6]], header_extra=(7.0, 8.0))
+    rec.write_idx(0, recordio.pack_img(recordio.IRHeader(0, label, 0, 0), img))
+    rec.close()
+    it = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                      path_imgrec=rec_path, path_imgidx=idx_path)
+    lab = next(it).label[0].asnumpy()
+    np.testing.assert_allclose(lab[0, 0], [1, 0.1, 0.1, 0.6, 0.6],
+                               rtol=1e-6)
+
+
+def test_image_det_record_iter_pad_width(tmp_path):
+    rec_path, idx_path, counts = _make_rec(tmp_path)
+    it = mx.io.ImageDetRecordIter(rec_path, (3, 32, 32), batch_size=3,
+                                  label_pad_width=13, path_imgidx=idx_path,
+                                  label_pad_value=-2.0)
+    lab = next(it).label[0].asnumpy()
+    assert lab.shape == (3, 13, 5)
+    assert (lab[0, counts[0]:] == -2.0).all()
+    with pytest.raises(mx.MXNetError):
+        mx.io.ImageDetRecordIter(rec_path, (3, 32, 32), batch_size=3,
+                                 label_pad_width=1, path_imgidx=idx_path)
+
+
+def test_det_flip_label():
+    aug = DetHorizontalFlipAug(p=1.0)
+    src = mx.nd.array(np.zeros((10, 10, 3), np.float32))
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    _, out = aug(src, label.copy())
+    np.testing.assert_allclose(out[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+
+
+def test_det_random_crop_constraints():
+    rng = np.random.RandomState(1)
+    aug = DetRandomCropAug(min_object_covered=0.5, area_range=(0.3, 0.9),
+                           max_attempts=50)
+    src = mx.nd.array(rng.uniform(0, 1, (64, 64, 3)).astype(np.float32))
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    for _ in range(10):
+        out_src, out_label = aug(src, label.copy())
+        # surviving boxes stay normalized and non-degenerate
+        assert (out_label[:, 1:] >= 0).all() and (out_label[:, 1:] <= 1).all()
+        assert (out_label[:, 3] > out_label[:, 1]).all()
+        assert (out_label[:, 4] > out_label[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    aug = DetRandomPadAug(area_range=(1.5, 3.0), max_attempts=50)
+    src = mx.nd.array(np.ones((32, 32, 3), np.float32))
+    label = np.array([[2, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out_src, out_label = aug(src, label.copy())
+    if out_src.shape != src.shape:      # pad proposal accepted
+        area = (out_label[0, 3] - out_label[0, 1]) * \
+               (out_label[0, 4] - out_label[0, 2])
+        assert area < 1.0
+
+
+def test_create_det_augmenter_pipeline(tmp_path):
+    rec_path, idx_path, _ = _make_rec(tmp_path)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      path_imgrec=rec_path, path_imgidx=idx_path,
+                      rand_crop=0.5, rand_pad=0.5, rand_mirror=True,
+                      mean=True, std=True, brightness=0.1)
+    for batch in it:
+        lab = batch.label[0].asnumpy()
+        real = lab[lab[:, :, 0] >= 0]
+        assert (real[:, 1:] >= -1e-6).all() and (real[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_multi_rand_crop_broadcast():
+    sel = CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5, 0.9],
+        aspect_ratio_range=(0.75, 1.33),
+        area_range=[(0.1, 1.0), (0.2, 1.0), (0.3, 1.0)])
+    assert len(sel.aug_list) == 3
+    assert sel.aug_list[1].min_object_covered == 0.5
+
+
+def test_sync_label_shape(tmp_path):
+    rec1, idx1, _ = _make_rec(tmp_path, n=6, max_boxes=3, seed=1)
+    d2 = tmp_path / "v"
+    d2.mkdir()
+    rec2, idx2, _ = _make_rec(d2, n=6, max_boxes=6, seed=2)
+    train = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                         path_imgrec=rec1, path_imgidx=idx1)
+    val = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                       path_imgrec=rec2, path_imgidx=idx2)
+    train.sync_label_shape(val)
+    assert train.label_shape == val.label_shape

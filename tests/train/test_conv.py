@@ -1,0 +1,115 @@
+"""End-to-end convergence: LeNet-style conv net on 8x8 digit images —
+Module.fit, Gluon Trainer, and FusedTrainer paths.
+
+Parity target: tests/python/train/test_conv.py (reference LeNet on MNIST,
+accuracy-thresholded).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, symbol as sym
+from mxnet_tpu.gluon import nn
+
+
+def _digit_images():
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32).reshape(-1, 1, 8, 8)
+    y = y.astype(np.float32)
+    rng = np.random.RandomState(3)
+    idx = rng.permutation(len(X))
+    X, y = X[idx], y[idx]
+    n = 1500
+    return (X[:n], y[:n]), (X[n:], y[n:])
+
+
+def _lenet_symbol():
+    data = sym.var("data")
+    c = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                        name="conv1")
+    c = sym.Activation(c, act_type="relu")
+    c = sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c = sym.Convolution(c, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                        name="conv2")
+    c = sym.Activation(c, act_type="relu")
+    c = sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(c)
+    f = sym.FullyConnected(f, num_hidden=64, name="fc1")
+    f = sym.Activation(f, act_type="relu")
+    f = sym.FullyConnected(f, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(f, name="softmax")
+
+
+def test_conv_module_fit_converges():
+    (Xtr, ytr), (Xte, yte) = _digit_images()
+    train = mx.io.NDArrayIter(Xtr, ytr, batch_size=100, shuffle=True)
+    val = mx.io.NDArrayIter(Xte, yte, batch_size=100)
+    mod = mx.mod.Module(_lenet_symbol())
+    mod.fit(train, num_epoch=10,
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    assert acc > 0.93, "val accuracy %.3f too low" % acc
+
+
+def _gluon_lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def _accuracy(net, X, y, batch=100):
+    correct = 0
+    for i in range(0, len(X), batch):
+        out = net(mx.nd.array(X[i:i + batch])).asnumpy()
+        correct += (out.argmax(1) == y[i:i + batch]).sum()
+    return correct / len(X)
+
+
+def test_conv_gluon_trainer_converges():
+    (Xtr, ytr), (Xte, yte) = _digit_images()
+    net = _gluon_lenet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    B = 100
+    for _ in range(12):
+        for i in range(0, len(Xtr), B):
+            x = mx.nd.array(Xtr[i:i + B])
+            y = mx.nd.array(ytr[i:i + B])
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(B)
+    acc = _accuracy(net, Xte, yte)
+    assert acc > 0.93, "gluon val accuracy %.3f too low" % acc
+
+
+def test_conv_fused_trainer_converges():
+    (Xtr, ytr), (Xte, yte) = _digit_images()
+    net = _gluon_lenet()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(Xtr[:2]))        # materialize params
+    ft = mx.FusedTrainer(net, "softmax_cross_entropy", "sgd",
+                         {"learning_rate": 0.2, "momentum": 0.9})
+    B = 100
+    first = last = None
+    for _ in range(10):
+        for i in range(0, 1500, B):
+            loss = ft.step(mx.nd.array(Xtr[i:i + B]),
+                           mx.nd.array(ytr[i:i + B]))
+        l = float(loss.asnumpy())
+        first = l if first is None else first
+        last = l
+    assert last < first * 0.2, "fused loss %.3f -> %.3f" % (first, last)
+    ft.sync_params()
+    acc = _accuracy(net, Xte, yte)
+    assert acc > 0.93, "fused val accuracy %.3f too low" % acc
