@@ -1,14 +1,15 @@
-"""ONNX model import.
+"""ONNX model import/export.
 
-Reference analog: ``python/mxnet/contrib/onnx/`` (onnx2mx import_model /
-import_to_gluon — SURVEY.md §2.3 contrib): converts an ONNX GraphProto into
-a Symbol + parameter dict.
+Reference analog: ``python/mxnet/contrib/onnx/`` (onnx2mx import_model and
+the ~100-entry converter table in ``onnx2mx/_op_translations.py`` —
+SURVEY.md §2.3 contrib): converts an ONNX GraphProto into a Symbol +
+parameter dict, and a Symbol + params back into an ONNX model.
 
-The converter itself (:func:`import_graph`) is pure and duck-typed over the
-ONNX protobuf objects, so it needs only the ``onnx`` package for *loading*
-files (:func:`import_model`); environments without onnx installed can still
-convert in-memory graph objects (this is also how the unit tests exercise
-every op converter without the package).
+Unlike the reference, no external ``onnx`` package is needed: ``.onnx``
+files are (de)serialized with :mod:`mxnet_tpu.contrib.onnx_proto`, a
+dependency-free protobuf wire codec.  The converter itself
+(:func:`import_graph`) is duck-typed over the proto objects, so graphs
+built with the real ``onnx`` package convert identically.
 """
 from __future__ import annotations
 
@@ -17,8 +18,15 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..base import MXNetError
+from . import onnx_proto as P
 
-__all__ = ["import_model", "import_graph", "get_model_metadata"]
+__all__ = ["import_model", "import_graph", "get_model_metadata",
+           "export_model", "export_graph"]
+
+# TensorProto.DataType -> numpy
+_ONNX_DT = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+            7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+_NP_DT = {np.dtype(v): k for k, v in _ONNX_DT.items()}
 
 
 def _attrs_of(node) -> dict:
@@ -35,6 +43,9 @@ def _attrs_of(node) -> dict:
         if getattr(a, "type", None) == 3 or _has(a, "s"):
             s = a.s
             out[name] = s.decode() if isinstance(s, bytes) else s
+        if getattr(a, "type", None) == 4 or _has(a, "t"):
+            if getattr(a, "t", None) is not None:
+                out[name] = _tensor_to_np(a.t)
         if len(getattr(a, "ints", ())):
             out[name] = tuple(int(x) for x in a.ints)
         if len(getattr(a, "floats", ())):
@@ -53,9 +64,7 @@ def _tensor_to_np(t) -> np.ndarray:
     """TensorProto -> numpy (float/int tensors; raw or field data)."""
     shape = tuple(t.dims)
     raw = getattr(t, "raw_data", b"")
-    # TensorProto.DataType: 1=FLOAT 6=INT32 7=INT64 11=DOUBLE
-    dt = {1: np.float32, 6: np.int32, 7: np.int64,
-          11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
+    dt = _ONNX_DT.get(getattr(t, "data_type", 1), np.float32)
     if raw:
         arr = np.frombuffer(raw, dtype=dt)
     elif len(getattr(t, "float_data", ())):
@@ -97,7 +106,15 @@ def import_graph(graph):
         if inp.name not in params:
             env[inp.name] = S.var(inp.name)
     for name in params:
-        env[name] = S.var(name)
+        env[name] = S.var(name, shape=params[name].shape)
+
+    def const_input(node, idx, what):
+        """Fetch input idx which must be a graph constant (initializer)."""
+        name = node.input[idx]
+        if name not in params:
+            raise MXNetError("ONNX %s with dynamic %s input is unsupported"
+                             % (node.op_type, what))
+        return params[name]
 
     def conv(node):
         attrs = _attrs_of(node)
@@ -113,24 +130,50 @@ def import_graph(graph):
                              no_bias=len(node.input) < 3,
                              name=node.name or node.output[0])
 
+    def conv_transpose(node):
+        attrs = _attrs_of(node)
+        kernel, stride, pad = _pool_attrs(attrs)
+        group = attrs.get("group", 1)
+        # ConvTranspose weight is (C_in, C_out/group, *kernel)
+        num_filter = const_input(node, 1, "weight").shape[1] * group
+        args = [env[i] for i in node.input]
+        return S.Deconvolution(*args, kernel=kernel, stride=stride,
+                               pad=pad, num_filter=num_filter,
+                               num_group=group,
+                               dilate=attrs.get("dilations",
+                                                (1,) * len(kernel)),
+                               adj=attrs.get("output_padding",
+                                             (0,) * len(kernel)),
+                               no_bias=len(node.input) < 3,
+                               name=node.name or node.output[0])
+
     def gemm(node):
         attrs = _attrs_of(node)
         if attrs.get("transA", 0):
             raise MXNetError("ONNX Gemm with transA=1 is unsupported")
-        a, w = env[node.input[0]], env[node.input[1]]
-        num_hidden = params[node.input[1]].shape[
-            1 if attrs.get("transB", 0) == 0 else 0]
-        if attrs.get("transB", 0) == 0:
-            # our FullyConnected expects (out, in): pre-transpose the param
-            params[node.input[1]] = params[node.input[1]].T
-        # fold alpha/beta scaling into the (initializer) params
+        a = env[node.input[0]]
+        wname = node.input[1]
+        w_shape = (params[wname].shape if wname in params
+                   else None)
+        trans_b = attrs.get("transB", 0)
+        w = env[wname]
+        if not trans_b:
+            # our FullyConnected expects (out, in): transpose symbolically
+            # (initializers stay untouched — they may be shared)
+            w = S.transpose(w, axes=(1, 0))
+        if w_shape is None:
+            raise MXNetError("ONNX Gemm with dynamic weight unsupported")
+        num_hidden = w_shape[1 if not trans_b else 0]
         alpha = attrs.get("alpha", 1.0)
         beta = attrs.get("beta", 1.0)
         if alpha != 1.0:
-            params[node.input[1]] = params[node.input[1]] * np.float32(alpha)
-        if beta != 1.0 and len(node.input) > 2:
-            params[node.input[2]] = params[node.input[2]] * np.float32(beta)
-        ins = [a, w] + ([env[node.input[2]]] if len(node.input) > 2 else [])
+            w = w * float(alpha)
+        ins = [a, w]
+        if len(node.input) > 2:
+            b = env[node.input[2]]
+            if beta != 1.0:
+                b = b * float(beta)
+            ins.append(b)
         return S.FullyConnected(*ins, num_hidden=num_hidden,
                                 no_bias=len(node.input) < 3,
                                 name=node.name or node.output[0])
@@ -160,40 +203,268 @@ def import_graph(graph):
                            name=node.name or node.output[0])
 
     def reshape(node):
-        shape = params.pop(node.input[1], None)
-        if shape is None:
-            raise MXNetError("ONNX Reshape with dynamic shape input is "
-                             "unsupported")
-        env.pop(node.input[1], None)
+        if len(node.input) > 1:
+            shape = const_input(node, 1, "shape")
+        else:  # opset 1 attr form
+            shape = _attrs_of(node)["shape"]
         return S.Reshape(env[node.input[0]],
                          shape=tuple(int(x) for x in shape))
 
+    def clip(node):
+        attrs = _attrs_of(node)
+        lo, hi = attrs.get("min"), attrs.get("max")
+        if lo is None and len(node.input) > 1 and node.input[1]:
+            lo = float(const_input(node, 1, "min"))
+        if hi is None and len(node.input) > 2 and node.input[2]:
+            hi = float(const_input(node, 2, "max"))
+        return S.clip(env[node.input[0]],
+                      a_min=-3.4e38 if lo is None else lo,
+                      a_max=3.4e38 if hi is None else hi)
+
+    def pad_op(node):
+        attrs = _attrs_of(node)
+        if len(node.input) > 1:
+            pads = tuple(int(x) for x in const_input(node, 1, "pads"))
+        else:
+            pads = attrs.get("pads", attrs.get("paddings"))
+        n = len(pads) // 2
+        # ONNX: (b1..bn, e1..en) -> mxnet pad_width (b1,e1,b2,e2,...)
+        pw = []
+        for i in range(n):
+            pw += [int(pads[i]), int(pads[i + n])]
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect"}[attrs.get("mode", "constant")]
+        return S.Pad(env[node.input[0]], mode=mode, pad_width=tuple(pw),
+                     constant_value=attrs.get("value", 0.0))
+
+    def slice_op(node):
+        attrs = _attrs_of(node)
+        if len(node.input) > 1:  # opset 10+: inputs
+            starts = const_input(node, 1, "starts")
+            ends = const_input(node, 2, "ends")
+            axes = (const_input(node, 3, "axes")
+                    if len(node.input) > 3 else range(len(starts)))
+            steps = (const_input(node, 4, "steps")
+                     if len(node.input) > 4 else [1] * len(starts))
+        else:
+            starts = attrs["starts"]
+            ends = attrs["ends"]
+            axes = attrs.get("axes", range(len(starts)))
+            steps = [1] * len(starts)
+        out = env[node.input[0]]
+        for ax, b, e, st in zip(axes, starts, ends, steps):
+            if int(st) != 1:
+                raise MXNetError("ONNX Slice with step != 1 unsupported")
+            e = int(e)
+            out = S.slice_axis(out, axis=int(ax), begin=int(b),
+                               end=None if e >= 2 ** 31 - 1 else e)
+        return out
+
+    def split(node):
+        attrs = _attrs_of(node)
+        axis = attrs.get("axis", 0)
+        sizes = attrs.get("split")
+        if sizes is None and len(node.input) > 1:  # opset 13+: input form
+            sizes = tuple(int(x) for x in const_input(node, 1, "split"))
+        if sizes is not None and len(set(sizes)) > 1:
+            raise MXNetError("ONNX Split with unequal parts unsupported")
+        return S.SliceChannel(env[node.input[0]],
+                              num_outputs=len(node.output), axis=axis,
+                              name=node.name or node.output[0])
+
+    def constant(node):
+        attrs = _attrs_of(node)
+        value = attrs.get("value")
+        if value is None:
+            raise MXNetError("ONNX Constant without 'value' tensor")
+        value = np.asarray(value)
+        params[node.output[0]] = value
+        return S.var(node.output[0], shape=value.shape)
+
+    def axes_of(node, attrs, key="axes"):
+        """axes from attribute (opset < 13) or constant input (13+)."""
+        if key in attrs:
+            return attrs[key]
+        if len(node.input) > 1 and node.input[1]:
+            return tuple(int(x) for x in const_input(node, 1, key))
+        return None
+
+    def unsqueeze(node):
+        axes = axes_of(node, _attrs_of(node))
+        if axes is None:
+            raise MXNetError("ONNX Unsqueeze without axes")
+        out = env[node.input[0]]
+        for ax in sorted(axes):
+            out = S.expand_dims(out, axis=int(ax))
+        return out
+
+    def squeeze(node):
+        return S.squeeze(env[node.input[0]],
+                         axis=axes_of(node, _attrs_of(node)))
+
+    def reduce(op_name):
+        def f(node):
+            attrs = _attrs_of(node)
+            return getattr(S, op_name)(
+                env[node.input[0]], axis=axes_of(node, attrs),
+                keepdims=bool(attrs.get("keepdims", 1)))
+        return f
+
+    def gather(node):
+        axis = _attrs_of(node).get("axis", 0)
+        return S.take(env[node.input[0]], env[node.input[1]], axis=axis)
+
+    def upsample(node):
+        attrs = _attrs_of(node)
+        scales = attrs.get("scales")
+        if scales is None and len(node.input) > 1:
+            scales = const_input(node, 1, "scales")
+        mode = attrs.get("mode", "nearest")
+        sh, sw = float(scales[2]), float(scales[3])
+        if sh != sw or sh != int(sh) or sh < 1:
+            raise MXNetError("ONNX Upsample scales %s unsupported (need "
+                             "equal integer H/W scales >= 1)"
+                             % (tuple(scales),))
+        return S.UpSampling(env[node.input[0]], scale=int(sh),
+                            sample_type="nearest" if mode == "nearest"
+                            else "bilinear",
+                            num_filter=1)
+
+    def cast(node):
+        to = _attrs_of(node)["to"]
+        return S.Cast(env[node.input[0]],
+                      dtype=np.dtype(_ONNX_DT[int(to)]).name)
+
+    def nary(binop):
+        def f(node):
+            out = env[node.input[0]]
+            for i in node.input[1:]:
+                out = binop(out, env[i])
+            return out
+        return f
+
+    def leaky(act):
+        def f(node):
+            attrs = _attrs_of(node)
+            kw = {}
+            if act in ("leaky", "elu"):
+                kw["slope"] = attrs.get("alpha",
+                                        0.01 if act == "leaky" else 1.0)
+            ins = [env[i] for i in node.input]
+            return S.LeakyReLU(*ins, act_type=act, **kw)
+        return f
+
+    def hard_sigmoid(node):
+        attrs = _attrs_of(node)
+        alpha = attrs.get("alpha", 0.2)
+        beta = attrs.get("beta", 0.5)
+        return S.clip(env[node.input[0]] * alpha + beta, 0.0, 1.0)
+
+    def image_scaler(node):
+        attrs = _attrs_of(node)
+        scale = attrs.get("scale", 1.0)
+        bias = np.asarray(attrs.get("bias", (0.0,)), np.float32)
+        bname = (node.name or node.output[0]) + "_bias"
+        params[bname] = bias.reshape((1, -1, 1, 1))
+        env[bname] = S.var(bname, shape=params[bname].shape)
+        return S.broadcast_add(env[node.input[0]] * scale, env[bname])
+
+    def mean_n(node):
+        out = env[node.input[0]]
+        for i in node.input[1:]:
+            out = out + env[i]
+        return out * (1.0 / len(node.input))
+
+    def unary(op_name):
+        return lambda n: getattr(S, op_name)(env[n.input[0]])
+
     simple = {
+        # activations
         "Relu": lambda n: S.Activation(env[n.input[0]], act_type="relu"),
         "Sigmoid": lambda n: S.Activation(env[n.input[0]],
                                           act_type="sigmoid"),
         "Tanh": lambda n: S.Activation(env[n.input[0]], act_type="tanh"),
+        "Softplus": lambda n: S.Activation(env[n.input[0]],
+                                           act_type="softrelu"),
+        "LeakyRelu": leaky("leaky"),
+        "Elu": leaky("elu"),
+        "PRelu": leaky("prelu"),
+        "Selu": leaky("selu"),
+        "HardSigmoid": hard_sigmoid,
         # ONNX opset < 13 defines the default Softmax axis as 1
         "Softmax": lambda n: S.softmax(env[n.input[0]],
                                        axis=_attrs_of(n).get("axis", 1)),
+        "LogSoftmax": lambda n: S.log_softmax(
+            env[n.input[0]], axis=_attrs_of(n).get("axis", 1)),
+        # shape manipulation
         "Flatten": lambda n: S.Flatten(env[n.input[0]]),
-        "Add": lambda n: env[n.input[0]] + env[n.input[1]],
-        "Sub": lambda n: env[n.input[0]] - env[n.input[1]],
-        "Mul": lambda n: env[n.input[0]] * env[n.input[1]],
-        "MatMul": lambda n: S.dot(env[n.input[0]], env[n.input[1]]),
+        "Reshape": reshape,
+        "Transpose": lambda n: S.transpose(
+            env[n.input[0]], axes=_attrs_of(n).get("perm", ())),
+        "Squeeze": squeeze,
+        "Unsqueeze": unsqueeze,
+        "Concat": lambda n: S.concat(*[env[i] for i in n.input],
+                                     dim=_attrs_of(n).get("axis", 1)),
+        "Split": split,
+        "Slice": slice_op,
+        "Pad": pad_op,
+        "Tile": lambda n: S.tile(env[n.input[0]], reps=tuple(
+            int(x) for x in const_input(n, 1, "repeats"))),
         "Identity": lambda n: env[n.input[0]],
         "Dropout": lambda n: S.Dropout(env[n.input[0]],
                                        p=_attrs_of(n).get("ratio", 0.5)),
-        "Concat": lambda n: S.concat(*[env[i] for i in n.input],
-                                     dim=_attrs_of(n).get("axis", 1)),
+        "Cast": cast,
+        # arithmetic
+        "Add": lambda n: S.broadcast_add(env[n.input[0]], env[n.input[1]]),
+        "Sub": lambda n: S.broadcast_sub(env[n.input[0]], env[n.input[1]]),
+        "Mul": lambda n: S.broadcast_mul(env[n.input[0]], env[n.input[1]]),
+        "Div": lambda n: S.broadcast_div(env[n.input[0]], env[n.input[1]]),
+        "Pow": lambda n: env[n.input[0]] ** env[n.input[1]],
+        "MatMul": lambda n: S.dot(env[n.input[0]], env[n.input[1]]),
+        "Sum": nary(lambda a, b: S.broadcast_add(a, b)),
+        "Mean": mean_n,
+        "Max": nary(lambda a, b: S.broadcast_maximum(a, b)),
+        "Min": nary(lambda a, b: S.broadcast_minimum(a, b)),
+        "Neg": unary("negative"),
+        "Abs": unary("abs"),
+        "Exp": unary("exp"),
+        "Log": unary("log"),
+        "Sqrt": unary("sqrt"),
+        "Floor": unary("floor"),
+        "Ceil": unary("ceil"),
+        "Reciprocal": unary("reciprocal"),
+        "Sign": unary("sign"),
+        "Clip": clip,
+        # reductions
+        "ReduceMean": reduce("mean"),
+        "ReduceSum": reduce("sum"),
+        "ReduceMax": reduce("max"),
+        "ReduceMin": reduce("min"),
+        "ReduceProd": reduce("prod"),
+        "ArgMax": lambda n: S.argmax(
+            env[n.input[0]], axis=_attrs_of(n).get("axis", 0),
+            keepdims=bool(_attrs_of(n).get("keepdims", 1))),
+        # NN layers
         "Conv": conv,
+        "ConvTranspose": conv_transpose,
         "Gemm": gemm,
         "MaxPool": pool("max"),
         "AveragePool": pool("avg"),
         "GlobalMaxPool": global_pool("max"),
         "GlobalAveragePool": global_pool("avg"),
         "BatchNormalization": batchnorm,
-        "Reshape": reshape,
+        "InstanceNormalization": lambda n: S.InstanceNorm(
+            *[env[i] for i in n.input],
+            eps=_attrs_of(n).get("epsilon", 1e-5)),
+        "LRN": lambda n: S.LRN(
+            env[n.input[0]], alpha=_attrs_of(n).get("alpha", 1e-4),
+            beta=_attrs_of(n).get("beta", 0.75),
+            knorm=_attrs_of(n).get("bias", 1.0),
+            nsize=_attrs_of(n).get("size", 5)),
+        "Gather": gather,
+        "Upsample": upsample,
+        "Constant": constant,
+        "ImageScaler": image_scaler,
     }
 
     for node in graph.node:
@@ -225,35 +496,365 @@ def import_graph(graph):
 
 
 def import_model(model_file):
-    """Load an .onnx file (requires the ``onnx`` package) and convert
-    (parity: contrib.onnx.import_model)."""
-    try:
-        import onnx
-    except ImportError as e:
-        raise ImportError(
-            "import_model requires the 'onnx' package to parse .onnx "
-            "files; in-memory graphs can be converted with import_graph"
-        ) from e
-    model = onnx.load(model_file)
+    """Load an .onnx file and convert -> (sym, arg_params, aux_params)
+    (parity: contrib.onnx.import_model; parsing is self-contained)."""
+    model = P.load(model_file)
+    if model.graph is None:
+        raise MXNetError("%s has no graph (not an ONNX ModelProto?)"
+                         % (model_file,))
     return import_graph(model.graph)
 
 
 def get_model_metadata(model_file):
     """Input/output descriptions of an .onnx file."""
-    try:
-        import onnx
-    except ImportError as e:
-        raise ImportError("get_model_metadata requires 'onnx'") from e
-    model = onnx.load(model_file)
+    model = P.load(model_file)
     g = model.graph
     init = {i.name for i in g.initializer}
 
     def shape_of(vi):
-        return tuple(d.dim_value for d in
-                     vi.type.tensor_type.shape.dim)
+        if vi.type is None or vi.type.tensor_type is None or \
+                vi.type.tensor_type.shape is None:
+            return ()
+        return tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
 
     return {
         "input_tensor_data": [(i.name, shape_of(i)) for i in g.input
                               if i.name not in init],
         "output_tensor_data": [(o.name, shape_of(o)) for o in g.output],
     }
+
+
+# ---------------------------------------------------------------------------
+# export (Symbol + params -> ONNX)
+# ---------------------------------------------------------------------------
+
+def _np_to_tensor(name: str, arr: np.ndarray) -> P.TensorProto:
+    arr = np.ascontiguousarray(arr)
+    dt = _NP_DT.get(arr.dtype)
+    if dt is None:
+        arr = arr.astype(np.float32)
+        dt = 1
+    return P.TensorProto(name=name, dims=list(arr.shape), data_type=dt,
+                         raw_data=arr.tobytes())
+
+
+def _vi(name: str, shape, elem_type=1) -> P.ValueInfoProto:
+    dims = [P.Dimension(dim_value=int(d)) for d in shape]
+    return P.ValueInfoProto(name=name, type=P.TypeProto(
+        tensor_type=P.TensorTypeProto(
+            elem_type=elem_type,
+            shape=P.TensorShapeProto(dim=dims))))
+
+
+def _attr(name, value):
+    a = P.AttributeProto(name=name)
+    if isinstance(value, bool):
+        a.i, a.type = int(value), P.AttributeProto.INT
+    elif isinstance(value, (int, np.integer)):
+        a.i, a.type = int(value), P.AttributeProto.INT
+    elif isinstance(value, (float, np.floating)):
+        a.f, a.type = float(value), P.AttributeProto.FLOAT
+    elif isinstance(value, str):
+        a.s, a.type = value.encode(), P.AttributeProto.STRING
+    elif isinstance(value, (tuple, list)):
+        if value and isinstance(value[0], (float, np.floating)):
+            a.floats, a.type = [float(v) for v in value], \
+                P.AttributeProto.FLOATS
+        else:
+            a.ints, a.type = [int(v) for v in value], P.AttributeProto.INTS
+    else:
+        raise MXNetError("cannot export attribute %s=%r" % (name, value))
+    return a
+
+
+def export_graph(sym, params, input_shapes, graph_name="mxnet_tpu"):
+    """Symbol + {name: array} + {input: shape} -> ONNX GraphProto.
+
+    Covers the layer set of the model zoo (Conv/Deconv, FC, pooling incl.
+    global, BatchNorm/InstanceNorm/LRN, activations, softmax, elementwise,
+    concat/reshape/transpose/slice/split/pad/clip, reductions, dropout,
+    embedding-gather, upsampling).  Multi-precision params are exported in
+    their stored dtype.
+    """
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in params.items()}
+    nodes: List[P.NodeProto] = []
+    initializers: List[P.TensorProto] = []
+    graph_inputs: List[P.ValueInfoProto] = []
+    names: Dict[int, List[str]] = {}   # id(_Node) -> output tensor names
+    uniq = [0]
+
+    def fresh(base):
+        uniq[0] += 1
+        return "%s_%d" % (base, uniq[0])
+
+    def add_node(op_type, ins, outs, name, **attrs):
+        nodes.append(P.NodeProto(
+            op_type=op_type, input=list(ins), output=list(outs),
+            name=name,
+            attribute=[_attr(k, v) for k, v in attrs.items()
+                       if v is not None]))
+
+    def add_const(base, arr):
+        name = fresh(base)
+        initializers.append(_np_to_tensor(name, np.asarray(arr)))
+        return name
+
+    topo = sym._topo()
+    for node in topo:
+        if node.is_var:
+            if node.name in params:
+                initializers.append(_np_to_tensor(node.name,
+                                                  params[node.name]))
+            else:
+                if node.name not in input_shapes:
+                    raise MXNetError(
+                        "export: missing shape for input %r" % node.name)
+                graph_inputs.append(_vi(node.name,
+                                        input_shapes[node.name]))
+            names[id(node)] = [node.name]
+            continue
+        in_names = [names[id(p)][i] for p, i in node.inputs]
+        attrs = node.parsed_attrs()
+        op = node.op.name
+        n_out = node.num_visible()
+        outs = [node.name] if n_out == 1 else \
+            ["%s_output%d" % (node.name, i) for i in range(n_out)]
+        _export_one(op, attrs, in_names, outs, node, add_node, add_const,
+                    params)
+        names[id(node)] = outs
+
+    out_vis = [_vi(n, ()) for n in
+               [names[id(node)][i] for node, i in sym._outputs]]
+    return P.GraphProto(name=graph_name, node=nodes,
+                        initializer=initializers,
+                        input=graph_inputs, output=out_vis)
+
+
+def _export_one(op, attrs, ins, outs, node, add_node, add_const, params):
+    """Emit ONNX node(s) for one symbol node."""
+    name = node.name
+
+    def a(key, default=None):
+        v = attrs.get(key, default)
+        return v
+
+    if op == "Convolution":
+        kernel = a("kernel")
+        add_node("Conv", ins, outs, name, kernel_shape=kernel,
+                 strides=a("stride") or (1,) * len(kernel),
+                 pads=tuple(a("pad") or (0,) * len(kernel)) * 2,
+                 dilations=a("dilate") or (1,) * len(kernel),
+                 group=a("num_group", 1))
+    elif op == "Deconvolution":
+        kernel = a("kernel")
+        add_node("ConvTranspose", ins, outs, name, kernel_shape=kernel,
+                 strides=a("stride") or (1,) * len(kernel),
+                 pads=tuple(a("pad") or (0,) * len(kernel)) * 2,
+                 dilations=a("dilate") or (1,) * len(kernel),
+                 group=a("num_group", 1))
+    elif op == "FullyConnected":
+        if not a("flatten", True):
+            # per-last-dim projection (N, ..., D) @ W.T: Gemm would flatten,
+            # so emit Transpose(W) + MatMul (+ broadcast Add bias)
+            wt = outs[0] + "_wT"
+            add_node("Transpose", [ins[1]], [wt], name + "_wT",
+                     perm=(1, 0))
+            mm_out = outs if len(ins) < 3 else [outs[0] + "_mm"]
+            add_node("MatMul", [ins[0], wt], mm_out, name + "_mm")
+            if len(ins) > 2:
+                add_node("Add", [mm_out[0], ins[2]], outs, name)
+            return
+        flat = outs[0] + "_flat"
+        add_node("Flatten", ins[:1], [flat], name + "_flatten", axis=1)
+        gemm_in = [flat, ins[1]]
+        if len(ins) > 2:
+            gemm_in.append(ins[2])
+        else:
+            gemm_in.append(add_const(name + "_zero_bias",
+                                     np.zeros((a("num_hidden"),),
+                                              np.float32)))
+        add_node("Gemm", gemm_in, outs, name, transB=1)
+    elif op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus", "softsign": "Softsign"}[a("act_type")]
+        add_node(act, ins, outs, name)
+    elif op in ("relu", "sigmoid", "tanh"):
+        add_node(op.capitalize(), ins, outs, name)
+    elif op == "LeakyReLU":
+        act = a("act_type", "leaky")
+        if act == "leaky":
+            add_node("LeakyRelu", ins, outs, name, alpha=a("slope", 0.25))
+        elif act == "elu":
+            add_node("Elu", ins, outs, name, alpha=a("slope", 0.25))
+        elif act == "prelu":
+            add_node("PRelu", ins, outs, name)
+        elif act == "selu":
+            add_node("Selu", ins, outs, name)
+        else:
+            raise MXNetError("cannot export LeakyReLU act_type %r" % act)
+    elif op == "Pooling":
+        kind = a("pool_type", "max")
+        if a("global_pool", False):
+            add_node({"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}
+                     [kind], ins, outs, name)
+        else:
+            kernel = a("kernel")
+            add_node({"max": "MaxPool", "avg": "AveragePool"}[kind],
+                     ins, outs, name, kernel_shape=kernel,
+                     strides=a("stride") or (1,) * len(kernel),
+                     pads=tuple(a("pad") or (0,) * len(kernel)) * 2)
+    elif op == "BatchNorm":
+        if len(outs) > 1:
+            raise MXNetError("cannot export BatchNorm with "
+                             "output_mean_var=True (consumers of the "
+                             "mean/var outputs have no ONNX equivalent)")
+        bn_ins = list(ins)
+        if a("fix_gamma", True):
+            # our op computes with gamma forced to ones; serialize that,
+            # not the stored (possibly nonuniform) gamma initializer
+            if ins[1] not in params:
+                raise MXNetError("cannot export BatchNorm with "
+                                 "fix_gamma=True and non-constant gamma")
+            bn_ins[1] = add_const(name + "_fixed_gamma",
+                                  np.ones_like(params[ins[1]]))
+        add_node("BatchNormalization", bn_ins, outs[:1], name,
+                 epsilon=a("eps", 1e-3), momentum=a("momentum", 0.9))
+    elif op == "InstanceNorm":
+        add_node("InstanceNormalization", ins, outs, name,
+                 epsilon=a("eps", 1e-3))
+    elif op == "LRN":
+        add_node("LRN", ins, outs, name, alpha=a("alpha", 1e-4),
+                 beta=a("beta", 0.75), bias=a("knorm", 2.0),
+                 size=a("nsize", 5))
+    elif op == "Flatten":
+        add_node("Flatten", ins, outs, name, axis=1)
+    elif op == "Reshape":
+        shape = add_const(name + "_shape",
+                          np.asarray(a("shape"), np.int64))
+        add_node("Reshape", [ins[0], shape], outs, name)
+    elif op == "Dropout":
+        if len(outs) > 1:
+            raise MXNetError("cannot export Dropout with a consumed "
+                             "mask output")
+        add_node("Dropout", ins, outs, name, ratio=a("p", 0.5))
+    elif op in ("softmax", "SoftmaxActivation"):
+        add_node("Softmax", ins, outs, name, axis=a("axis", -1))
+    elif op == "log_softmax":
+        add_node("LogSoftmax", ins, outs, name, axis=a("axis", -1))
+    elif op == "SoftmaxOutput":
+        # inference form: softmax over axis 1; label input dropped
+        add_node("Softmax", ins[:1], outs, name, axis=1)
+    elif op in ("Concat", "concat"):
+        add_node("Concat", ins, outs, name, axis=a("dim", 1))
+    elif op in ("elemwise_add", "_plus", "broadcast_add"):
+        add_node("Add", ins, outs, name)
+    elif op in ("elemwise_sub", "_minus", "broadcast_sub"):
+        add_node("Sub", ins, outs, name)
+    elif op in ("elemwise_mul", "_mul", "broadcast_mul"):
+        add_node("Mul", ins, outs, name)
+    elif op in ("elemwise_div", "_div", "broadcast_div"):
+        add_node("Div", ins, outs, name)
+    elif op in ("broadcast_maximum",):
+        add_node("Max", ins, outs, name)
+    elif op in ("broadcast_minimum",):
+        add_node("Min", ins, outs, name)
+    elif op in ("add_n", "ElementWiseSum"):
+        add_node("Sum", ins, outs, name)
+    elif op == "dot":
+        if a("transpose_a", False) or a("transpose_b", False):
+            raise MXNetError("cannot export transposed dot")
+        add_node("MatMul", ins, outs, name)
+    elif op in ("_plus_scalar", "_minus_scalar", "_mul_scalar",
+                "_div_scalar", "_power_scalar"):
+        c = add_const(name + "_scalar",
+                      np.asarray(a("scalar"), np.float32))
+        onnx_op = {"_plus_scalar": "Add", "_minus_scalar": "Sub",
+                   "_mul_scalar": "Mul", "_div_scalar": "Div",
+                   "_power_scalar": "Pow"}[op]
+        add_node(onnx_op, [ins[0], c], outs, name)
+    elif op == "transpose":
+        add_node("Transpose", ins, outs, name, perm=a("axes") or None)
+    elif op == "expand_dims":
+        add_node("Unsqueeze", ins, outs, name, axes=(a("axis"),))
+    elif op == "squeeze":
+        ax = a("axis")
+        add_node("Squeeze", ins, outs, name,
+                 axes=(ax,) if isinstance(ax, int) else ax)
+    elif op == "clip":
+        add_node("Clip", ins, outs, name, min=a("a_min"), max=a("a_max"))
+    elif op == "Pad":
+        pw = a("pad_width")
+        n = len(pw) // 2
+        pads = [int(pw[2 * i]) for i in range(n)] + \
+               [int(pw[2 * i + 1]) for i in range(n)]
+        add_node("Pad", ins, outs, name, mode=a("mode", "constant"),
+                 pads=pads, value=a("constant_value", 0.0))
+    elif op in ("sum", "mean", "max", "min", "prod"):
+        onnx_op = {"sum": "ReduceSum", "mean": "ReduceMean",
+                   "max": "ReduceMax", "min": "ReduceMin",
+                   "prod": "ReduceProd"}[op]
+        ax = a("axis")
+        add_node(onnx_op, ins, outs, name,
+                 axes=(ax,) if isinstance(ax, int) else (ax or None),
+                 keepdims=int(bool(a("keepdims", False))))
+    elif op == "slice_axis":
+        add_node("Slice", ins, outs, name, axes=(a("axis"),),
+                 starts=(a("begin"),),
+                 ends=(2 ** 31 - 1 if a("end") is None else a("end"),))
+    elif op in ("SliceChannel", "split"):
+        add_node("Split", ins, outs, name, axis=a("axis", 1))
+    elif op == "Cast":
+        add_node("Cast", ins, outs, name,
+                 to=_NP_DT[np.dtype(a("dtype"))])
+    elif op == "Embedding":
+        # ONNX Gather(weight, indices): weight is input[1] on our side
+        add_node("Gather", [ins[1], ins[0]], outs, name, axis=0)
+    elif op == "take":
+        add_node("Gather", ins, outs, name, axis=a("axis", 0))
+    elif op == "UpSampling":
+        # opset 9: scales is a required input, not an attribute
+        sc = add_const(name + "_scales",
+                       np.asarray([1.0, 1.0, float(a("scale")),
+                                   float(a("scale"))], np.float32))
+        mode = {"nearest": "nearest",
+                "bilinear": "linear"}[a("sample_type", "nearest")]
+        add_node("Upsample", [ins[0], sc], outs, name, mode=mode)
+    elif op in ("identity", "_copy", "BlockGrad", "stop_gradient"):
+        add_node("Identity", ins, outs, name)
+    elif op in ("negative", "abs", "exp", "log", "sqrt", "floor", "ceil",
+                "reciprocal", "sign"):
+        add_node({"negative": "Neg"}.get(op, op.capitalize()),
+                 ins, outs, name)
+    elif op == "argmax":
+        add_node("ArgMax", ins, outs, name, axis=a("axis", 0),
+                 keepdims=int(bool(a("keepdims", False))))
+    else:
+        raise MXNetError("cannot export op %r to ONNX" % op)
+
+
+def export_model(sym, params, input_shapes, onnx_file=None,
+                 graph_name="mxnet_tpu", opset=9):
+    """Export Symbol + params to an ONNX model.
+
+    ``input_shapes``: dict name->shape, or a single shape tuple when the
+    symbol has exactly one data input.  Returns the serialized bytes; also
+    writes ``onnx_file`` when given.  (Reference analog: the mx2onnx
+    direction of contrib.onnx in later reference versions.)
+    """
+    if not isinstance(input_shapes, dict):
+        args = set(sym.list_arguments()) - set(params)
+        if len(args) != 1:
+            raise MXNetError("pass input_shapes as a dict (inputs: %s)"
+                             % sorted(args))
+        input_shapes = {args.pop(): tuple(input_shapes)}
+    graph = export_graph(sym, params, input_shapes, graph_name)
+    model = P.ModelProto(
+        ir_version=4, producer_name="mxnet_tpu",
+        opset_import=[P.OperatorSetIdProto(domain="", version=opset)],
+        graph=graph)
+    data = model.serialize()
+    if onnx_file:
+        with open(onnx_file, "wb") as f:
+            f.write(data)
+    return data

@@ -1,0 +1,435 @@
+"""Dependency-free ONNX protobuf codec (wire format, schema subset).
+
+The environment ships no ``onnx`` package, so :mod:`mxnet_tpu.contrib.onnx`
+parses and writes ``.onnx`` files with this hand-rolled protobuf codec.  It
+implements the protobuf wire format (varint / 64-bit / length-delimited /
+32-bit fields, packed repeated scalars) plus descriptors for the subset of
+the stable ONNX schema that model import/export needs: ModelProto,
+GraphProto, NodeProto, AttributeProto, TensorProto, ValueInfoProto,
+TypeProto(+Tensor), TensorShapeProto(+Dimension), OperatorSetIdProto,
+StringStringEntryProto.  Field numbers follow onnx/onnx.proto (IR version 3+,
+unchanged since).
+
+Reference analog: the reference's ``contrib/onnx`` relies on the ``onnx``
+package for (de)serialization (``python/mxnet/contrib/onnx/onnx2mx/
+import_model.py``); here the codec is part of the framework so ONNX
+interchange works in hermetic environments.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Tuple
+
+__all__ = [
+    "ModelProto", "GraphProto", "NodeProto", "AttributeProto",
+    "TensorProto", "ValueInfoProto", "TypeProto", "TensorTypeProto",
+    "TensorShapeProto", "Dimension", "OperatorSetIdProto",
+    "StringStringEntryProto", "load", "load_from_bytes", "save",
+]
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    if value < 0:
+        value += 1 << 64
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _to_signed(value: int) -> int:
+    return value - (1 << 64) if value >= (1 << 63) else value
+
+
+# kind -> (wire type, packable)
+_SCALAR_WIRE = {
+    "int": (_WIRE_VARINT, True),
+    "float": (_WIRE_I32, True),
+    "double": (_WIRE_I64, True),
+    "bytes": (_WIRE_LEN, False),
+    "string": (_WIRE_LEN, False),
+}
+
+
+class Message:
+    """Base class: FIELDS maps field number -> (name, kind, repeated).
+
+    kind is 'int' | 'float' | 'double' | 'bytes' | 'string' or a Message
+    subclass.  Presence is tracked for HasField(); repeated fields default to
+    fresh lists, scalars to proto3 defaults, submessages to None.
+    """
+
+    FIELDS: Dict[int, tuple] = {}
+
+    def __init__(self, **kwargs):
+        self._present = set()
+        for name, kind, repeated in self.FIELDS.values():
+            if repeated:
+                object.__setattr__(self, name, [])
+            elif isinstance(kind, type):
+                object.__setattr__(self, name, None)
+            else:
+                object.__setattr__(self, name, _DEFAULTS[kind])
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if not name.startswith("_"):
+            self._present.add(name)
+
+    def HasField(self, name):  # noqa: N802 (protobuf API parity)
+        return name in self._present
+
+    # ---- parsing --------------------------------------------------------
+    @classmethod
+    def parse(cls, data: bytes) -> "Message":
+        msg = cls()
+        pos, end = 0, len(data)
+        while pos < end:
+            key, pos = _read_varint(data, pos)
+            field_num, wire = key >> 3, key & 7
+            spec = cls.FIELDS.get(field_num)
+            if spec is None:
+                pos = _skip(data, pos, wire)
+                continue
+            name, kind, repeated = spec
+            if isinstance(kind, type):
+                if wire != _WIRE_LEN:
+                    raise ValueError("submessage field with wire %d" % wire)
+                ln, pos = _read_varint(data, pos)
+                sub = kind.parse(data[pos:pos + ln])
+                pos += ln
+                if repeated:
+                    getattr(msg, name).append(sub)
+                else:
+                    setattr(msg, name, sub)
+                continue
+            if wire == _WIRE_LEN and kind in ("int", "float", "double"):
+                # packed repeated scalars
+                ln, pos = _read_varint(data, pos)
+                chunk_end = pos + ln
+                vals = getattr(msg, name)
+                while pos < chunk_end:
+                    v, pos = _read_scalar(data, pos, kind)
+                    vals.append(v)
+                msg._present.add(name)
+                continue
+            v, pos = _read_scalar(data, pos, kind) if wire != _WIRE_LEN \
+                else _read_len_delimited(data, pos, kind)
+            if repeated:
+                getattr(msg, name).append(v)
+                msg._present.add(name)
+            else:
+                setattr(msg, name, v)
+        return msg
+
+    # ---- serialization --------------------------------------------------
+    def serialize(self) -> bytes:
+        out = bytearray()
+        for field_num in sorted(self.FIELDS):
+            name, kind, repeated = self.FIELDS[field_num]
+            value = getattr(self, name)
+            if isinstance(kind, type):
+                subs = value if repeated else \
+                    ([value] if value is not None else [])
+                for sub in subs:
+                    body = sub.serialize()
+                    _write_varint(out, (field_num << 3) | _WIRE_LEN)
+                    _write_varint(out, len(body))
+                    out += body
+                continue
+            wire, packable = _SCALAR_WIRE[kind]
+            if repeated:
+                if not value:
+                    continue
+                if packable:
+                    body = bytearray()
+                    for v in value:
+                        _write_scalar(body, v, kind)
+                    _write_varint(out, (field_num << 3) | _WIRE_LEN)
+                    _write_varint(out, len(body))
+                    out += body
+                else:
+                    for v in value:
+                        _write_field(out, field_num, v, kind, wire)
+                continue
+            if name not in self._present and not value:
+                continue  # proto3: defaults are omitted
+            _write_field(out, field_num, value, kind, wire)
+        return bytes(out)
+
+    def __repr__(self):
+        items = ", ".join("%s=%r" % (n, getattr(self, n))
+                          for n, _, _ in self.FIELDS.values()
+                          if n in self._present)
+        return "%s(%s)" % (type(self).__name__, items)
+
+
+_DEFAULTS = {"int": 0, "float": 0.0, "double": 0.0, "bytes": b"",
+             "string": ""}
+
+
+def _skip(data: bytes, pos: int, wire: int) -> int:
+    if wire == _WIRE_VARINT:
+        _, pos = _read_varint(data, pos)
+        return pos
+    if wire == _WIRE_I64:
+        return pos + 8
+    if wire == _WIRE_LEN:
+        ln, pos = _read_varint(data, pos)
+        return pos + ln
+    if wire == _WIRE_I32:
+        return pos + 4
+    raise ValueError("unsupported wire type %d" % wire)
+
+
+def _read_scalar(data: bytes, pos: int, kind: str) -> Tuple[object, int]:
+    if kind == "int":
+        v, pos = _read_varint(data, pos)
+        return _to_signed(v), pos
+    if kind == "float":
+        return struct.unpack_from("<f", data, pos)[0], pos + 4
+    if kind == "double":
+        return struct.unpack_from("<d", data, pos)[0], pos + 8
+    raise ValueError("scalar kind %r with non-len wire" % kind)
+
+
+def _read_len_delimited(data: bytes, pos: int, kind: str):
+    ln, pos = _read_varint(data, pos)
+    raw = data[pos:pos + ln]
+    pos += ln
+    if kind == "string":
+        return raw.decode("utf-8", "surrogateescape"), pos
+    if kind == "bytes":
+        return raw, pos
+    raise ValueError("unexpected len-delimited for kind %r" % kind)
+
+
+def _write_scalar(out: bytearray, value, kind: str) -> None:
+    if kind == "int":
+        _write_varint(out, int(value))
+    elif kind == "float":
+        out += struct.pack("<f", float(value))
+    elif kind == "double":
+        out += struct.pack("<d", float(value))
+    else:
+        raise ValueError(kind)
+
+
+def _write_field(out: bytearray, num: int, value, kind: str,
+                 wire: int) -> None:
+    _write_varint(out, (num << 3) | wire)
+    if kind in ("int", "float", "double"):
+        _write_scalar(out, value, kind)
+    elif kind == "string":
+        raw = value.encode("utf-8", "surrogateescape")
+        _write_varint(out, len(raw))
+        out += raw
+    elif kind == "bytes":
+        raw = bytes(value)
+        _write_varint(out, len(raw))
+        out += raw
+    else:
+        raise ValueError(kind)
+
+
+# ---------------------------------------------------------------------------
+# ONNX schema descriptors (field numbers from onnx/onnx.proto)
+# ---------------------------------------------------------------------------
+
+class StringStringEntryProto(Message):
+    pass
+
+
+StringStringEntryProto.FIELDS = {
+    1: ("key", "string", False),
+    2: ("value", "string", False),
+}
+
+
+class OperatorSetIdProto(Message):
+    pass
+
+
+OperatorSetIdProto.FIELDS = {
+    1: ("domain", "string", False),
+    2: ("version", "int", False),
+}
+
+
+class TensorProto(Message):
+    # DataType enum values used by the converter
+    FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = \
+        1, 2, 3, 6, 7, 9, 10, 11
+
+
+TensorProto.FIELDS = {
+    1: ("dims", "int", True),
+    2: ("data_type", "int", False),
+    4: ("float_data", "float", True),
+    5: ("int32_data", "int", True),
+    6: ("string_data", "bytes", True),
+    7: ("int64_data", "int", True),
+    8: ("name", "string", False),
+    9: ("raw_data", "bytes", False),
+    10: ("double_data", "double", True),
+    11: ("uint64_data", "int", True),
+    12: ("doc_string", "string", False),
+}
+
+
+class Dimension(Message):
+    pass
+
+
+Dimension.FIELDS = {
+    1: ("dim_value", "int", False),
+    2: ("dim_param", "string", False),
+}
+
+
+class TensorShapeProto(Message):
+    pass
+
+
+TensorShapeProto.FIELDS = {
+    1: ("dim", Dimension, True),
+}
+
+
+class TensorTypeProto(Message):
+    pass
+
+
+TensorTypeProto.FIELDS = {
+    1: ("elem_type", "int", False),
+    2: ("shape", TensorShapeProto, False),
+}
+
+
+class TypeProto(Message):
+    pass
+
+
+TypeProto.FIELDS = {
+    1: ("tensor_type", TensorTypeProto, False),
+}
+
+
+class ValueInfoProto(Message):
+    pass
+
+
+ValueInfoProto.FIELDS = {
+    1: ("name", "string", False),
+    2: ("type", TypeProto, False),
+    3: ("doc_string", "string", False),
+}
+
+
+class GraphProto(Message):
+    pass
+
+
+class AttributeProto(Message):
+    # AttributeType enum
+    FLOAT, INT, STRING, TENSOR, GRAPH = 1, 2, 3, 4, 5
+    FLOATS, INTS, STRINGS, TENSORS, GRAPHS = 6, 7, 8, 9, 10
+
+
+AttributeProto.FIELDS = {
+    1: ("name", "string", False),
+    2: ("f", "float", False),
+    3: ("i", "int", False),
+    4: ("s", "bytes", False),
+    5: ("t", TensorProto, False),
+    # 6: subgraph attr (control flow) — parsed generically if ever present
+    7: ("floats", "float", True),
+    8: ("ints", "int", True),
+    9: ("strings", "bytes", True),
+    10: ("tensors", TensorProto, True),
+    13: ("doc_string", "string", False),
+    20: ("type", "int", False),
+}
+
+
+class NodeProto(Message):
+    pass
+
+
+NodeProto.FIELDS = {
+    1: ("input", "string", True),
+    2: ("output", "string", True),
+    3: ("name", "string", False),
+    4: ("op_type", "string", False),
+    5: ("attribute", AttributeProto, True),
+    6: ("doc_string", "string", False),
+    7: ("domain", "string", False),
+}
+
+
+GraphProto.FIELDS = {
+    1: ("node", NodeProto, True),
+    2: ("name", "string", False),
+    5: ("initializer", TensorProto, True),
+    10: ("doc_string", "string", False),
+    11: ("input", ValueInfoProto, True),
+    12: ("output", ValueInfoProto, True),
+    13: ("value_info", ValueInfoProto, True),
+}
+
+
+class ModelProto(Message):
+    pass
+
+
+ModelProto.FIELDS = {
+    1: ("ir_version", "int", False),
+    2: ("producer_name", "string", False),
+    3: ("producer_version", "string", False),
+    4: ("domain", "string", False),
+    5: ("model_version", "int", False),
+    6: ("doc_string", "string", False),
+    7: ("graph", GraphProto, False),
+    8: ("opset_import", OperatorSetIdProto, True),
+    14: ("metadata_props", StringStringEntryProto, True),
+}
+
+
+# ---------------------------------------------------------------------------
+# file API (onnx.load / onnx.save parity)
+# ---------------------------------------------------------------------------
+
+def load_from_bytes(data: bytes) -> ModelProto:
+    return ModelProto.parse(data)
+
+
+def load(path) -> ModelProto:
+    with open(path, "rb") as f:
+        return load_from_bytes(f.read())
+
+
+def save(model: ModelProto, path) -> None:
+    with open(path, "wb") as f:
+        f.write(model.serialize())

@@ -19,6 +19,19 @@ _register.populate(globals())
 from .utils import *  # noqa: F401,F403
 
 
+def sparse_retain(data, indices):
+    """Retain rows of a row_sparse array (or mask rows of a dense one).
+
+    Parity: ``mx.nd.sparse_retain`` (ref: src/operator/tensor/
+    sparse_retain.cc:27).  RowSparseNDArray input stays row_sparse; dense
+    input goes through the registered XLA op (rows not in ``indices``
+    zeroed).
+    """
+    if isinstance(data, RowSparseNDArray):
+        return sparse.retain(data, indices)
+    return imperative_invoke("sparse_retain", data, indices)
+
+
 def maximum(lhs, rhs):
     """mx.nd.maximum with scalar/array dispatch (parity: ndarray.py)."""
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):

@@ -312,6 +312,31 @@ def _embedding(attrs, data, weight):
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
+@register("_contrib_SparseEmbedding", nin=2,
+          params={"input_dim": param(int, 0, required=True),
+                  "output_dim": param(int, 0, required=True),
+                  "dtype": param("dtype", "float32")})
+def _sparse_embedding(attrs, data, weight):
+    """Embedding whose weight gradient is row-sparse (ref:
+    src/operator/tensor/indexing_op.cc _contrib_SparseEmbedding).  Compute
+    is the same XLA gather as Embedding; the row-sparse gradient contract
+    is honored by the trainer/kvstore layer (row_sparse_pull of touched
+    rows), which is where TPU sparsity lives."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("sparse_retain", nin=2, aliases=("_sparse_retain",))
+def _sparse_retain_op(attrs, data, indices):
+    """Dense view of sparse_retain: zero every row of ``data`` whose index
+    is not in ``indices`` (ref: src/operator/tensor/sparse_retain.cc:27).
+    For RowSparseNDArray inputs the frontend dispatches to
+    ndarray.sparse.retain, which keeps the result row_sparse."""
+    rows = jnp.arange(data.shape[0])
+    mask = jnp.isin(rows, indices.astype(jnp.int32))
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data,
+                     jnp.zeros_like(data))
+
+
 @register("Cast", nin=1, aliases=("cast",),
           params={"dtype": param("dtype", "float32", required=True)})
 def _cast(attrs, x):

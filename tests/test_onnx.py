@@ -1,0 +1,368 @@
+"""ONNX interchange: proto codec, export -> file -> import round trips.
+
+Parity model: reference tests/python-pytest/onnx (onnx_import/export round
+trips over real .onnx files) — here exercised with the self-contained
+protobuf codec (mxnet_tpu/contrib/onnx_proto.py), so real serialized bytes
+cross the boundary, not in-memory mocks.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.contrib import onnx as onnx_mx
+from mxnet_tpu.contrib import onnx_proto as P
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_proto_scalar_roundtrip():
+    t = P.TensorProto(name="w", dims=[2, 3], data_type=P.TensorProto.FLOAT,
+                      raw_data=np.arange(6, dtype=np.float32).tobytes())
+    t2 = P.TensorProto.parse(t.serialize())
+    assert t2.name == "w"
+    assert list(t2.dims) == [2, 3]
+    assert t2.data_type == 1
+    np.testing.assert_array_equal(
+        np.frombuffer(t2.raw_data, np.float32),
+        np.arange(6, dtype=np.float32))
+
+
+def test_proto_negative_and_packed_ints():
+    a = P.AttributeProto(name="axis", i=-1, type=P.AttributeProto.INT)
+    a2 = P.AttributeProto.parse(a.serialize())
+    assert a2.i == -1
+    a = P.AttributeProto(name="axes", ints=[0, -2, 5],
+                         type=P.AttributeProto.INTS)
+    a2 = P.AttributeProto.parse(a.serialize())
+    assert list(a2.ints) == [0, -2, 5]
+
+
+def test_proto_nested_model_roundtrip():
+    node = P.NodeProto(op_type="Relu", input=["x"], output=["y"], name="r")
+    g = P.GraphProto(name="g", node=[node],
+                     input=[onnx_mx._vi("x", (1, 3))],
+                     output=[onnx_mx._vi("y", (1, 3))])
+    m = P.ModelProto(ir_version=4, producer_name="mxnet_tpu", graph=g,
+                     opset_import=[P.OperatorSetIdProto(version=9)])
+    m2 = P.ModelProto.parse(m.serialize())
+    assert m2.ir_version == 4
+    assert m2.opset_import[0].version == 9
+    assert m2.graph.node[0].op_type == "Relu"
+    assert m2.graph.input[0].type.tensor_type.shape.dim[1].dim_value == 3
+    # unknown fields must be skipped, not fatal: append a field we don't
+    # know (number 15, varint)
+    raw = m.serialize() + bytes([(15 << 3) | 0, 7])
+    m3 = P.ModelProto.parse(raw)
+    assert m3.graph.node[0].op_type == "Relu"
+
+
+# ---------------------------------------------------------------------------
+# export -> import round trips (forward match)
+# ---------------------------------------------------------------------------
+
+def _random_params(sym, **input_shapes):
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, shp in zip(sym.list_arguments(), arg_shapes):
+        if name in input_shapes:
+            continue
+        params[name] = (rng.uniform(-0.5, 0.5, size=shp)
+                        .astype(np.float32))
+    auxs = {}
+    for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
+        if name.endswith("moving_var"):
+            auxs[name] = np.abs(rng.uniform(0.5, 1.5, size=shp)) \
+                .astype(np.float32)
+        else:
+            auxs[name] = rng.uniform(-0.1, 0.1, size=shp) \
+                .astype(np.float32)
+    return params, auxs
+
+
+def _forward(sym, params, auxs, data):
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=data.shape)
+    ex.copy_params_from({k: nd.array(v) for k, v in params.items()},
+                        {k: nd.array(v) for k, v in auxs.items()})
+    return ex.forward(is_train=False, data=nd.array(data))[0].asnumpy()
+
+
+def _roundtrip(sym, data_shape, tmp_path, atol=1e-4):
+    params, auxs = _random_params(sym, data=data_shape)
+    rng = np.random.RandomState(1)
+    data = rng.uniform(-1, 1, size=data_shape).astype(np.float32)
+    ref = _forward(sym, params, auxs, data)
+
+    all_params = dict(params)
+    all_params.update(auxs)
+    path = str(tmp_path / "model.onnx")
+    onnx_mx.export_model(sym, all_params, {"data": data_shape},
+                         onnx_file=path)
+
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    got = _forward(sym2,
+                   {k: v.asnumpy() for k, v in args2.items()},
+                   {k: v.asnumpy() for k, v in auxs2.items()}, data)
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=atol)
+    return path
+
+
+def _lenet():
+    S = mx.symbol
+    x = S.var("data")
+    c1 = S.Convolution(x, kernel=(5, 5), num_filter=8, name="c1")
+    a1 = S.Activation(c1, act_type="tanh", name="a1")
+    p1 = S.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                   name="p1")
+    c2 = S.Convolution(p1, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                       name="c2")
+    a2 = S.Activation(c2, act_type="relu", name="a2")
+    p2 = S.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="avg",
+                   name="p2")
+    f = S.Flatten(p2, name="flat")
+    fc1 = S.FullyConnected(f, num_hidden=32, name="fc1")
+    d = S.Dropout(fc1, p=0.5, name="drop")
+    fc2 = S.FullyConnected(d, num_hidden=10, name="fc2")
+    return S.softmax(fc2, axis=1, name="out")
+
+
+def _mini_resnet():
+    S = mx.symbol
+    x = S.var("data")
+    c0 = S.Convolution(x, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                       no_bias=True, name="c0")
+    b0 = S.BatchNorm(c0, fix_gamma=False, name="b0")
+    r0 = S.Activation(b0, act_type="relu", name="r0")
+    # residual block
+    c1 = S.Convolution(r0, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                       no_bias=True, name="c1")
+    b1 = S.BatchNorm(c1, fix_gamma=False, name="b1")
+    r1 = S.Activation(b1, act_type="relu", name="r1")
+    c2 = S.Convolution(r1, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                       no_bias=True, name="c2")
+    b2 = S.BatchNorm(c2, fix_gamma=False, name="b2")
+    s = S.elemwise_add(b2, r0, name="res")
+    r2 = S.Activation(s, act_type="relu", name="r2")
+    g = S.Pooling(r2, global_pool=True, kernel=(1, 1), pool_type="avg",
+                  name="gpool")
+    f = S.Flatten(g, name="flat")
+    fc = S.FullyConnected(f, num_hidden=10, name="fc")
+    return S.softmax(fc, axis=1, name="out")
+
+
+def test_lenet_roundtrip(tmp_path):
+    _roundtrip(_lenet(), (2, 1, 28, 28), tmp_path)
+
+
+def test_mini_resnet_roundtrip(tmp_path):
+    path = _roundtrip(_mini_resnet(), (2, 3, 16, 16), tmp_path)
+    meta = onnx_mx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 3, 16, 16))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_model_zoo_resnet18_roundtrip(tmp_path):
+    """Export/import a real model-zoo topology (resnet18_v1)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1()
+    net.initialize()
+    data_shape = (1, 3, 32, 32)
+    x = nd.array(np.random.RandomState(2)
+                 .uniform(-1, 1, data_shape).astype(np.float32))
+    net(x)  # materialize deferred params
+    sym = net(mx.symbol.var("data"))
+    params = {}
+    for name, p in net.collect_params().items():
+        params[name] = p.data().asnumpy()
+    ref = net(x).asnumpy()
+
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mx.export_model(sym, params, {"data": data_shape},
+                         onnx_file=path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    got = _forward(sym2,
+                   {k: v.asnumpy() for k, v in args2.items()},
+                   {k: v.asnumpy() for k, v in auxs2.items()},
+                   x.asnumpy())
+    np.testing.assert_allclose(ref, got, rtol=1e-3, atol=1e-3)
+
+
+def test_misc_op_roundtrip(tmp_path):
+    """Elementwise/reshape/transpose/concat/reduce/clip export+import."""
+    S = mx.symbol
+    x = S.var("data")
+    t = S.transpose(x, axes=(0, 2, 1))
+    r = S.Reshape(t, shape=(0, -1))
+    c = S.concat(r, r, dim=1)
+    cl = S.clip(c, a_min=-0.5, a_max=0.5)
+    m = S.mean(cl, axis=1, keepdims=True)
+    out = S.broadcast_add(cl, m) * 2.0
+    sym = S.exp(S.negative(out))
+    _roundtrip(sym, (2, 3, 4), tmp_path)
+
+
+def test_embedding_gather_roundtrip(tmp_path):
+    S = mx.symbol
+    x = S.var("data")
+    e = S.Embedding(x, input_dim=11, output_dim=5, name="emb")
+    sym = S.sum(e, axis=-1)
+    params = {"emb_weight":
+              np.random.RandomState(3).randn(11, 5).astype(np.float32)}
+    data = np.array([[1, 2], [10, 0]], np.float32)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 2))
+    ex.copy_params_from({k: nd.array(v) for k, v in params.items()}, {})
+    ref = ex.forward(is_train=False, data=nd.array(data))[0].asnumpy()
+
+    path = str(tmp_path / "emb.onnx")
+    onnx_mx.export_model(sym, params, {"data": (2, 2)}, onnx_file=path)
+    sym2, args2, _ = onnx_mx.import_model(path)
+    ex2 = sym2.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 2))
+    ex2.copy_params_from(args2, {})
+    got = ex2.forward(is_train=False, data=nd.array(data))[0].asnumpy()
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_import_unsupported_op_message(tmp_path):
+    g = P.GraphProto(name="g", node=[
+        P.NodeProto(op_type="NoSuchOp", input=["x"], output=["y"])],
+        input=[onnx_mx._vi("x", (1,))],
+        output=[onnx_mx._vi("y", (1,))])
+    with pytest.raises(mx.base.MXNetError, match="NoSuchOp"):
+        onnx_mx.import_graph(g)
+
+
+def test_fc_no_flatten_roundtrip(tmp_path):
+    """FullyConnected(flatten=False) must export as MatMul, not Gemm."""
+    S = mx.symbol
+    x = S.var("data")
+    sym = S.FullyConnected(x, num_hidden=6, flatten=False, name="proj")
+    _roundtrip(sym, (2, 3, 4), tmp_path)
+
+
+def test_upsampling_roundtrip(tmp_path):
+    """Upsample exports scales as an input (opset 9) and reimports."""
+    S = mx.symbol
+    x = S.var("data")
+    sym = S.UpSampling(x, scale=2, sample_type="nearest", num_filter=1,
+                       name="up")
+    _roundtrip(sym, (1, 2, 4, 4), tmp_path)
+    # fractional / unequal scales must raise, not silently truncate
+    g = P.GraphProto(name="g", node=[
+        P.NodeProto(op_type="Upsample", input=["x"], output=["y"],
+                    attribute=[onnx_mx._attr("scales",
+                                             (1.0, 1.0, 1.5, 1.5))])],
+        input=[onnx_mx._vi("x", (1, 2, 4, 4))],
+        output=[onnx_mx._vi("y", (1, 2, 6, 6))])
+    with pytest.raises(mx.base.MXNetError, match="Upsample"):
+        onnx_mx.import_graph(g)
+
+
+def test_batchnorm_fix_gamma_export(tmp_path):
+    """fix_gamma=True: exported model must behave as gamma==1 even when
+    the stored gamma initializer is not 1."""
+    S = mx.symbol
+    x = S.var("data")
+    sym = S.BatchNorm(x, fix_gamma=True, name="bn")
+    rng = np.random.RandomState(0)
+    params = {"bn_beta": rng.randn(3).astype(np.float32),
+              "bn_gamma": np.full((3,), 7.0, np.float32)}  # ignored
+    auxs = {"bn_moving_mean": rng.randn(3).astype(np.float32),
+            "bn_moving_var": np.abs(rng.randn(3)).astype(np.float32) + .5}
+    data = rng.randn(2, 3, 4, 4).astype(np.float32)
+    ref = _forward(sym, params, auxs, data)
+
+    all_params = dict(params)
+    all_params.update(auxs)
+    path = str(tmp_path / "bn.onnx")
+    onnx_mx.export_model(sym, all_params, {"data": (2, 3, 4, 4)},
+                         onnx_file=path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    got = _forward(sym2,
+                   {k: v.asnumpy() for k, v in args2.items()},
+                   {k: v.asnumpy() for k, v in auxs2.items()}, data)
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-4)
+
+
+def test_gemm_transb0_import():
+    """Gemm with transB=0 (the default many exporters emit) must bind and
+    produce x @ w (+ alpha/beta scaling) — regression: shape mismatch."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="Gemm", input=["x", "w", "b"],
+                          output=["y"], name="gemm",
+                          attribute=[onnx_mx._attr("alpha", 2.0),
+                                     onnx_mx._attr("beta", 0.5)])],
+        initializer=[onnx_mx._np_to_tensor("w", w),
+                     onnx_mx._np_to_tensor("b", b)],
+        input=[onnx_mx._vi("x", (3, 4))],
+        output=[onnx_mx._vi("y", (3, 6))])
+    sym, args, auxs = onnx_mx.import_graph(g)
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(3, 4))
+    ex.copy_params_from(args, auxs)
+    got = ex.forward(is_train=False, x=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(got, 2.0 * (x @ w) + 0.5 * b,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_reduce_axes_as_input():
+    """Opset-13 ReduceSum carries axes as input[1]; must not silently
+    reduce over all axes."""
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="ReduceSum", input=["x", "ax"],
+                          output=["y"])],
+        initializer=[onnx_mx._np_to_tensor(
+            "ax", np.asarray([1], np.int64))],
+        input=[onnx_mx._vi("x", (2, 3))],
+        output=[onnx_mx._vi("y", (2, 1))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 3))
+    ex.copy_params_from(args, {})
+    got = ex.forward(is_train=False,
+                     x=nd.array(np.ones((2, 3), np.float32)))[0].asnumpy()
+    np.testing.assert_allclose(got, np.full((2, 1), 3.0))
+
+
+def test_shared_reshape_initializer():
+    """Two Reshape nodes sharing one shape initializer (deduplicated
+    constants) — regression: second import raised 'dynamic shape'."""
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="Reshape", input=["x", "shp"],
+                          output=["a"]),
+              P.NodeProto(op_type="Reshape", input=["a", "shp"],
+                          output=["y"])],
+        initializer=[onnx_mx._np_to_tensor(
+            "shp", np.asarray([6], np.int64))],
+        input=[onnx_mx._vi("x", (2, 3))],
+        output=[onnx_mx._vi("y", (6,))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 3))
+    ex.copy_params_from(args, {})
+    out = ex.forward(is_train=False,
+                     x=nd.array(np.arange(6, dtype=np.float32)
+                                .reshape(2, 3)))[0].asnumpy()
+    assert out.shape == (6,)
+
+
+def test_export_bn_mean_var_raises():
+    S = mx.symbol
+    x = S.var("data")
+    bn = S.BatchNorm(x, fix_gamma=False, output_mean_var=True, name="bn")
+    sym = mx.symbol.Group([bn[0], bn[1]])
+    with pytest.raises(mx.base.MXNetError, match="output_mean_var"):
+        onnx_mx.export_graph(sym, {"bn_gamma": np.ones((3,), np.float32),
+                                   "bn_beta": np.zeros((3,), np.float32),
+                                   "bn_moving_mean":
+                                       np.zeros((3,), np.float32),
+                                   "bn_moving_var":
+                                       np.ones((3,), np.float32)},
+                             {"data": (2, 3, 4, 4)})

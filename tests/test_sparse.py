@@ -1,5 +1,6 @@
 """Sparse NDArray + ops tests (parity model: tests/python/unittest/
 test_sparse_ndarray.py and test_sparse_operator.py)."""
+import argparse
 import os
 import numpy as np
 import pytest
@@ -239,3 +240,65 @@ def test_kvstore_dense_push_to_sparse_store():
     out = nd.zeros((4, 3))
     kv.pull("w", out=out)
     np.testing.assert_array_equal(out.asnumpy(), dense_g.asnumpy())
+
+
+def test_sparse_retain_op_registered():
+    """sparse_retain / _sparse_retain in the op registry; dense semantics
+    zero non-retained rows (ref: tensor/sparse_retain.cc:27)."""
+    from mxnet_tpu.ops.registry import OPS
+    assert "sparse_retain" in OPS and "_sparse_retain" in OPS
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    out = nd.sparse_retain(data, nd.array([0, 2]))
+    expect = data.asnumpy().copy()
+    expect[[1, 3]] = 0
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+
+
+def test_sparse_retain_row_sparse_dispatch():
+    rsp = sparse.row_sparse_array(
+        (np.ones((3, 2), np.float32) * np.arange(1, 4)[:, None],
+         [1, 4, 6]), shape=(8, 2))
+    out = nd.sparse_retain(rsp, nd.array([4, 6, 7]))
+    assert out.stype == "row_sparse"
+    dense = out.tostype("default").asnumpy()
+    expect = np.zeros((8, 2), np.float32)
+    expect[4] = 2
+    expect[6] = 3
+    np.testing.assert_array_equal(dense, expect)
+
+
+def test_sparse_embedding_op():
+    """_contrib_SparseEmbedding forward matches Embedding; grad w.r.t.
+    weight only touches looked-up rows (row-sparse contract)."""
+    from mxnet_tpu import autograd
+    w = nd.array(np.random.RandomState(0).randn(10, 4).astype(np.float32))
+    w.attach_grad()
+    idx = nd.array([[1, 3], [3, 7]])
+    with autograd.record():
+        out = nd._contrib_SparseEmbedding(idx, w, input_dim=10,
+                                          output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    ref = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy())
+    g = w.grad.asnumpy()
+    touched = sorted(set([1, 3, 7]))
+    untouched = [i for i in range(10) if i not in touched]
+    assert np.all(g[untouched] == 0)
+    assert np.all(g[touched] != 0)
+
+
+def test_wide_deep_example_converges():
+    """example/sparse/wide_deep.py end-to-end (BASELINE config #5)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "example", "sparse", "wide_deep.py")
+    spec = importlib.util.spec_from_file_location("wide_deep_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    args = argparse.Namespace(
+        num_samples=256, wide_dim=500, nnz=10, num_cats=3, vocab=50,
+        embed_dim=4, hidden=16, batch_size=64, epochs=6, lr=0.1,
+        kv_store="local")
+    acc = mod.train(args)
+    assert acc > 0.9, "wide&deep failed to fit synthetic data: %.3f" % acc
