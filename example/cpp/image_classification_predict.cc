@@ -1,0 +1,117 @@
+// C++ inference demo over the C predict ABI (reference parity:
+// example/image-classification/predict-cpp/image-classification-predict.cc
+// and the header-only cpp-package frontend, both of which consume
+// include/mxnet/c_predict_api.h).
+//
+// Usage:
+//   make            # builds ../../src predict library + this binary
+//   ./image_classification_predict model-symbol.json model.params.npz
+//       1 3 224 224 < image.f32   (raw float32 NCHW pixels on stdin)
+//
+// Prints the top-5 (class index, probability) pairs.  Any checkpoint saved
+// by mxnet_tpu.model.save_checkpoint / Symbol.save + nd.save works.
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <numeric>
+#include <sstream>
+#include <string>
+#include <vector>
+
+typedef uint32_t mx_uint;
+typedef void *PredictorHandle;
+
+extern "C" {
+const char *MXGetLastError();
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+}
+
+namespace {
+
+std::string ReadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", path.c_str());
+    std::exit(2);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+void Check(int rc, const char *what) {
+  if (rc != 0) {
+    std::fprintf(stderr, "%s failed: %s\n", what, MXGetLastError());
+    std::exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 7) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json params N C H W < input.f32\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string symbol_json = ReadFile(argv[1]);
+  const std::string params = ReadFile(argv[2]);
+  mx_uint shape[4];
+  for (int i = 0; i < 4; ++i) {
+    shape[i] = static_cast<mx_uint>(std::atoi(argv[3 + i]));
+  }
+  const mx_uint indptr[2] = {0, 4};
+  const char *keys[1] = {"data"};
+
+  PredictorHandle pred = nullptr;
+  Check(MXPredCreate(symbol_json.c_str(), params.data(),
+                     static_cast<int>(params.size()), /*dev_type=*/1,
+                     /*dev_id=*/0, 1, keys, indptr, shape, &pred),
+        "MXPredCreate");
+
+  const mx_uint n = shape[0] * shape[1] * shape[2] * shape[3];
+  std::vector<float> input(n);
+  if (std::fread(input.data(), sizeof(float), n, stdin) != n) {
+    std::fprintf(stderr, "expected %u float32 values on stdin\n", n);
+    return 2;
+  }
+  Check(MXPredSetInput(pred, "data", input.data(), n), "MXPredSetInput");
+  Check(MXPredForward(pred), "MXPredForward");
+
+  mx_uint *oshape = nullptr, ondim = 0;
+  Check(MXPredGetOutputShape(pred, 0, &oshape, &ondim),
+        "MXPredGetOutputShape");
+  mx_uint osize = 1;
+  for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+  std::vector<float> probs(osize);
+  Check(MXPredGetOutput(pred, 0, probs.data(), osize), "MXPredGetOutput");
+
+  const mx_uint classes = ondim >= 2 ? oshape[ondim - 1] : osize;
+  std::vector<int> order(classes);
+  std::iota(order.begin(), order.end(), 0);
+  std::partial_sort(order.begin(),
+                    order.begin() + std::min<mx_uint>(5, classes),
+                    order.end(), [&](int a, int b) {
+                      return probs[a] > probs[b];
+                    });
+  for (mx_uint i = 0; i < std::min<mx_uint>(5, classes); ++i) {
+    std::printf("class %d  p=%.4f\n", order[i], probs[order[i]]);
+  }
+  Check(MXPredFree(pred), "MXPredFree");
+  return 0;
+}

@@ -223,8 +223,11 @@ def import_graph(graph):
 
     def pad_op(node):
         attrs = _attrs_of(node)
+        value = attrs.get("value", 0.0)
         if len(node.input) > 1:
             pads = tuple(int(x) for x in const_input(node, 1, "pads"))
+            if len(node.input) > 2 and node.input[2]:
+                value = float(const_input(node, 2, "constant_value"))
         else:
             pads = attrs.get("pads", attrs.get("paddings"))
         n = len(pads) // 2
@@ -235,7 +238,7 @@ def import_graph(graph):
         mode = {"constant": "constant", "edge": "edge",
                 "reflect": "reflect"}[attrs.get("mode", "constant")]
         return S.Pad(env[node.input[0]], mode=mode, pad_width=tuple(pw),
-                     constant_value=attrs.get("value", 0.0))
+                     constant_value=value)
 
     def slice_op(node):
         attrs = _attrs_of(node)
@@ -539,11 +542,13 @@ def _np_to_tensor(name: str, arr: np.ndarray) -> P.TensorProto:
 
 
 def _vi(name: str, shape, elem_type=1) -> P.ValueInfoProto:
-    dims = [P.Dimension(dim_value=int(d)) for d in shape]
-    return P.ValueInfoProto(name=name, type=P.TypeProto(
-        tensor_type=P.TensorTypeProto(
-            elem_type=elem_type,
-            shape=P.TensorShapeProto(dim=dims))))
+    """ValueInfoProto; shape=None means unknown rank (no TensorShapeProto —
+    an *empty* shape would declare a scalar in ONNX semantics)."""
+    tt = P.TensorTypeProto(elem_type=elem_type)
+    if shape is not None:
+        tt.shape = P.TensorShapeProto(
+            dim=[P.Dimension(dim_value=int(d)) for d in shape])
+    return P.ValueInfoProto(name=name, type=P.TypeProto(tensor_type=tt))
 
 
 def _attr(name, value):
@@ -624,7 +629,7 @@ def export_graph(sym, params, input_shapes, graph_name="mxnet_tpu"):
                     params)
         names[id(node)] = outs
 
-    out_vis = [_vi(n, ()) for n in
+    out_vis = [_vi(n, None) for n in
                [names[id(node)][i] for node, i in sym._outputs]]
     return P.GraphProto(name=graph_name, node=nodes,
                         initializer=initializers,

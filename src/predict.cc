@@ -1,0 +1,453 @@
+// C predict ABI (reference parity: include/mxnet/c_predict_api.h:78-200,
+// src/c_api/c_predict_api.cc — SURVEY.md N18).
+//
+// The reference exposes a minimal inference-only C surface —
+// MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput — which is
+// the waist every non-Python binding and the mobile amalgamation ride.  The
+// TPU-native runtime's executor is the Python-built XLA plan, so this ABI
+// embeds CPython (the official stable embedding API, no numpy headers
+// needed) and drives mxnet_tpu.predictor.Predictor.  From the caller's
+// side the contract is identical to the reference: flat float32 buffers in,
+// flat float32 buffers out, thread-local error strings via MXGetLastError.
+//
+// Build: make libmxnet_tpu_predict.so (links libpython).  Host processes
+// must have mxnet_tpu importable (PYTHONPATH or installed).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef uint32_t mx_uint;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+#define MXNET_DLL extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string &msg) { g_last_error = msg; }
+
+// Capture the pending Python exception into the error string.
+void SetPyError(const char *fallback) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = fallback;
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *utf8 = PyUnicode_AsUTF8(s);
+      if (utf8 != nullptr) msg = utf8;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  SetError(msg);
+}
+
+// One-time interpreter bring-up.  When the host process already runs
+// Python (e.g. tests loading this .so via ctypes) we piggyback on it.
+bool EnsurePython() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      PyConfig config;
+      PyConfig_InitPythonConfig(&config);
+      Py_InitializeFromConfig(&config);
+      PyConfig_Clear(&config);
+      // Release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any caller thread.
+      PyEval_SaveThread();
+    }
+    ok = true;
+  });
+  return ok;
+}
+
+struct GILGuard {
+  PyGILState_STATE state;
+  GILGuard() : state(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state); }
+};
+
+struct Predictor {
+  PyObject *obj = nullptr;                       // mxnet_tpu Predictor
+  std::map<std::string, std::vector<mx_uint>> input_shapes;
+  std::vector<mx_uint> shape_scratch;            // MXPredGetOutputShape
+  ~Predictor() {
+    if (obj != nullptr) {
+      GILGuard gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+struct NDList {
+  PyObject *dict = nullptr;                      // {name: NDArray}
+  std::vector<std::string> keys;
+  std::vector<mx_uint> shape_scratch;
+  std::vector<float> data_scratch;
+  ~NDList() {
+    if (dict != nullptr) {
+      GILGuard gil;
+      Py_DECREF(dict);
+    }
+  }
+};
+
+// Fill pred->input_shapes and return a new {key: shape tuple} dict.
+PyObject *BuildShapesDict(
+    std::map<std::string, std::vector<mx_uint>> *input_shapes,
+    mx_uint num_input_nodes, const char **input_keys,
+    const mx_uint *input_shape_indptr, const mx_uint *input_shape_data) {
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    std::vector<mx_uint> shape(input_shape_data + input_shape_indptr[i],
+                               input_shape_data + input_shape_indptr[i + 1]);
+    (*input_shapes)[input_keys[i]] = shape;
+    PyObject *tup = PyTuple_New(shape.size());
+    for (size_t d = 0; d < shape.size(); ++d) {
+      PyTuple_SET_ITEM(tup, d, PyLong_FromUnsignedLong(shape[d]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+  return shapes;
+}
+
+// Read obj.shape (a tuple of ints) into *shape without touching the data.
+bool ShapeOf(PyObject *obj, std::vector<mx_uint> *shape) {
+  PyObject *shp = PyObject_GetAttrString(obj, "shape");
+  if (shp == nullptr) return false;
+  PyObject *seq = PySequence_Tuple(shp);
+  Py_DECREF(shp);
+  if (seq == nullptr) return false;
+  shape->clear();
+  Py_ssize_t n = PyTuple_Size(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape->push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(seq, i))));
+  }
+  Py_DECREF(seq);
+  return !PyErr_Occurred();
+}
+
+// steal-nothing helper: import module attr, new reference.
+PyObject *GetAttr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (mod == nullptr) return nullptr;
+  PyObject *a = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return a;
+}
+
+// flat float32 buffer -> numpy array of `shape` (copy).
+PyObject *BufferToNumpy(const float *data, size_t size,
+                        const std::vector<mx_uint> &shape) {
+  PyObject *np_frombuffer = GetAttr("numpy", "frombuffer");
+  if (np_frombuffer == nullptr) return nullptr;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *arr = PyObject_CallFunction(np_frombuffer, "Os", bytes,
+                                        "float32");
+  Py_DECREF(bytes);
+  Py_DECREF(np_frombuffer);
+  if (arr == nullptr) return nullptr;
+  PyObject *shape_tuple = PyTuple_New(shape.size());
+  for (size_t i = 0; i < shape.size(); ++i) {
+    PyTuple_SET_ITEM(shape_tuple, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject *reshaped =
+      PyObject_CallMethod(arr, "reshape", "O", shape_tuple);
+  Py_DECREF(shape_tuple);
+  Py_DECREF(arr);
+  return reshaped;
+}
+
+// any array-like -> flat float32 std::vector (via .asnumpy() if present).
+bool NumpyToBuffer(PyObject *arr, std::vector<float> *out,
+                   std::vector<mx_uint> *shape) {
+  PyObject *np = arr;
+  if (PyObject_HasAttrString(arr, "asnumpy")) {
+    np = PyObject_CallMethod(arr, "asnumpy", nullptr);
+    if (np == nullptr) return false;
+  } else {
+    Py_INCREF(np);
+  }
+  PyObject *np32 = PyObject_CallMethod(np, "astype", "s", "float32");
+  Py_DECREF(np);
+  if (np32 == nullptr) return false;
+  if (shape != nullptr) {
+    shape->clear();
+    PyObject *shp = PyObject_GetAttrString(np32, "shape");
+    if (shp == nullptr) {
+      Py_DECREF(np32);
+      return false;
+    }
+    Py_ssize_t n = PyTuple_Size(shp);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      shape->push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i))));
+    }
+    Py_DECREF(shp);
+  }
+  PyObject *bytes = PyObject_CallMethod(np32, "tobytes", nullptr);
+  Py_DECREF(np32);
+  if (bytes == nullptr) return false;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  out->resize(static_cast<size_t>(len) / sizeof(float));
+  std::memcpy(out->data(), buf, static_cast<size_t>(len));
+  Py_DECREF(bytes);
+  return true;
+}
+
+}  // namespace
+
+MXNET_DLL const char *MXGetLastError() { return g_last_error.c_str(); }
+
+// Create a predictor from symbol JSON + parameter blob + input shapes.
+// dev_type follows the reference enum (1 = cpu, 2 = gpu; this runtime also
+// accepts 4 = tpu and maps 2 -> the default accelerator context).
+MXNET_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out) {
+  if (!EnsurePython()) {
+    SetError("failed to initialize embedded Python");
+    return -1;
+  }
+  GILGuard gil;
+  auto *pred = new Predictor();
+  PyObject *shapes =
+      BuildShapesDict(&pred->input_shapes, num_input_nodes, input_keys,
+                      input_shape_indptr, input_shape_data);
+  PyObject *cls = GetAttr("mxnet_tpu.predictor", "Predictor");
+  if (cls == nullptr) {
+    SetPyError("cannot import mxnet_tpu.predictor (is mxnet_tpu on "
+               "PYTHONPATH?)");
+    Py_DECREF(shapes);
+    delete pred;
+    return -1;
+  }
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  const char *dev_str = dev_type == 1 ? "cpu" : dev_type == 4 ? "tpu"
+                                                              : "gpu";
+  PyObject *kwargs = Py_BuildValue("{s:s, s:i, s:O}", "dev_type", dev_str,
+                                   "dev_id", dev_id, "input_shapes",
+                                   shapes);
+  PyObject *args = Py_BuildValue("(sO)", symbol_json_str, params);
+  pred->obj = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  Py_DECREF(cls);
+  if (pred->obj == nullptr) {
+    SetPyError("MXPredCreate failed");
+    delete pred;
+    return -1;
+  }
+  *out = pred;
+  return 0;
+}
+
+MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const float *data, mx_uint size) {
+  auto *pred = static_cast<Predictor *>(handle);
+  GILGuard gil;
+  auto it = pred->input_shapes.find(key);
+  if (it == pred->input_shapes.end()) {
+    SetError(std::string("unknown input key: ") + key);
+    return -1;
+  }
+  size_t expect = 1;
+  for (mx_uint d : it->second) expect *= d;
+  if (expect != size) {
+    SetError("MXPredSetInput: size mismatch for '" + std::string(key) +
+             "': got " + std::to_string(size) + ", expected " +
+             std::to_string(expect));
+    return -1;
+  }
+  PyObject *arr = BufferToNumpy(data, size, it->second);
+  if (arr == nullptr) {
+    SetPyError("MXPredSetInput: buffer conversion failed");
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(pred->obj, "set_input", "sO", key, arr);
+  Py_DECREF(arr);
+  if (r == nullptr) {
+    SetPyError("MXPredSetInput failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXPredForward(PredictorHandle handle) {
+  auto *pred = static_cast<Predictor *>(handle);
+  GILGuard gil;
+  PyObject *r = PyObject_CallMethod(pred->obj, "forward", nullptr);
+  if (r == nullptr) {
+    SetPyError("MXPredForward failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim) {
+  auto *pred = static_cast<Predictor *>(handle);
+  GILGuard gil;
+  PyObject *out =
+      PyObject_CallMethod(pred->obj, "get_output", "I", index);
+  if (out == nullptr) {
+    SetPyError("MXPredGetOutputShape failed");
+    return -1;
+  }
+  if (!ShapeOf(out, &pred->shape_scratch)) {
+    Py_DECREF(out);
+    SetPyError("MXPredGetOutputShape: cannot read output shape");
+    return -1;
+  }
+  Py_DECREF(out);
+  *shape_data = pred->shape_scratch.data();
+  *shape_ndim = static_cast<mx_uint>(pred->shape_scratch.size());
+  return 0;
+}
+
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              float *data, mx_uint size) {
+  auto *pred = static_cast<Predictor *>(handle);
+  GILGuard gil;
+  PyObject *out =
+      PyObject_CallMethod(pred->obj, "get_output", "I", index);
+  if (out == nullptr) {
+    SetPyError("MXPredGetOutput failed");
+    return -1;
+  }
+  std::vector<float> buf;
+  if (!NumpyToBuffer(out, &buf, nullptr)) {
+    Py_DECREF(out);
+    SetPyError("MXPredGetOutput: conversion failed");
+    return -1;
+  }
+  Py_DECREF(out);
+  if (buf.size() != size) {
+    SetError("MXPredGetOutput: size mismatch: output has " +
+             std::to_string(buf.size()) + " elements, caller asked for " +
+             std::to_string(size));
+    return -1;
+  }
+  std::memcpy(data, buf.data(), size * sizeof(float));
+  return 0;
+}
+
+MXNET_DLL int MXPredReshape(PredictorHandle handle,
+                            mx_uint num_input_nodes,
+                            const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            PredictorHandle *out) {
+  auto *pred = static_cast<Predictor *>(handle);
+  GILGuard gil;
+  auto *fresh = new Predictor();
+  PyObject *shapes =
+      BuildShapesDict(&fresh->input_shapes, num_input_nodes, input_keys,
+                      input_shape_indptr, input_shape_data);
+  fresh->obj = PyObject_CallMethod(pred->obj, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (fresh->obj == nullptr) {
+    SetPyError("MXPredReshape failed");
+    delete fresh;
+    return -1;
+  }
+  *out = fresh;
+  return 0;
+}
+
+MXNET_DLL int MXPredFree(PredictorHandle handle) {
+  delete static_cast<Predictor *>(handle);
+  return 0;
+}
+
+// ---- NDList: parameter-blob inspection (MXNDListCreate family) ----------
+
+MXNET_DLL int MXNDListCreate(const char *nd_file_bytes, int size,
+                             NDListHandle *out, mx_uint *out_length) {
+  if (!EnsurePython()) {
+    SetError("failed to initialize embedded Python");
+    return -1;
+  }
+  GILGuard gil;
+  PyObject *loader = GetAttr("mxnet_tpu.predictor", "load_ndarray_file");
+  if (loader == nullptr) {
+    SetPyError("cannot import mxnet_tpu.predictor");
+    return -1;
+  }
+  PyObject *bytes = PyBytes_FromStringAndSize(nd_file_bytes, size);
+  PyObject *dict = PyObject_CallFunctionObjArgs(loader, bytes, nullptr);
+  Py_DECREF(bytes);
+  Py_DECREF(loader);
+  if (dict == nullptr) {
+    SetPyError("MXNDListCreate failed");
+    return -1;
+  }
+  auto *list = new NDList();
+  list->dict = dict;
+  PyObject *keys = PyDict_Keys(dict);
+  Py_ssize_t n = PyList_Size(keys);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    list->keys.push_back(PyUnicode_AsUTF8(PyList_GetItem(keys, i)));
+  }
+  Py_DECREF(keys);
+  *out = list;
+  *out_length = static_cast<mx_uint>(n);
+  return 0;
+}
+
+MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim) {
+  auto *list = static_cast<NDList *>(handle);
+  if (index >= list->keys.size()) {
+    SetError("MXNDListGet: index out of range");
+    return -1;
+  }
+  GILGuard gil;
+  const std::string &key = list->keys[index];
+  PyObject *arr = PyDict_GetItemString(list->dict, key.c_str());
+  if (arr == nullptr ||
+      !NumpyToBuffer(arr, &list->data_scratch, &list->shape_scratch)) {
+    SetPyError("MXNDListGet: conversion failed");
+    return -1;
+  }
+  *out_key = key.c_str();
+  *out_data = list->data_scratch.data();
+  *out_shape = list->shape_scratch.data();
+  *out_ndim = static_cast<mx_uint>(list->shape_scratch.size());
+  return 0;
+}
+
+MXNET_DLL int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList *>(handle);
+  return 0;
+}

@@ -1,0 +1,121 @@
+/* C-ABI smoke test for the predict API (reference parity:
+ * example/image-classification/predict-cpp/image-classification-predict.cc
+ * usage of c_predict_api.h).
+ *
+ * Pure C consumer: loads a symbol JSON + parameter blob from argv, feeds a
+ * deterministic float32 input, prints the flat output to stdout (one value
+ * per line, "%.6g").  The pytest harness (tests/test_predict_capi.py)
+ * compiles+runs this and compares against the Python Predictor on the same
+ * input.
+ *
+ * Usage: predict_test symbol.json params.bin N C H W
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef uint32_t mx_uint;
+typedef void *PredictorHandle;
+
+extern const char *MXGetLastError(void);
+extern int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        mx_uint num_input_nodes, const char **input_keys,
+                        const mx_uint *input_shape_indptr,
+                        const mx_uint *input_shape_data,
+                        PredictorHandle *out);
+extern int MXPredSetInput(PredictorHandle handle, const char *key,
+                          const float *data, mx_uint size);
+extern int MXPredForward(PredictorHandle handle);
+extern int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                mx_uint **shape_data, mx_uint *shape_ndim);
+extern int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                           float *data, mx_uint size);
+extern int MXPredFree(PredictorHandle handle);
+
+#define CHECK(call)                                                     \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError());        \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    return NULL;
+  }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc((size_t)*size + 1);
+  if (fread(buf, 1, (size_t)*size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = '\0';
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 7) {
+    fprintf(stderr, "usage: %s symbol.json params.bin N C H W\n", argv[0]);
+    return 2;
+  }
+  long json_size = 0, param_size = 0;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!json || !params) return 2;
+
+  mx_uint shape[4];
+  for (int i = 0; i < 4; ++i) shape[i] = (mx_uint)atoi(argv[3 + i]);
+  mx_uint indptr[2] = {0, 4};
+  const char *keys[1] = {"data"};
+
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(json, params, (int)param_size, /*dev_type=*/1,
+                     /*dev_id=*/0, 1, keys, indptr, shape, &pred));
+
+  mx_uint n = shape[0] * shape[1] * shape[2] * shape[3];
+  float *input = (float *)malloc(n * sizeof(float));
+  for (mx_uint i = 0; i < n; ++i) {
+    input[i] = (float)((double)(i % 17) / 8.0 - 1.0);
+  }
+  /* error path: wrong size must fail with a message, not crash */
+  if (MXPredSetInput(pred, "data", input, n + 1) == 0) {
+    fprintf(stderr, "FAIL: oversized set_input accepted\n");
+    return 1;
+  }
+  if (MXPredSetInput(pred, "nosuch", input, n) == 0) {
+    fprintf(stderr, "FAIL: unknown key accepted\n");
+    return 1;
+  }
+  CHECK(MXPredSetInput(pred, "data", input, n));
+  CHECK(MXPredForward(pred));
+
+  mx_uint *oshape = NULL, ondim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  mx_uint osize = 1;
+  fprintf(stderr, "output shape:");
+  for (mx_uint i = 0; i < ondim; ++i) {
+    fprintf(stderr, " %u", oshape[i]);
+    osize *= oshape[i];
+  }
+  fprintf(stderr, "\n");
+
+  float *out = (float *)malloc(osize * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, out, osize));
+  for (mx_uint i = 0; i < osize; ++i) printf("%.6g\n", (double)out[i]);
+
+  CHECK(MXPredFree(pred));
+  free(out);
+  free(input);
+  free(json);
+  free(params);
+  return 0;
+}

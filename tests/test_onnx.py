@@ -366,3 +366,23 @@ def test_export_bn_mean_var_raises():
                                    "bn_moving_var":
                                        np.ones((3,), np.float32)},
                              {"data": (2, 3, 4, 4)})
+
+
+def test_pad_constant_value_input():
+    """Opset-11 Pad carries the pad value as input[2]; must not pad 0."""
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="Pad", input=["x", "pads", "cval"],
+                          output=["y"])],
+        initializer=[
+            onnx_mx._np_to_tensor("pads",
+                                  np.asarray([0, 0, 0, 1], np.int64)),
+            onnx_mx._np_to_tensor("cval", np.asarray(5.0, np.float32))],
+        input=[onnx_mx._vi("x", (2, 2))],
+        output=[onnx_mx._vi("y", (2, 3))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 2))
+    ex.copy_params_from(args, {})
+    out = ex.forward(is_train=False,
+                     x=nd.array(np.ones((2, 2), np.float32)))[0].asnumpy()
+    np.testing.assert_array_equal(out[:, -1], np.full((2,), 5.0))
