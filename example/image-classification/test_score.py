@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Pretrained-model accuracy harness (parity:
+example/image-classification/test_score.py:30 — the reference downloads
+pretrained ImageNet models and asserts their known accuracies).
+
+Zero-egress variant: scores the in-repo pretrained checkpoint
+``models/digits-lenet`` (a small conv net trained to >0.97 validation
+accuracy on sklearn's 8x8 digits — the repo's stand-in for the MNIST/
+ImageNet artifacts) and asserts the stored accuracy still reproduces.
+Any regression in conv/pool/FC/softmax inference, checkpoint loading, or
+Module.bind shows up here as a score drop.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+
+# model name -> (epoch, expected accuracy on the digits val split)
+PRETRAINED = {
+    "digits-lenet": (20, 0.973),
+}
+
+
+def val_data(batch_size=99):
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32).reshape(-1, 1, 8, 8)
+    y = y.astype(np.float32)
+    rng = np.random.RandomState(7)          # same split as training
+    idx = rng.permutation(len(X))
+    X, y = X[idx], y[idx]
+    return mx.io.NDArrayIter(X[1500:], y[1500:], batch_size=batch_size)
+
+
+def score(model, epoch, ctx=None, tol=0.01):
+    prefix = os.path.join(REPO, "models", model)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    mod = mx.mod.Module(sym, context=ctx)
+    val = val_data()
+    mod.bind(for_training=False, data_shapes=val.provide_data,
+             label_shapes=val.provide_label)
+    mod.set_params(arg_params, aux_params)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    expected = PRETRAINED[model][1]
+    ok = acc >= expected - tol
+    print("%s-%04d  accuracy %.4f  expected %.4f  %s"
+          % (model, epoch, acc, expected, "OK" if ok else "FAIL"))
+    return acc, ok
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None,
+                    help="score one model (default: all)")
+    args = ap.parse_args()
+    models = [args.model] if args.model else list(PRETRAINED)
+    failed = False
+    for m in models:
+        _, ok = score(m, PRETRAINED[m][0])
+        failed |= not ok
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

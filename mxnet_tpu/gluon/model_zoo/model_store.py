@@ -13,16 +13,26 @@ __all__ = ["get_model_file", "purge"]
 _model_sha1 = {}
 
 
+def _repo_models_dir():
+    """The in-repo ``models/`` artifact directory (checked as a fallback —
+    this repo ships small pretrained checkpoints, e.g. digits-lenet)."""
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+        "models"))
+
+
 def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
     """Return the local path of a pretrained parameter file."""
     root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
-    for fname in os.listdir(root) if os.path.isdir(root) else []:
-        if fname.startswith(name) and fname.endswith(".params"):
-            return os.path.join(root, fname)
+    for d in (root, _repo_models_dir()):
+        for fname in sorted(os.listdir(d)) if os.path.isdir(d) else []:
+            if fname.startswith(name) and (fname.endswith(".params") or
+                                           fname.endswith(".params.npz")):
+                return os.path.join(d, fname)
     raise FileNotFoundError(
-        "Pretrained model file for %r not found under %s. Downloads are "
-        "disabled in this environment; place '%s-<hash>.params' there "
-        "manually." % (name, root, name))
+        "Pretrained model file for %r not found under %s or %s. Downloads "
+        "are disabled in this environment; place '%s-<hash>.params' there "
+        "manually." % (name, root, _repo_models_dir(), name))
 
 
 def purge(root=os.path.join("~", ".mxnet", "models")):

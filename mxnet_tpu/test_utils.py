@@ -40,17 +40,19 @@ def same(a, b):
     return np.array_equal(np.asarray(a), np.asarray(b))
 
 
-def almost_equal(a, b, rtol=1e-5, atol=1e-20):
-    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
 
 
-def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
     """Tolerance assert with a useful message (reference
     assert_almost_equal)."""
     from .ndarray import NDArray
     a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
     b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
-    if not np.allclose(a, b, rtol=rtol, atol=atol):
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
         err = np.abs(a - b)
         rel = err / (np.abs(b) + 1e-12)
         raise AssertionError(
@@ -66,6 +68,19 @@ def rand_shape_2d(dim0=10, dim1=10):
 def rand_shape_3d(dim0=10, dim1=10, dim2=10):
     return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
             np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10, allow_zero_size=False):
+    """Random shape of ``num_dim`` dims, each in [1, dim] (or [0, dim] when
+    zero-size edge shapes are wanted) — reference rand_shape_nd."""
+    low = 0 if allow_zero_size else 1
+    return tuple(np.random.randint(low, dim + 1, size=num_dim).tolist())
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    """A random 2-D coordinate (reference rand_coord_2d)."""
+    return (np.random.randint(x_low, x_high),
+            np.random.randint(y_low, y_high))
 
 
 def rand_ndarray(shape, stype="default", density=None, dtype=None,
@@ -212,27 +227,138 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
     return ex
 
 
-def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
-    """Run one symbol on several contexts and require matching outputs
-    (reference check_consistency — the CPU/GPU cross-check pattern, here
-    CPU interpreter vs TPU)."""
+# per-dtype default tolerances (reference check_consistency tol table:
+# fp16 1e-1, fp32 1e-3, fp64 1e-5, int types exact; bfloat16 has a coarser
+# mantissa than fp16 so it shares the loose tier)
+_DTYPE_RTOL = {"float16": 1e-1, "bfloat16": 1e-1, "float32": 1e-3,
+               "float64": 1e-5}
+_DTYPE_ATOL = {"float16": 1e-1, "bfloat16": 1e-1, "float32": 1e-4,
+               "float64": 1e-7}
+# precision ranking by mantissa bits (bf16 < fp16 < fp32 < fp64); numpy
+# reports bfloat16 (an ml_dtypes extension type) as kind 'V', so rank by
+# name, not itemsize/kind
+_MANTISSA_BITS = {"bfloat16": 8, "float16": 10, "float32": 23,
+                  "float64": 52}
+
+
+def _float_rank(dtype):
+    """Mantissa bits of a float-ish dtype, or None for non-floats."""
+    return _MANTISSA_BITS.get(np.dtype(dtype).name)
+
+
+def _entry_dtypes(entry, names):
+    td = entry.get("type_dict", {})
+    return {k: np.dtype(td.get(k, np.float32)) for k in names}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=None, atol=None,
+                      grad_req="write", equal_nan=False):
+    """Run one symbol across contexts *and dtypes* and require matching
+    forward outputs and backward gradients (reference check_consistency —
+    the CPU/GPU + fp16-grid cross-check pattern; here contexts are CPU
+    interpreter vs TPU and the dtype grid covers fp16/bf16/fp32/fp64).
+
+    ctx_list entries: ``{'ctx': Context, <arg name>: shape, ...,
+    'type_dict': {arg name: dtype}}``.  Ground truth is the
+    highest-precision entry; every other entry is compared against it with
+    tolerances keyed to the lower-precision dtype of the pair (overridable
+    via rtol/atol).  With ``grad_req != 'null'``, backward runs with a
+    fixed random head gradient and argument gradients must match too.
+    """
+    from . import ndarray as nd
     if not ctx_list:
         return
-    # ctx_list entries: {'ctx': Context, <arg shapes by name>}
-    arg_shapes = {k: v for k, v in ctx_list[0].items() if k != "ctx"}
+    arg_shapes = {k: v for k, v in ctx_list[0].items()
+                  if k not in ("ctx", "type_dict")}
+    names = list(arg_shapes)
     rng = np.random.RandomState(0)
-    location = {k: (rng.normal(0, scale, s).astype(np.float32))
-                for k, s in arg_shapes.items()}
-    outputs = []
+    base = {k: rng.normal(0, scale, s).astype(np.float64)
+            for k, s in arg_shapes.items()}
+    reqs = ({k: grad_req for k in names} if isinstance(grad_req, str)
+            else dict(grad_req))
+    run_backward = any(r != "null" for r in reqs.values())
+
+    results = []   # (min_dtype, outputs, grads)
+    head_grads = None
     for entry in ctx_list:
-        ctx = entry["ctx"]
-        grad_req = {k: "null" for k in location}
-        ex = _executor_for(sym, location, None, grad_req, ctx)
-        outputs.append([o.asnumpy() for o in ex.forward(is_train=False)])
-    for other in outputs[1:]:
-        for a, b in zip(outputs[0], other):
-            assert_almost_equal(a, b, rtol=rtol, atol=atol)
-    return outputs
+        dtypes = _entry_dtypes(entry, names)
+        location = {k: base[k].astype(dtypes[k]) for k in names}
+        ex = _executor_for(sym, location, None, reqs, entry["ctx"])
+        outs = ex.forward(is_train=run_backward)
+        grads = {}
+        if run_backward:
+            if head_grads is None:
+                head_grads = [rng.normal(0, 1, o.shape)
+                              .astype(np.float64) for o in outs]
+            ex.backward([nd.array(h.astype(o.dtype))
+                         for h, o in zip(head_grads, outs)])
+            grads = {k: ex.grad_dict[k].asnumpy()
+                     for k in names if reqs.get(k) != "null"}
+        ranks = [_float_rank(dt) for dt in dtypes.values()]
+        ranks = [r for r in ranks if r is not None] or \
+            [_MANTISSA_BITS["float32"]]
+        min_rank = min(ranks)
+        results.append((min_rank, [o.asnumpy() for o in outs], grads))
+
+    # ground truth: the entry whose lowest-precision dtype is widest
+    gt_idx = max(range(len(results)), key=lambda i: results[i][0])
+    gt_rank, gt_outs, gt_grads = results[gt_idx]
+    rank2name = {v: k for k, v in _MANTISSA_BITS.items()}
+    for i, (rank, outs, grads) in enumerate(results):
+        if i == gt_idx:
+            continue
+        pair_name = rank2name[min(rank, gt_rank)]
+        r = _DTYPE_RTOL.get(pair_name, 1e-3) if rtol is None else rtol
+        a = _DTYPE_ATOL.get(pair_name, 1e-4) if atol is None else atol
+        for o, e in zip(outs, gt_outs):
+            assert_almost_equal(np.asarray(o, np.float64),
+                                np.asarray(e, np.float64), rtol=r, atol=a,
+                                equal_nan=equal_nan,
+                                names=("ctx[%d] output" % i, "ground truth"))
+        for k in grads:
+            assert_almost_equal(np.asarray(grads[k], np.float64),
+                                np.asarray(gt_grads[k], np.float64),
+                                rtol=r, atol=a, equal_nan=equal_nan,
+                                names=("ctx[%d] grad(%s)" % (i, k),
+                                       "ground truth"))
+    return [outs for _, outs, _ in results]
+
+
+def check_speed(sym, location=None, ctx=None, n=20, grad_req="null",
+                typ="whole", **arg_shapes):
+    """Median seconds per execution (reference check_speed).  ``typ``:
+    'whole' = forward+backward when grad_req allows it, 'forward' =
+    forward only regardless of grad_req."""
+    import time
+    from . import ndarray as nd
+    if typ not in ("whole", "forward"):
+        raise MXNetError("check_speed typ must be 'whole' or 'forward'")
+    ctx = ctx or default_context()
+    if location is None:
+        rng = np.random.RandomState(0)
+        location = {k: rng.normal(0, 1, s).astype(np.float32)
+                    for k, s in arg_shapes.items()}
+    reqs = {k: grad_req for k in location}
+    ex = _executor_for(sym, location, None, reqs, ctx)
+    run_backward = grad_req != "null" and typ == "whole"
+
+    def once():
+        outs = ex.forward(is_train=run_backward)
+        if run_backward:
+            ex.backward([nd.ones(o.shape, dtype=o.dtype) for o in outs])
+            for g in ex.grad_dict.values():
+                g.asnumpy()
+        else:
+            for o in outs:
+                o.asnumpy()
+
+    once()  # compile
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        once()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
 
 
 def list_gpus():

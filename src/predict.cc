@@ -62,8 +62,11 @@ bool EnsurePython() {
     if (!Py_IsInitialized()) {
       PyConfig config;
       PyConfig_InitPythonConfig(&config);
-      Py_InitializeFromConfig(&config);
+      PyStatus status = Py_InitializeFromConfig(&config);
       PyConfig_Clear(&config);
+      if (PyStatus_Exception(status)) {
+        return;  // ok stays false; callers surface the error
+      }
       // Release the GIL acquired by Py_Initialize so PyGILState_Ensure
       // works from any caller thread.
       PyEval_SaveThread();
@@ -92,16 +95,15 @@ struct Predictor {
 };
 
 struct NDList {
-  PyObject *dict = nullptr;                      // {name: NDArray}
-  std::vector<std::string> keys;
-  std::vector<mx_uint> shape_scratch;
-  std::vector<float> data_scratch;
-  ~NDList() {
-    if (dict != nullptr) {
-      GILGuard gil;
-      Py_DECREF(dict);
-    }
-  }
+  // Converted eagerly at create time so the pointers handed out by
+  // MXNDListGet stay valid until MXNDListFree (reference contract) — a
+  // shared scratch buffer would alias consecutive Get calls.
+  struct Entry {
+    std::string key;                             // "" for list-format blobs
+    std::vector<float> data;
+    std::vector<mx_uint> shape;
+  };
+  std::vector<Entry> entries;
 };
 
 // Fill pred->input_shapes and return a new {key: shape tuple} dict.
@@ -404,23 +406,51 @@ MXNET_DLL int MXNDListCreate(const char *nd_file_bytes, int size,
     return -1;
   }
   PyObject *bytes = PyBytes_FromStringAndSize(nd_file_bytes, size);
-  PyObject *dict = PyObject_CallFunctionObjArgs(loader, bytes, nullptr);
+  PyObject *loaded = PyObject_CallFunctionObjArgs(loader, bytes, nullptr);
   Py_DECREF(bytes);
   Py_DECREF(loader);
-  if (dict == nullptr) {
+  if (loaded == nullptr) {
     SetPyError("MXNDListCreate failed");
     return -1;
   }
   auto *list = new NDList();
-  list->dict = dict;
-  PyObject *keys = PyDict_Keys(dict);
-  Py_ssize_t n = PyList_Size(keys);
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    list->keys.push_back(PyUnicode_AsUTF8(PyList_GetItem(keys, i)));
+  bool failed = false;
+  if (PyDict_Check(loaded)) {
+    PyObject *key = nullptr, *value = nullptr;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(loaded, &pos, &key, &value)) {
+      NDList::Entry e;
+      const char *k = PyUnicode_AsUTF8(key);
+      e.key = k != nullptr ? k : "";
+      if (!NumpyToBuffer(value, &e.data, &e.shape)) {
+        failed = true;
+        break;
+      }
+      list->entries.push_back(std::move(e));
+    }
+  } else if (PyList_Check(loaded)) {
+    // list-format blob (nd.save of a list): entries have empty keys,
+    // matching the reference MXNDListCreate contract
+    for (Py_ssize_t i = 0; i < PyList_Size(loaded); ++i) {
+      NDList::Entry e;
+      if (!NumpyToBuffer(PyList_GetItem(loaded, i), &e.data, &e.shape)) {
+        failed = true;
+        break;
+      }
+      list->entries.push_back(std::move(e));
+    }
+  } else {
+    SetError("MXNDListCreate: blob did not load as a dict or list");
+    failed = true;
   }
-  Py_DECREF(keys);
+  Py_DECREF(loaded);
+  if (failed) {
+    if (PyErr_Occurred()) SetPyError("MXNDListCreate: conversion failed");
+    delete list;
+    return -1;
+  }
   *out = list;
-  *out_length = static_cast<mx_uint>(n);
+  *out_length = static_cast<mx_uint>(list->entries.size());
   return 0;
 }
 
@@ -428,22 +458,15 @@ MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
                           const char **out_key, const float **out_data,
                           const mx_uint **out_shape, mx_uint *out_ndim) {
   auto *list = static_cast<NDList *>(handle);
-  if (index >= list->keys.size()) {
+  if (index >= list->entries.size()) {
     SetError("MXNDListGet: index out of range");
     return -1;
   }
-  GILGuard gil;
-  const std::string &key = list->keys[index];
-  PyObject *arr = PyDict_GetItemString(list->dict, key.c_str());
-  if (arr == nullptr ||
-      !NumpyToBuffer(arr, &list->data_scratch, &list->shape_scratch)) {
-    SetPyError("MXNDListGet: conversion failed");
-    return -1;
-  }
-  *out_key = key.c_str();
-  *out_data = list->data_scratch.data();
-  *out_shape = list->shape_scratch.data();
-  *out_ndim = static_cast<mx_uint>(list->shape_scratch.size());
+  const NDList::Entry &e = list->entries[index];
+  *out_key = e.key.c_str();
+  *out_data = e.data.data();
+  *out_shape = e.shape.data();
+  *out_ndim = static_cast<mx_uint>(e.shape.size());
   return 0;
 }
 

@@ -169,3 +169,42 @@ def test_predictor_ctypes_inprocess(tmp_path):
         5) == 0
     assert lib.MXPredFree(fresh) == 0
     assert lib.MXPredFree(handle) == 0
+
+
+def test_ndlist_list_format_and_pointer_stability(tmp_path):
+    """List-format blobs (nd.save of a list) get empty keys; pointers from
+    earlier MXNDListGet calls stay valid after later ones (reference
+    contract: valid until MXNDListFree)."""
+    _make(os.path.relpath(LIB, SRC))
+    arrs = [nd.array(np.full((2, 2), 1.0, np.float32)),
+            nd.array(np.full((3,), 2.0, np.float32))]
+    nd.save(str(tmp_path / "list.bin"), arrs)
+    with open(str(tmp_path / "list.bin.npz"), "rb") as f:
+        blob = f.read()
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(blob, len(blob), ctypes.byref(handle),
+                            ctypes.byref(length))
+    assert rc == 0, lib.MXGetLastError()
+    assert length.value == 2
+    held = []
+    for i in range(2):
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shape = ctypes.POINTER(ctypes.c_uint)()
+        ndim = ctypes.c_uint()
+        assert lib.MXNDListGet(handle, i, ctypes.byref(key),
+                               ctypes.byref(data), ctypes.byref(shape),
+                               ctypes.byref(ndim)) == 0
+        held.append((key.value, data, shape, ndim.value))
+    # entry 0's pointers must still describe entry 0 after fetching entry 1
+    key0, data0, shape0, ndim0 = held[0]
+    assert key0 == b""
+    assert ndim0 == 2 and shape0[0] == 2 and shape0[1] == 2
+    assert [data0[j] for j in range(4)] == [1.0] * 4
+    key1, data1, shape1, ndim1 = held[1]
+    assert ndim1 == 1 and shape1[0] == 3
+    assert [data1[j] for j in range(3)] == [2.0] * 3
+    assert lib.MXNDListFree(handle) == 0

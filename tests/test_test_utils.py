@@ -1,0 +1,120 @@
+"""test_utils parity: dtype-grid check_consistency, edge-shape random
+machinery, check_speed (reference python/mxnet/test_utils.py — the
+check_consistency fp16-grid pattern of tests/python/gpu/test_operator_gpu.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_rand_shape_nd():
+    np.random.seed(3)
+    for nd_ in (1, 2, 5):
+        s = tu.rand_shape_nd(nd_, dim=6)
+        assert len(s) == nd_
+        assert all(1 <= d <= 6 for d in s)
+    s = tu.rand_shape_nd(3, dim=4, allow_zero_size=True)
+    assert all(0 <= d <= 4 for d in s)
+    x, y = tu.rand_coord_2d(0, 5, 10, 20)
+    assert 0 <= x < 5 and 10 <= y < 20
+
+
+def test_rand_ndarray_dtypes_and_stypes():
+    a = tu.rand_ndarray((3, 4), dtype=np.float16)
+    assert a.dtype == np.float16
+    rsp = tu.rand_ndarray((6, 3), stype="row_sparse", density=0.5)
+    assert rsp.stype == "row_sparse"
+    empty = tu.rand_ndarray((6, 3), stype="row_sparse", density=0.0)
+    assert empty.stype == "row_sparse"
+    np.testing.assert_array_equal(empty.tostype("default").asnumpy(),
+                                  np.zeros((6, 3), np.float32))
+    csr = tu.rand_ndarray((5, 7), stype="csr", density=0.3)
+    assert csr.stype == "csr"
+
+
+def test_check_consistency_dtype_grid():
+    """fp16/fp32/fp64 grid on one symbol: forward + backward must agree
+    within per-dtype tolerance (ground truth = widest dtype)."""
+    S = mx.symbol
+    x = S.var("data")
+    w = S.var("w")
+    sym = S.sum(S.Activation(S.dot(x, w), act_type="tanh"))
+    grid = [
+        {"ctx": mx.cpu(), "data": (4, 5), "w": (5, 3),
+         "type_dict": {"data": np.float16, "w": np.float16}},
+        {"ctx": mx.cpu(), "data": (4, 5), "w": (5, 3),
+         "type_dict": {"data": np.float32, "w": np.float32}},
+        {"ctx": mx.cpu(), "data": (4, 5), "w": (5, 3),
+         "type_dict": {"data": np.float64, "w": np.float64}},
+    ]
+    outs = tu.check_consistency(sym, grid, grad_req="write")
+    assert len(outs) == 3
+
+
+def test_check_consistency_catches_divergence():
+    """A dtype entry whose numerics genuinely diverge (beyond tolerance)
+    must fail loudly — exercised by clobbering the tolerance."""
+    S = mx.symbol
+    sym = S.exp(S.var("data") * 8.0)  # fp16 overflows where fp64 doesn't
+    grid = [
+        {"ctx": mx.cpu(), "data": (4,),
+         "type_dict": {"data": np.float16}},
+        {"ctx": mx.cpu(), "data": (4,),
+         "type_dict": {"data": np.float64}},
+    ]
+    with pytest.raises(AssertionError, match="ground truth"):
+        tu.check_consistency(sym, grid, scale=4.0, grad_req="null",
+                             rtol=1e-7, atol=1e-9)
+
+
+def test_check_speed_returns_positive_time():
+    S = mx.symbol
+    sym = S.FullyConnected(S.var("data"), S.var("w"), no_bias=True,
+                           num_hidden=8)
+    t = tu.check_speed(sym, n=3, grad_req="write", data=(16, 8),
+                       w=(8, 8))
+    assert t > 0
+
+
+def test_check_consistency_bfloat16_entry():
+    """bf16 entries rank below fp16 and get the loose tolerance tier
+    (regression: bf16's numpy kind is 'V', not 'f')."""
+    import ml_dtypes
+    S = mx.symbol
+    sym = S.dot(S.var("data"), S.var("w"))
+    grid = [
+        {"ctx": mx.cpu(), "data": (4, 5), "w": (5, 3),
+         "type_dict": {"data": ml_dtypes.bfloat16,
+                       "w": ml_dtypes.bfloat16}},
+        {"ctx": mx.cpu(), "data": (4, 5), "w": (5, 3),
+         "type_dict": {"data": np.float64, "w": np.float64}},
+    ]
+    outs = tu.check_consistency(sym, grid, grad_req="null")
+    assert len(outs) == 2
+
+
+def test_check_consistency_equal_nan():
+    S = mx.symbol
+    sym = S.sqrt(S.var("data"))  # NaN for negative inputs in every dtype
+    grid = [
+        {"ctx": mx.cpu(), "data": (6,),
+         "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (6,),
+         "type_dict": {"data": np.float64}},
+    ]
+    with pytest.raises(AssertionError):
+        tu.check_consistency(sym, grid, grad_req="null")
+    tu.check_consistency(sym, grid, grad_req="null", equal_nan=True)
+
+
+def test_check_speed_forward_only():
+    S = mx.symbol
+    sym = S.FullyConnected(S.var("data"), S.var("w"), no_bias=True,
+                           num_hidden=8)
+    t = tu.check_speed(sym, n=2, grad_req="write", typ="forward",
+                       data=(4, 8), w=(8, 8))
+    assert t > 0
+    with pytest.raises(mx.base.MXNetError):
+        tu.check_speed(sym, n=1, typ="bogus", data=(4, 8), w=(8, 8))

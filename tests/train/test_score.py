@@ -1,0 +1,34 @@
+"""Pretrained-artifact scoring (parity:
+example/image-classification/test_score.py:30 — known-accuracy assertions
+on shipped checkpoints).  The in-repo ``models/digits-lenet`` checkpoint
+must keep reproducing its stored validation accuracy; a drop means an
+inference-path or checkpoint-format regression.
+"""
+import importlib.util
+import os
+
+from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+
+
+def _score_module():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "..", "example",
+        "image-classification", "test_score.py")
+    spec = importlib.util.spec_from_file_location("score_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pretrained_digits_lenet_score():
+    mod = _score_module()
+    acc, ok = mod.score("digits-lenet", 20)
+    assert ok, "digits-lenet scored %.4f, expected >= %.4f" \
+        % (acc, mod.PRETRAINED["digits-lenet"][1] - 0.01)
+
+
+def test_model_store_resolves_repo_artifact():
+    """get_model_file falls back to the in-repo models/ directory."""
+    path = get_model_file("digits-lenet")
+    assert os.path.exists(path)
+    assert "models" in path
