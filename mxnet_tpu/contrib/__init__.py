@@ -3,3 +3,5 @@ text utilities, ONNX import, experimental APIs."""
 from . import quantization  # noqa: F401
 from . import text          # noqa: F401
 from . import onnx          # noqa: F401
+from . import onnx_proto    # noqa: F401
+from . import tensorboard   # noqa: F401

@@ -1,0 +1,172 @@
+"""TensorBoard logging (parity: ``python/mxnet/contrib/tensorboard.py``).
+
+The reference's ``LogMetricsCallback`` wraps the external ``tensorboard``
+package's SummaryWriter.  Zero-dependency here: event files are written
+directly — Event/Summary protos via the same hand-rolled protobuf codec
+used for ONNX (:mod:`mxnet_tpu.contrib.onnx_proto`), framed in the
+TFRecord format (length + masked CRC32C) that TensorBoard reads.  Scalars
+and histograms are supported — the two summary kinds the reference
+callback emits.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+import numpy as np
+
+from .onnx_proto import Message
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), required by the TFRecord framing
+# ---------------------------------------------------------------------------
+
+def _make_crc_table():
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _make_crc_table()
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# tensorflow Event/Summary proto subset (field numbers from
+# tensorflow/core/util/event.proto and framework/summary.proto)
+# ---------------------------------------------------------------------------
+
+class HistogramProto(Message):
+    pass
+
+
+HistogramProto.FIELDS = {
+    1: ("min", "double", False),
+    2: ("max", "double", False),
+    3: ("num", "double", False),
+    4: ("sum", "double", False),
+    5: ("sum_squares", "double", False),
+    6: ("bucket_limit", "double", True),
+    7: ("bucket", "double", True),
+}
+
+
+class SummaryValue(Message):
+    pass
+
+
+SummaryValue.FIELDS = {
+    1: ("tag", "string", False),
+    2: ("simple_value", "float", False),
+    5: ("histo", HistogramProto, False),
+}
+
+
+class Summary(Message):
+    pass
+
+
+Summary.FIELDS = {
+    1: ("value", SummaryValue, True),
+}
+
+
+class Event(Message):
+    pass
+
+
+Event.FIELDS = {
+    1: ("wall_time", "double", False),
+    2: ("step", "int", False),
+    3: ("file_version", "string", False),
+    5: ("summary", Summary, False),
+}
+
+
+class SummaryWriter:
+    """Minimal event-file writer with the tensorboardX API subset the
+    reference callback uses (add_scalar/add_histogram/flush/close)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = "events.out.tfevents.%d.mxnet_tpu" % int(time.time())
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "ab")
+        self._write_event(Event(wall_time=time.time(),
+                                file_version="brain.Event:2"))
+
+    def _write_event(self, event: Event):
+        payload = event.serialize()
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event(Event(
+            wall_time=time.time(), step=int(global_step),
+            summary=Summary(value=[SummaryValue(
+                tag=str(tag), simple_value=float(value))])))
+
+    def add_histogram(self, tag, values, global_step=0, bins=30):
+        arr = np.asarray(
+            values.asnumpy() if hasattr(values, "asnumpy") else values,
+            np.float64).ravel()
+        counts, edges = np.histogram(arr, bins=bins)
+        histo = HistogramProto(
+            min=float(arr.min()), max=float(arr.max()),
+            num=float(arr.size), sum=float(arr.sum()),
+            sum_squares=float((arr * arr).sum()),
+            bucket_limit=[float(e) for e in edges[1:]],
+            bucket=[float(c) for c in counts])
+        self._write_event(Event(
+            wall_time=time.time(), step=int(global_step),
+            summary=Summary(value=[SummaryValue(tag=str(tag),
+                                                histo=histo)])))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming metric values to TensorBoard
+    (parity: contrib.tensorboard.LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self._writer.add_scalar(name, value, self._step)
+        self._writer.flush()

@@ -271,10 +271,23 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
     train = attrs.get("__train__") and not attrs["use_global_stats"]
+    low_precision = data.dtype in (jnp.bfloat16, jnp.float16)
     if train:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        if low_precision:
+            # bf16/f16 fast path: f32-ACCUMULATED stats straight off the
+            # low-precision activations (no materialized f32 copy — the
+            # square fuses into the reduction), one-pass variance.  The
+            # activation-sized reads/writes stay 2 bytes/elt, halving the
+            # HBM traffic of this memory-bound op (~17% ResNet-50 step
+            # time on v5e).
+            mean = jnp.mean(data, axis=red, dtype=jnp.float32)
+            m2 = jnp.mean(jax.lax.square(data.astype(jnp.float32)),
+                          axis=red)
+            var = jnp.maximum(m2 - jax.lax.square(mean), 0.0)
+        else:
+            x32 = data.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=red)
+            var = jnp.var(x32, axis=red)
         m = attrs["momentum"]
         new_mm = moving_mean * m + mean * (1 - m)
         new_mv = moving_var * m + var * (1 - m)
@@ -282,8 +295,14 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
     g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma
-    inv = jax.lax.rsqrt(var + attrs["eps"])
-    out = (data - mean.reshape(shape)) * (inv * g).reshape(shape) \
+    inv = jax.lax.rsqrt(var + attrs["eps"]) * g
+    if low_precision:
+        # normalize in the input dtype with the scale/shift folded into
+        # two per-channel scalars (y = x*inv + (beta - mean*inv))
+        shift = beta - mean * inv
+        return (data * inv.astype(data.dtype).reshape(shape)
+                + shift.astype(data.dtype).reshape(shape)), new_mm, new_mv
+    out = (data - mean.reshape(shape)) * inv.reshape(shape) \
         + beta.reshape(shape)
     return out.astype(data.dtype), new_mm, new_mv
 

@@ -13,3 +13,5 @@ from . import tensor_parallel
 from . import ring_attention
 from . import pipeline
 from .pipeline import Pipeline, pipeline_apply
+from . import moe
+from .moe import moe_ffn, top_k_gating, init_moe_params
