@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Model-parallel multi-layer LSTM language model (parity:
+example/model-parallel/lstm — the reference's coarse model-parallelism
+showcase: each LSTM layer lives in its own ``ctx_group``, bound to a
+different device via ``group2ctx``; activations cross device boundaries
+between layers while each layer's weights stay resident on its device).
+
+TPU-native notes: placement uses the group2ctx executor path
+(``AssignContext`` parity); on a real pod you would instead shard layers
+with pipeline parallelism (``mxnet_tpu.parallel.pipeline``) — this example
+exists for reference-workflow parity and runs on any multi-device setup
+(including the CPU interpreter with multiple virtual devices).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+         python lstm.py --num-layers 4 --num-epochs 4
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def lstm_cell(num_hidden, indata, prev_c, prev_h, layer, t):
+    """One explicit LSTM cell from FC ops (reference lstm.py pattern —
+    weights shared across time via name reuse)."""
+    i2h = sym.FullyConnected(indata, num_hidden=num_hidden * 4,
+                             name="l%d_i2h" % layer)
+    h2h = sym.FullyConnected(prev_h, num_hidden=num_hidden * 4,
+                             name="l%d_h2h" % layer)
+    gates = i2h + h2h
+    sliced = sym.SliceChannel(gates, num_outputs=4,
+                              name="l%d_t%d_slice" % (layer, t))
+    in_gate = sym.Activation(sliced[0], act_type="sigmoid")
+    in_trans = sym.Activation(sliced[1], act_type="tanh")
+    forget = sym.Activation(sliced[2], act_type="sigmoid")
+    out_gate = sym.Activation(sliced[3], act_type="sigmoid")
+    next_c = forget * prev_c + in_gate * in_trans
+    next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+    return next_c, next_h
+
+
+def build(seq_len, vocab, num_embed, num_hidden, num_layers):
+    """Unrolled LM: embedding on group 'embed', LSTM layer i on group
+    'layer_i', decoder on the last layer's group."""
+    data = sym.var("data")            # (batch, seq_len)
+    label = sym.var("softmax_label")
+    with mx.AttrScope(ctx_group="embed"):
+        embed = sym.Embedding(data, input_dim=vocab, output_dim=num_embed,
+                              name="embed")
+        steps = sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                 squeeze_axis=True, name="embed_slice")
+    states = []
+    for layer in range(num_layers):
+        with mx.AttrScope(ctx_group="layer_%d" % layer):
+            c = sym.var("l%d_init_c" % layer)
+            h = sym.var("l%d_init_h" % layer)
+        states.append((c, h))
+    outputs = []
+    for t in range(seq_len):
+        x = steps[t]
+        for layer in range(num_layers):
+            with mx.AttrScope(ctx_group="layer_%d" % layer):
+                c, h = lstm_cell(num_hidden, x, states[layer][0],
+                                 states[layer][1], layer, t)
+            states[layer] = (c, h)
+            x = h
+        outputs.append(x)
+    with mx.AttrScope(ctx_group="layer_%d" % (num_layers - 1)):
+        concat = sym.concat(*outputs, dim=0)      # (seq*batch, hidden)
+        pred = sym.FullyConnected(concat, num_hidden=vocab, name="decoder")
+        flat_label = sym.Reshape(sym.transpose(label, axes=(1, 0)),
+                                 shape=(-1,))
+        out = sym.SoftmaxOutput(pred, flat_label, name="softmax")
+    return out
+
+
+def synthetic_corpus(n_tokens, vocab, rng):
+    """Markov-ish synthetic ids so the LM has learnable structure."""
+    ids = np.zeros(n_tokens, np.int64)
+    for i in range(1, n_tokens):
+        ids[i] = (ids[i - 1] * 31 + 7) % vocab if rng.rand() < 0.8 \
+            else rng.randint(vocab)
+    return ids
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-layers", type=int, default=4)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args()
+    train(args)
+
+
+def train(args):
+    import jax
+    n_dev = len(jax.devices())
+    # layer i -> device i (mod available); embedding with layer 0
+    group2ctx = {"embed": mx.Context(mx.current_context().device_type, 0)}
+    for layer in range(args.num_layers):
+        group2ctx["layer_%d" % layer] = mx.Context(
+            mx.current_context().device_type, layer % n_dev)
+    print("placement:", {g: str(c) for g, c in group2ctx.items()})
+
+    net = build(args.seq_len, args.vocab, args.num_embed, args.num_hidden,
+                args.num_layers)
+
+    rng = np.random.RandomState(0)
+    corpus = synthetic_corpus(20_000, args.vocab, rng)
+    n_seq = (len(corpus) - 1) // args.seq_len
+    X = corpus[:n_seq * args.seq_len].reshape(n_seq, args.seq_len)
+    Y = corpus[1:n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+
+    init_states = {}
+    for layer in range(args.num_layers):
+        for s in ("c", "h"):
+            init_states["l%d_init_%s" % (layer, s)] = \
+                (args.batch_size, args.num_hidden)
+    ex = net.simple_bind(ctx=list(group2ctx.values())[0],
+                         group2ctx=group2ctx, grad_req="write",
+                         data=(args.batch_size, args.seq_len),
+                         softmax_label=(args.batch_size, args.seq_len),
+                         **init_states)
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "softmax_label") or "_init_" in name:
+            continue
+        init(mx.init.InitDesc(name), arr)
+    zeros = {k: mx.nd.zeros(v) for k, v in init_states.items()}
+
+    last_ppl = None
+    for epoch in range(args.num_epochs):
+        order = rng.permutation(n_seq // args.batch_size)
+        total_nll, total_tok = 0.0, 0
+        for b in order:
+            s = b * args.batch_size
+            xb = X[s:s + args.batch_size].astype(np.float32)
+            yb = Y[s:s + args.batch_size].astype(np.float32)
+            outs = ex.forward(is_train=True, data=mx.nd.array(xb),
+                              softmax_label=mx.nd.array(yb), **zeros)
+            ex.backward()
+            for name, grad in ex.grad_dict.items():
+                if name in ("data", "softmax_label") or "_init_" in name:
+                    continue
+                ex.arg_dict[name][:] = ex.arg_dict[name] - \
+                    (args.lr / args.batch_size) * grad
+            probs = outs[0].asnumpy()
+            flat_y = yb.T.reshape(-1).astype(np.int64)
+            nll = -np.log(np.maximum(
+                probs[np.arange(len(flat_y)), flat_y], 1e-12))
+            total_nll += nll.sum()
+            total_tok += len(flat_y)
+        last_ppl = float(np.exp(total_nll / total_tok))
+        print("epoch %d  perplexity %.2f" % (epoch, last_ppl))
+    return last_ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""Faster R-CNN end-to-end training (parity: example/rcnn/train_end2end.py).
+
+The reference's RCNN example is a full package (rcnn/symbol, AnchorLoader,
+ProposalTarget custom op, MutableModule); this is the same topology in one
+file, exercising every RCNN-specific piece of the framework:
+
+  backbone convs -> RPN head (cls + bbox) -> SoftmaxOutput with ignore
+  labels + smooth-L1 RPN bbox loss -> ``_contrib_Proposal`` (anchor decode
+  + NMS, fixed-capacity TPU formulation) -> **ProposalTarget as a Python
+  custom op** (the reference's rcnn/symbol/proposal_target.py pattern over
+  the custom-op bridge) -> ROIPooling -> classifier/regressor heads.
+
+Data is synthetic (colored rectangles, zero egress) with the exact label
+conventions of the reference pipeline: padded gt_boxes (x1,y1,x2,y2,cls),
+RPN anchor targets with -1 = ignore, class-specific bbox regression with
+per-class weights.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+# ---- config (reference rcnn/config.py, shrunk to demo scale) -------------
+IMG = 64
+STRIDE = 8
+FEAT = IMG // STRIDE
+SCALES = (2.0, 4.0)        # anchor box sizes in stride units
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+NUM_CLASSES = 3            # background + 2 shapes
+ROI_BATCH = 32             # sampled rois per image (TRAIN.BATCH_ROIS)
+POST_NMS = 64
+
+
+def make_anchors():
+    """(A*F*F, 4) anchors, x1y1x2y2 (rcnn/processing/generate_anchor.py)."""
+    anchors = []
+    for y in range(FEAT):
+        for x in range(FEAT):
+            cx, cy = (x + 0.5) * STRIDE, (y + 0.5) * STRIDE
+            for s in SCALES:
+                for r in RATIOS:
+                    w = STRIDE * s * np.sqrt(r)
+                    h = STRIDE * s / np.sqrt(r)
+                    anchors.append([cx - w / 2, cy - h / 2,
+                                    cx + w / 2, cy + h / 2])
+    return np.asarray(anchors, np.float32)
+
+
+ANCHORS = make_anchors()
+
+
+def iou(boxes, gt):
+    """(N,4) x (M,4) -> (N,M)."""
+    ix1 = np.maximum(boxes[:, None, 0], gt[None, :, 0])
+    iy1 = np.maximum(boxes[:, None, 1], gt[None, :, 1])
+    ix2 = np.minimum(boxes[:, None, 2], gt[None, :, 2])
+    iy2 = np.minimum(boxes[:, None, 3], gt[None, :, 3])
+    iw = np.maximum(ix2 - ix1, 0)
+    ih = np.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_b = ((boxes[:, 2] - boxes[:, 0]) *
+              (boxes[:, 3] - boxes[:, 1]))[:, None]
+    area_g = ((gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1]))[None, :]
+    return inter / np.maximum(area_b + area_g - inter, 1e-9)
+
+
+def bbox_transform(rois, gt):
+    """Box -> regression deltas (rcnn/processing/bbox_transform.py)."""
+    rw = np.maximum(rois[:, 2] - rois[:, 0], 1.0)
+    rh = np.maximum(rois[:, 3] - rois[:, 1], 1.0)
+    rcx = rois[:, 0] + rw / 2
+    rcy = rois[:, 1] + rh / 2
+    gw = np.maximum(gt[:, 2] - gt[:, 0], 1.0)
+    gh = np.maximum(gt[:, 3] - gt[:, 1], 1.0)
+    gcx = gt[:, 0] + gw / 2
+    gcy = gt[:, 1] + gh / 2
+    return np.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     np.log(gw / rw), np.log(gh / rh)], axis=1)
+
+
+def anchor_target(gt):
+    """RPN training targets for one image (rcnn/io/rpn.py assign_anchor):
+    labels (A*F*F,) in {-1 ignore, 0 neg, 1 pos}; bbox targets/weights
+    (4A, F, F)."""
+    labels = np.full(len(ANCHORS), -1, np.float32)
+    targets = np.zeros((len(ANCHORS), 4), np.float32)
+    weights = np.zeros((len(ANCHORS), 4), np.float32)
+    if len(gt):
+        overlaps = iou(ANCHORS, gt[:, :4])
+        max_ov = overlaps.max(axis=1)
+        argmax = overlaps.argmax(axis=1)
+        labels[max_ov < 0.3] = 0
+        labels[max_ov >= 0.5] = 1
+        labels[overlaps.argmax(axis=0)] = 1  # best anchor per gt
+        pos = labels == 1
+        targets[pos] = bbox_transform(ANCHORS[pos], gt[argmax[pos], :4])
+        weights[pos] = 1.0
+    else:
+        labels[:] = 0
+    # (A*F*F,) per-position ordering -> (4A, F, F): anchors vary fastest
+    t = targets.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1)
+    w = weights.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1)
+    return labels.reshape(FEAT, FEAT, A).transpose(2, 0, 1).reshape(-1), t, w
+
+
+@mx.operator.register("proposal_target_demo")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    """Sample proposals vs gt into fixed-size RCNN training batches
+    (reference rcnn/symbol/proposal_target.py custom op)."""
+
+    def __init__(self, num_classes=str(NUM_CLASSES),
+                 batch_rois=str(ROI_BATCH)):
+        super().__init__(need_top_grad=False)
+        self.nc = int(num_classes)
+        self.br = int(batch_rois)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        return (in_shape,
+                [[self.br, 5], [self.br], [self.br, 4 * self.nc],
+                 [self.br, 4 * self.nc]], [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        prop = self
+
+        class Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                rois = in_data[0].asnumpy()        # (P, 5)
+                gt = in_data[1].asnumpy()          # (M, 5) padded with -1
+                gt = gt[gt[:, 4] >= 0]
+                # include gt boxes as proposals (reference behavior)
+                if len(gt):
+                    gt_rois = np.concatenate(
+                        [np.zeros((len(gt), 1), np.float32), gt[:, :4]], 1)
+                    rois = np.concatenate([rois, gt_rois], 0)
+                n = prop.br
+                labels = np.zeros(len(rois), np.float32)
+                targets = np.zeros((len(rois), 4), np.float32)
+                if len(gt):
+                    ov = iou(rois[:, 1:], gt[:, :4])
+                    mx_ov = ov.max(1)
+                    am = ov.argmax(1)
+                    fg = mx_ov >= 0.5
+                    labels[fg] = gt[am[fg], 4] + 1  # class ids 1..C-1
+                    targets[fg] = bbox_transform(rois[fg, 1:],
+                                                 gt[am[fg], :4])
+                # sample: up to n/4 fg, rest bg
+                fg_idx = np.where(labels > 0)[0]
+                bg_idx = np.where(labels == 0)[0]
+                rng = np.random
+                fg_take = fg_idx[rng.permutation(len(fg_idx))[:n // 4]]
+                need = n - len(fg_take)
+                bg_take = bg_idx[rng.permutation(len(bg_idx))[:need]]
+                take = np.concatenate([fg_take, bg_take])
+                if len(take) < n:   # wrap-pad
+                    take = np.concatenate(
+                        [take, take[:n - len(take)]] if len(take)
+                        else [np.zeros(n, np.int64)])
+                sr = rois[take].astype(np.float32)
+                sl = labels[take]
+                st = np.zeros((n, 4 * prop.nc), np.float32)
+                sw = np.zeros((n, 4 * prop.nc), np.float32)
+                for i, lab in enumerate(sl):
+                    c = int(lab)
+                    if c > 0:
+                        st[i, 4 * c:4 * c + 4] = targets[take[i]]
+                        sw[i, 4 * c:4 * c + 4] = 1.0
+                self.assign(out_data[0], req[0], mx.nd.array(sr))
+                self.assign(out_data[1], req[1], mx.nd.array(sl))
+                self.assign(out_data[2], req[2], mx.nd.array(st))
+                self.assign(out_data[3], req[3], mx.nd.array(sw))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                for i, g in enumerate(in_grad):
+                    self.assign(g, req[i], mx.nd.zeros(g.shape))
+
+        return Op()
+
+
+def build_symbol():
+    data = sym.var("data")
+    im_info = sym.var("im_info")
+    gt_boxes = sym.var("gt_boxes")
+    rpn_label = sym.var("rpn_label")
+    rpn_bbox_target = sym.var("rpn_bbox_target")
+    rpn_bbox_weight = sym.var("rpn_bbox_weight")
+
+    # backbone: 3 stride-2 convs -> stride 8 feature map
+    x = data
+    for i, nf in enumerate((16, 32, 64)):
+        x = sym.Convolution(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                            num_filter=nf, name="conv%d" % i)
+        x = sym.Activation(x, act_type="relu")
+    feat = x
+
+    # RPN head
+    rpn = sym.Activation(
+        sym.Convolution(feat, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                        name="rpn_conv"), act_type="relu")
+    rpn_cls_score = sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A,
+                                    name="rpn_cls_score")
+    rpn_bbox_pred = sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A,
+                                    name="rpn_bbox_pred")
+    score_reshape = sym.Reshape(rpn_cls_score, shape=(0, 2, -1, 0),
+                                name="rpn_cls_score_reshape")
+    rpn_cls_prob = sym.SoftmaxOutput(score_reshape, rpn_label,
+                                     multi_output=True, use_ignore=True,
+                                     ignore_label=-1, name="rpn_cls_prob")
+    rpn_bbox_loss = sym.MakeLoss(
+        sym.smooth_l1(rpn_bbox_weight *
+                      (rpn_bbox_pred - rpn_bbox_target), scalar=3.0) *
+        (1.0 / ROI_BATCH), name="rpn_bbox_loss")
+
+    # proposals (fixed post-NMS capacity) + target sampling custom op
+    prob_back = sym.Reshape(rpn_cls_prob, shape=(0, 2 * A, -1, FEAT),
+                            name="rpn_cls_prob_reshape")
+    rois = sym.Proposal(prob_back, rpn_bbox_pred, im_info,
+                        feature_stride=STRIDE, scales=SCALES,
+                        ratios=RATIOS, rpn_pre_nms_top_n=128,
+                        rpn_post_nms_top_n=POST_NMS, threshold=0.7,
+                        rpn_min_size=4, name="rois")
+    group = sym.Custom(rois, gt_boxes, op_type="proposal_target_demo",
+                       num_classes=str(NUM_CLASSES),
+                       batch_rois=str(ROI_BATCH), name="ptarget")
+    sampled_rois, label, bbox_target, bbox_weight = \
+        group[0], group[1], group[2], group[3]
+
+    # RCNN head
+    pooled = sym.ROIPooling(feat, sampled_rois, pooled_size=(4, 4),
+                            spatial_scale=1.0 / STRIDE, name="roi_pool")
+    flat = sym.Flatten(pooled)
+    fc = sym.Activation(sym.FullyConnected(flat, num_hidden=64,
+                                           name="fc6"), act_type="relu")
+    cls_score = sym.FullyConnected(fc, num_hidden=NUM_CLASSES,
+                                   name="cls_score")
+    bbox_pred = sym.FullyConnected(fc, num_hidden=4 * NUM_CLASSES,
+                                   name="bbox_pred")
+    cls_prob = sym.SoftmaxOutput(cls_score, label, name="cls_prob")
+    bbox_loss = sym.MakeLoss(
+        sym.smooth_l1(bbox_weight * (bbox_pred - bbox_target),
+                      scalar=1.0) * (1.0 / ROI_BATCH), name="bbox_loss")
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                      sym.BlockGrad(label)])
+
+
+def synth_image(rng):
+    """Image with 1-2 rectangles of class 0 (dark) / 1 (bright)."""
+    img = rng.uniform(0, 0.2, (3, IMG, IMG)).astype(np.float32)
+    boxes = []
+    for _ in range(rng.randint(1, 3)):
+        w, h = rng.randint(12, 32, 2)
+        x1 = rng.randint(0, IMG - w)
+        y1 = rng.randint(0, IMG - h)
+        cls = rng.randint(0, 2)
+        val = 0.5 if cls == 0 else 1.0
+        img[:, y1:y1 + h, x1:x1 + w] = val + \
+            rng.uniform(-0.05, 0.05, (3, h, w))
+        boxes.append([x1, y1, x1 + w, y1 + h, cls])
+    gt = np.full((4, 5), -1, np.float32)
+    gt[:len(boxes)] = np.asarray(boxes, np.float32)
+    return img, gt
+
+
+def train(args):
+    net = build_symbol()
+    ex = net.simple_bind(
+        ctx=mx.current_context(), grad_req="write",
+        data=(1, 3, IMG, IMG), im_info=(1, 3), gt_boxes=(4, 5),
+        rpn_label=(1, A * FEAT, FEAT),
+        rpn_bbox_target=(1, 4 * A, FEAT, FEAT),
+        rpn_bbox_weight=(1, 4 * A, FEAT, FEAT))
+    init = mx.init.Xavier()
+    data_names = {"data", "im_info", "gt_boxes", "rpn_label",
+                  "rpn_bbox_target", "rpn_bbox_weight"}
+    for name, arr in ex.arg_dict.items():
+        if name not in data_names:
+            init(mx.init.InitDesc(name), arr)
+
+    rng = np.random.RandomState(0)
+    im_info = np.asarray([[IMG, IMG, 1.0]], np.float32)
+    history = []
+    for it in range(args.num_iter):
+        img, gt = synth_image(rng)
+        labels, bt, bw = anchor_target(gt[gt[:, 4] >= 0])
+        outs = ex.forward(
+            is_train=True, data=mx.nd.array(img[None]),
+            im_info=mx.nd.array(im_info), gt_boxes=mx.nd.array(gt),
+            rpn_label=mx.nd.array(labels.reshape(1, A * FEAT, FEAT)),
+            rpn_bbox_target=mx.nd.array(bt[None]),
+            rpn_bbox_weight=mx.nd.array(bw[None]))
+        ex.backward()
+        for name, grad in ex.grad_dict.items():
+            if name in data_names:
+                continue
+            ex.arg_dict[name][:] = ex.arg_dict[name] - args.lr * grad
+        rpn_prob = outs[0].asnumpy()        # (1, 2, A*F*F) probs
+        rpn_lab = labels
+        probs = rpn_prob.reshape(2, -1)
+        valid = rpn_lab >= 0
+        rpn_nll = -np.log(np.maximum(
+            probs[rpn_lab[valid].astype(int), np.where(valid)[0]], 1e-9))
+        cls_lab = outs[4].asnumpy().astype(int)
+        cls_nll = -np.log(np.maximum(
+            outs[2].asnumpy()[np.arange(len(cls_lab)), cls_lab], 1e-9))
+        total = (rpn_nll.mean() + float(outs[1].asnumpy().sum()) +
+                 cls_nll.mean() + float(outs[3].asnumpy().sum()))
+        history.append(total)
+        if it % max(1, args.num_iter // 10) == 0:
+            print("iter %3d  rpn_cls %.3f  rpn_bbox %.4f  cls %.3f  "
+                  "bbox %.4f  total %.3f"
+                  % (it, rpn_nll.mean(), outs[1].asnumpy().sum(),
+                     cls_nll.mean(), outs[3].asnumpy().sum(), total))
+    first = np.mean(history[:5])
+    last = np.mean(history[-5:])
+    print("loss %.3f -> %.3f" % (first, last))
+    return first, last
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-iter", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    train(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -107,11 +107,17 @@ class SummaryWriter:
     """Minimal event-file writer with the tensorboardX API subset the
     reference callback uses (add_scalar/add_histogram/flush/close)."""
 
+    _seq = 0
+
     def __init__(self, logdir):
         os.makedirs(logdir, exist_ok=True)
-        fname = "events.out.tfevents.%d.mxnet_tpu" % int(time.time())
+        # pid + per-process counter uniquify concurrent writers in one
+        # logdir (tensorboardX embeds hostname+pid for the same reason)
+        SummaryWriter._seq += 1
+        fname = "events.out.tfevents.%d.%d.%d.mxnet_tpu" % (
+            int(time.time()), os.getpid(), SummaryWriter._seq)
         self._path = os.path.join(logdir, fname)
-        self._f = open(self._path, "ab")
+        self._f = open(self._path, "wb")
         self._write_event(Event(wall_time=time.time(),
                                 file_version="brain.Event:2"))
 

@@ -411,3 +411,30 @@ def get() -> Engine:
 def set_engine(engine: Engine):
     global _engine
     _engine = engine
+
+
+def _at_fork_child():
+    """Fork survival (reference initialize.cc:39-70 pthread_atfork: the
+    engine is stopped before fork and restarted in both processes so
+    fork-based DataLoader workers can't deadlock on dead worker threads).
+    Python threads don't survive fork, so the child must drop the
+    inherited singleton — the next get() builds a fresh engine."""
+    global _engine
+    _engine = None
+
+
+def _before_fork():
+    """Drain the queue so the child never sees half-scheduled vars."""
+    if _engine is not None:
+        try:
+            _engine.wait_for_all()
+        except Exception:
+            pass  # fork must not be blocked by a poisoned op
+
+
+try:
+    import os as _os
+    _os.register_at_fork(before=_before_fork,
+                         after_in_child=_at_fork_child)
+except (ImportError, AttributeError):  # non-POSIX: no fork, nothing to do
+    pass

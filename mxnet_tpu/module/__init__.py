@@ -4,3 +4,4 @@ from .module import Module
 from .executor_group import DataParallelExecutorGroup
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule

@@ -1,0 +1,163 @@
+"""Modules whose computation is defined in Python rather than a Symbol.
+
+Reference analog: ``python/mxnet/module/python_module.py`` (PythonModule
+at :28, PythonLossModule at :243) — the escape hatch used to splice
+host-side computations (custom losses, constraint projections) into a
+``SequentialModule`` chain while keeping the Module API contract.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base_module import BaseModule
+from ..initializer import Uniform
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """A convenient base for modules implemented in Python: parameter-free
+    by default, with shape bookkeeping handled here so subclasses only
+    override ``_compute_output_shapes`` (+ forward/backward)."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    # ---- names/shapes ---------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    # ---- params (none by default) ---------------------------------------
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if initializer is None:
+            initializer = Uniform(0.01)
+        self.params_initialized = True
+
+    def update(self):
+        """Parameter-free by default (reference python_module.py:134)."""
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        """Subclasses computing a loss typically skip metric updates
+        (reference: do nothing by default)."""
+
+    # ---- binding --------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._data_shapes = [tuple(s) if not isinstance(s, tuple) else s
+                             for s in data_shapes]
+        self._label_shapes = ([tuple(s) if not isinstance(s, tuple) else s
+                               for s in label_shapes]
+                              if label_shapes else None)
+        self._output_shapes = self._compute_output_shapes()
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Parameter-free modules have nothing to optimize."""
+
+
+class PythonLossModule(PythonModule):
+    """A loss layer as a module (reference python_module.py:243): forward
+    stores the input scores, backward produces the gradient via a
+    user-supplied function (or the default identity 'propagate what
+    backward() was given')."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names,
+                         [name + "_output"], logger=logger)
+        self._name = name
+        assert len(data_names) == 1
+        assert len(label_names) == 1
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        """The loss passes scores through (reference: output shape ==
+        data shape)."""
+        return [(self._name + "_output", self._data_shapes[0])]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train and data_batch.label:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, \
+            "PythonLossModule is a loss head: it originates gradients"
+        assert self.for_training
+        self._backward_impl()
+
+    def _backward_impl(self):
+        """Default gradient: d(scores)/dx of cross-entropy-with-softmax if
+        a grad_func was not supplied (reference leaves this to the user;
+        the softmax form is its documented example)."""
+        from .. import ndarray as nd
+        if self._grad_func is not None:
+            grad = self._grad_func(self._scores, self._labels)
+            if not isinstance(grad, nd.NDArray):
+                grad = nd.array(np.asarray(grad))
+            self._scores_grad = grad
+            return
+        scores = self._scores.asnumpy()
+        labels = self._labels.asnumpy().astype(np.int64).ravel()
+        prob = np.exp(scores - scores.max(axis=1, keepdims=True))
+        prob /= prob.sum(axis=1, keepdims=True)
+        prob[np.arange(len(labels)), labels] -= 1.0
+        self._scores_grad = nd.array(prob / len(labels))
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert merge_multi_context
+        return [self._scores_grad]
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()

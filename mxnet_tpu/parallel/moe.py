@@ -18,7 +18,6 @@ Layout (per shard_map block, E experts over ``n`` chips, local E_l = E/n):
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

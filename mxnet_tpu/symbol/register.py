@@ -7,7 +7,6 @@ inputs; everything else becomes node attrs.
 from __future__ import annotations
 
 from ..ops.registry import OPS
-from ..attribute import current_attrs
 from .symbol import Symbol, _create
 
 
@@ -55,16 +54,9 @@ def _make_fn(op_name):
                 sym_inputs = ins
             else:
                 sym_inputs.extend(kw_syms.values())
-        scope_attrs = current_attrs()
-        if attr:
-            scope_attrs.update(attr)
-        out = _create(op_name, sym_inputs, kwargs, name)
-        if scope_attrs:
-            for node, _ in out._outputs:
-                merged = dict(scope_attrs)
-                merged.update(node.attrs)
-                node.attrs = merged
-        return out
+        # attr precedence handled inside _create: op kwargs > explicit
+        # attr dict > AttrScope defaults
+        return _create(op_name, sym_inputs, kwargs, name, attr=attr)
 
     fn.__name__ = op_name
     fn.__qualname__ = op_name

@@ -464,7 +464,8 @@ def _norm_grad_req(grad_req, arg_names):
 # symbol creation
 # --------------------------------------------------------------------------
 def _create(op_name: str, sym_inputs: Sequence[Symbol],
-            kwargs: Dict[str, Any], name: Optional[str] = None) -> Symbol:
+            kwargs: Dict[str, Any], name: Optional[str] = None,
+            attr: Optional[Dict[str, str]] = None) -> Symbol:
     op = get_op(op_name)
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
     name = name or kwargs.pop("name", None)
@@ -500,14 +501,28 @@ def _create(op_name: str, sym_inputs: Sequence[Symbol],
             v = _Node(None, "%s_%s" % (name, argname), {}, [])
             entries.append((v, 0))
 
-    node = _Node(op, name, dict(kwargs), entries)
+    # AttrScope defaults (ctx_group, __lr_mult__, ...) apply to EVERY node
+    # created in scope — including operator-overload nodes (a * b) that
+    # don't route through the generated functions (reference: AttrScope
+    # applied in symbol creation C API).  Precedence: op kwargs > explicit
+    # attr dict > scope defaults.
+    from ..attribute import current_attrs
+    attrs = current_attrs()
+    if attr:
+        attrs.update(attr)
+    attrs.update(kwargs)
+    node = _Node(op, name, attrs, entries)
     nvis = node.num_visible()
     return Symbol([(node, i) for i in range(nvis)])
 
 
 def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs) -> Symbol:
-    attrs = dict(attr or {})
+    # scope defaults apply to variables too (reference AttrScope behavior:
+    # a var created in AttrScope(__lr_mult__=...) carries the attr)
+    from ..attribute import current_attrs
+    attrs = current_attrs()
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:

@@ -81,3 +81,49 @@ def test_group2ctx_module_api_accepted():
     it = mx.io.NDArrayIter(X, y, batch_size=8)
     mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
     assert dict(mod.score(it, "acc"))  # runs end to end
+
+
+def test_attr_scope_applies_to_operator_overloads():
+    """Regression: nodes created by operator overloads (a * b) inside an
+    AttrScope must inherit ctx_group like generated-function nodes do."""
+    with mx.AttrScope(ctx_group="g1"):
+        a = sym.var("a")
+        b = sym.var("b")
+        c = a * b + a
+    for node, _ in c._outputs:
+        assert node.attrs.get("ctx_group") == "g1"
+
+
+def test_model_parallel_lstm_example_converges():
+    """example/model-parallel/lstm trains with layers on 2 devices and
+    perplexity drops (parity: example/model-parallel/lstm)."""
+    import argparse
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "example", "model-parallel", "lstm",
+        "lstm.py")
+    spec = importlib.util.spec_from_file_location("mp_lstm_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    args = argparse.Namespace(num_layers=2, num_hidden=32, num_embed=16,
+                              vocab=32, seq_len=8, batch_size=32,
+                              num_epochs=3, lr=0.5)
+    ppl = mod.train(args)
+    assert ppl < 12.0, "model-parallel LSTM failed to learn: ppl %.1f" % ppl
+
+
+def test_attr_precedence_and_variable_scope():
+    """Op kwargs beat explicit attr dict; attr dict beats scope; variables
+    inherit scope attrs (reference AttrScope semantics)."""
+    with mx.AttrScope(ctx_group="g", __lr_mult__="0.0"):
+        v = sym.var("w")
+        fc = sym.FullyConnected(sym.var("x"), num_hidden=10,
+                                attr={"num_hidden": "20",
+                                      "ctx_group": "override"})
+    assert v._outputs[0][0].attrs["__lr_mult__"] == "0.0"
+    assert v._outputs[0][0].attrs["ctx_group"] == "g"
+    node = fc._outputs[0][0]
+    # the op parameter must NOT be clobbered by the attr dict
+    assert node.parsed_attrs()["num_hidden"] == 10
+    assert node.attrs["ctx_group"] == "override"

@@ -145,3 +145,71 @@ def test_fixed_params():
     mod.update()
     w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
     assert np.allclose(w_before, w_after)
+
+
+def test_python_loss_module():
+    """PythonModule/PythonLossModule parity (reference module/
+    python_module.py): a Python-defined loss head produces softmax-CE
+    gradients through the Module API."""
+    from mxnet_tpu.module.python_module import PythonLossModule
+    m = PythonLossModule()
+    m.bind(data_shapes=[(4, 3)], label_shapes=[(4,)])
+    m.init_params()
+    assert m.output_shapes == [("pyloss_output", (4, 3))]
+    scores = nd.array(np.array([[2.0, 1.0, 0.0]] * 4, np.float32))
+    labels = nd.array(np.array([0, 1, 2, 0], np.float32))
+
+    class Batch:
+        data = [scores]
+        label = [labels]
+
+    m.for_training = True
+    m.forward(Batch(), is_train=True)
+    assert m.get_outputs()[0] is scores
+    m.backward()
+    g = m.get_input_grads()[0].asnumpy()
+    prob = np.exp([2.0, 1.0, 0.0]); prob /= prob.sum()
+    expect = np.tile(prob, (4, 1))
+    for i, lab in enumerate([0, 1, 2, 0]):
+        expect[i, lab] -= 1.0
+    np.testing.assert_allclose(g, expect / 4, rtol=1e-5)
+    # custom grad_func path
+    m2 = PythonLossModule(grad_func=lambda s, l: s * 0 + 1)
+    m2.bind(data_shapes=[(4, 3)], label_shapes=[(4,)])
+    m2.for_training = True
+    m2.forward(Batch(), is_train=True)
+    m2.backward()
+    np.testing.assert_allclose(m2.get_input_grads()[0].asnumpy(),
+                               np.ones((4, 3)), rtol=1e-6)
+
+
+def test_engine_fork_survival():
+    """Fork lifecycle (reference initialize.cc pthread_atfork): a child
+    process gets a fresh engine and can run ops without deadlocking."""
+    import multiprocessing as mp
+    import mxnet_tpu.engine as engine
+
+    eng = engine.get()
+    v = eng.new_variable("fork_test")
+    eng.push(lambda: None, mutable_vars=(v,))
+    eng.wait_for_var(v)
+
+    def child(q):
+        try:
+            e2 = engine.get()
+            v2 = e2.new_variable("child_var")
+            results = []
+            for i in range(10):
+                e2.push(lambda i=i: results.append(i), mutable_vars=(v2,))
+            e2.wait_for_var(v2)
+            q.put(results == list(range(10)))
+        except Exception as exc:  # pragma: no cover
+            q.put(str(exc))
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=child, args=(q,))
+    p.start()
+    ok = q.get(timeout=60)
+    p.join(timeout=60)
+    assert ok is True, ok

@@ -1,0 +1,27 @@
+"""Faster R-CNN end-to-end example (parity: example/rcnn/train_end2end.py
+— exercises Proposal, ROIPooling, SoftmaxOutput ignore labels, smooth_l1,
+and the ProposalTarget custom-op bridge in one training graph)."""
+import argparse
+import importlib.util
+import os
+
+import numpy as np
+
+
+def _module():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "..", "example", "rcnn",
+        "train_end2end.py")
+    spec = importlib.util.spec_from_file_location("rcnn_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rcnn_end2end_loss_drops():
+    np.random.seed(0)
+    mod = _module()
+    first, last = mod.train(argparse.Namespace(num_iter=40, lr=0.02))
+    assert np.isfinite(last)
+    assert last < first * 0.8, \
+        "rcnn loss did not drop: %.3f -> %.3f" % (first, last)
