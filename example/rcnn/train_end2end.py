@@ -166,10 +166,10 @@ class ProposalTargetProp(mx.operator.CustomOpProp):
                 need = n - len(fg_take)
                 bg_take = bg_idx[rng.permutation(len(bg_idx))[:need]]
                 take = np.concatenate([fg_take, bg_take])
-                if len(take) < n:   # wrap-pad
-                    take = np.concatenate(
-                        [take, take[:n - len(take)]] if len(take)
-                        else [np.zeros(n, np.int64)])
+                if not len(take):
+                    take = np.zeros(n, np.int64)
+                while len(take) < n:   # wrap-pad until the batch is full
+                    take = np.concatenate([take, take[:n - len(take)]])
                 sr = rois[take].astype(np.float32)
                 sl = labels[take]
                 st = np.zeros((n, 4 * prop.nc), np.float32)

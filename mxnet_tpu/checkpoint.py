@@ -34,7 +34,8 @@ def _as_pytree(obj) -> Dict[str, jax.Array]:
     if hasattr(obj, "items"):
         out = {}
         for k, v in obj.items():
-            if hasattr(v, "data"):          # Parameter
+            # Parameter (callable .data) — NOT numpy's .data memoryview
+            if hasattr(v, "data") and callable(getattr(v, "data")):
                 v = v.data()
             out[k] = v._data if isinstance(v, NDArray) else jax.numpy.asarray(v)
         return out

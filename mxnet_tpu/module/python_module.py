@@ -12,7 +12,6 @@ import logging
 import numpy as np
 
 from .base_module import BaseModule
-from ..initializer import Uniform
 
 __all__ = ["PythonModule", "PythonLossModule"]
 
@@ -60,8 +59,7 @@ class PythonModule(BaseModule):
     def init_params(self, initializer=None, arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
                     allow_extra=False):
-        if initializer is None:
-            initializer = Uniform(0.01)
+        """Parameter-free: nothing to initialize, just flip the flag."""
         self.params_initialized = True
 
     def update(self):
@@ -81,10 +79,14 @@ class PythonModule(BaseModule):
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
-        self._data_shapes = [tuple(s) if not isinstance(s, tuple) else s
-                             for s in data_shapes]
-        self._label_shapes = ([tuple(s) if not isinstance(s, tuple) else s
-                               for s in label_shapes]
+
+        def plain(s):
+            # entries may be DataDesc namedtuples (io.provide_data) — keep
+            # only the bare shape (reference extracts .shape too)
+            return tuple(s.shape) if hasattr(s, "shape") else tuple(s)
+
+        self._data_shapes = [plain(s) for s in data_shapes]
+        self._label_shapes = ([plain(s) for s in label_shapes]
                               if label_shapes else None)
         self._output_shapes = self._compute_output_shapes()
 

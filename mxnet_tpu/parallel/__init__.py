@@ -15,3 +15,5 @@ from . import pipeline
 from .pipeline import Pipeline, pipeline_apply
 from . import moe
 from .moe import moe_ffn, top_k_gating, init_moe_params
+from . import elastic
+from .elastic import ElasticRunner, run_elastic

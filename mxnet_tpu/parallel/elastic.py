@@ -1,0 +1,159 @@
+"""Elastic training: failure detection + checkpoint-based restart.
+
+Reference analog: essentially absent in the reference (SURVEY.md §5.3 —
+ps-lite gives node roles but no in-tree elastic recovery; the documented
+story is "reload last epoch checkpoint manually", common/fit.py:56-66).
+This module goes beyond parity with a torchelastic-style supervisor for
+TPU training:
+
+  * :class:`ElasticRunner` — a single-host supervisor that launches N
+    worker processes (a fake cluster, the tests/nightly pattern; on real
+    pods the same contract is fulfilled by the cluster scheduler), detects
+    worker death, and relaunches the *whole* gang with a bumped restart
+    generation.  Synchronous SPMD training cannot survive losing a member
+    (every collective is global), so gang restart + resume is the correct
+    semantic — the same decision torchelastic made.
+  * :func:`run_elastic` — the worker-side loop: restore the latest
+    checkpoint if one exists, run ``train_fn`` from that step, write
+    periodic checkpoints via orbax (``mxnet_tpu.checkpoint``).
+  * :func:`latest_checkpoint` / :func:`save_step` — step-numbered
+    checkpoint bookkeeping shared by both sides.
+
+The supervisor/worker contract is environment-based (MXNET_ELASTIC_*),
+mirroring how DMLC_* variables drive the dist kvstore.
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["ElasticRunner", "run_elastic", "latest_checkpoint",
+           "save_step"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def save_step(ckpt_dir: str, step: int, params) -> str:
+    """Write a step-numbered sharded checkpoint; returns its path."""
+    from ..checkpoint import save_sharded
+    path = os.path.join(ckpt_dir, "step_%d" % step)
+    save_sharded(path, params, force=True)
+    return path
+
+
+def latest_checkpoint(ckpt_dir: str):
+    """(step, path) of the newest complete checkpoint, or (None, None)."""
+    if not os.path.isdir(ckpt_dir):
+        return None, None
+    best = None
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m:
+            step = int(m.group(1))
+            if best is None or step > best:
+                best = step
+    if best is None:
+        return None, None
+    return best, os.path.join(ckpt_dir, "step_%d" % best)
+
+
+def run_elastic(train_fn: Callable, ckpt_dir: str, total_steps: int):
+    """Worker-side elastic loop.
+
+    ``train_fn(start_step, total_steps, save, restored)`` runs training
+    from ``start_step``; ``restored`` is the params tree of the latest
+    checkpoint (None on a fresh start); the loop should call
+    ``save(step, params)`` periodically (the closure handles the
+    step-numbered directory layout) and return its final params.  On
+    entry the latest checkpoint (if any) decides ``start_step`` — a
+    relaunched worker resumes instead of restarting from scratch.
+
+    Returns (start_step, final_params).
+    """
+    from ..checkpoint import load_sharded
+    step, path = latest_checkpoint(ckpt_dir)
+    start = 0
+    restored = None
+    if step is not None:
+        restored = load_sharded(path)
+        start = step
+
+    def save(step, params):
+        save_step(ckpt_dir, step, params)
+
+    final = train_fn(start, total_steps, save, restored)
+    return start, final
+
+
+class ElasticRunner:
+    """Single-host gang supervisor (fake-cluster pattern).
+
+    Launches ``nworkers`` copies of ``cmd`` with rank env vars, watches
+    for failures, and relaunches the whole gang (with
+    ``MXNET_ELASTIC_RESTART`` bumped) until the gang exits cleanly or
+    ``max_restarts`` is exhausted.  Worker processes coordinate through
+    ``jax.distributed``/kvstore exactly as a normal run; recovery state
+    travels only through the checkpoint directory.
+    """
+
+    def __init__(self, cmd: Sequence[str], nworkers: int,
+                 max_restarts: int = 3, env: Optional[dict] = None,
+                 poll_interval: float = 0.2):
+        self.cmd = list(cmd)
+        self.nworkers = nworkers
+        self.max_restarts = max_restarts
+        self.env = dict(env or os.environ)
+        self.poll_interval = poll_interval
+        self.restarts = 0
+
+    def _launch(self) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(self.nworkers):
+            env = dict(self.env)
+            env.update({
+                "MXNET_ELASTIC_RANK": str(rank),
+                "MXNET_ELASTIC_NWORKERS": str(self.nworkers),
+                "MXNET_ELASTIC_RESTART": str(self.restarts),
+            })
+            procs.append(subprocess.Popen(self.cmd, env=env))
+        return procs
+
+    def _reap(self, procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def run(self) -> int:
+        """Supervise until clean exit; returns total restart count.
+        Raises RuntimeError when max_restarts is exhausted."""
+        while True:
+            procs = self._launch()
+            failed = False
+            while True:
+                codes = [p.poll() for p in procs]
+                if any(c not in (None, 0) for c in codes):
+                    failed = True  # a member died: the gang is lost
+                    break
+                if all(c == 0 for c in codes):
+                    break
+                time.sleep(self.poll_interval)
+            if not failed:
+                return self.restarts
+            self._reap(procs)
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                raise RuntimeError(
+                    "elastic training failed: %d restarts exhausted"
+                    % self.max_restarts)

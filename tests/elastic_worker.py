@@ -1,0 +1,53 @@
+"""Worker for the elastic-training test (parity model: beyond-reference
+§5.3 — checkpoint-resume under supervised gang restart).
+
+Trains a tiny linear regression; on restart generation 0, rank 0 kills
+itself partway through (simulated hardware failure).  The relaunched gang
+must resume from the latest checkpoint, not step 0.  Each incarnation
+appends "rank start_step gen" to progress.log for the test to assert on.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.parallel.elastic import run_elastic  # noqa: E402
+
+CKPT = sys.argv[1]
+TOTAL = int(sys.argv[2])
+FAIL_AT = int(sys.argv[3])
+
+RANK = int(os.environ["MXNET_ELASTIC_RANK"])
+GEN = int(os.environ["MXNET_ELASTIC_RESTART"])
+
+
+def train_fn(start, total, save, restored):
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    true_w = np.asarray([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = X @ true_w
+
+    w = restored["w"] if restored else jnp.zeros((4,), jnp.float32)
+    with open(os.path.join(CKPT, "progress.log"), "a") as f:
+        f.write("%d %d %d\n" % (RANK, start, GEN))
+    for step in range(start, total):
+        grad = X.T @ (np.asarray(w) @ X.T - y) / len(X)
+        w = w - 0.1 * jnp.asarray(grad)
+        if RANK == 0 and (step + 1) % 5 == 0:
+            save(step + 1, {"w": w})
+        if GEN == 0 and RANK == 0 and step + 1 == FAIL_AT:
+            os._exit(1)  # simulated failure AFTER a checkpoint exists
+    if RANK == 0:
+        loss = float(((np.asarray(w) @ X.T - y) ** 2).mean())
+        with open(os.path.join(CKPT, "final.txt"), "w") as f:
+            f.write("%g\n" % loss)
+    return {"w": w}
+
+
+run_elastic(train_fn, CKPT, TOTAL)

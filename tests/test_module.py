@@ -213,3 +213,15 @@ def test_engine_fork_survival():
     ok = q.get(timeout=60)
     p.join(timeout=60)
     assert ok is True, ok
+
+
+def test_python_module_datadesc_shapes():
+    """bind() with DataDesc entries (provide_data) keeps bare shapes
+    (regression: the whole DataDesc leaked into output_shapes)."""
+    from mxnet_tpu.io import DataDesc
+    from mxnet_tpu.module.python_module import PythonLossModule
+    m = PythonLossModule()
+    m.bind(data_shapes=[DataDesc("data", (4, 3))],
+           label_shapes=[DataDesc("softmax_label", (4,))])
+    assert m.data_shapes == [(4, 3)]
+    assert m.output_shapes == [("pyloss_output", (4, 3))]
