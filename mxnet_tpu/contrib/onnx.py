@@ -50,6 +50,9 @@ def _attrs_of(node) -> dict:
             out[name] = tuple(int(x) for x in a.ints)
         if len(getattr(a, "floats", ())):
             out[name] = tuple(float(x) for x in a.floats)
+        if len(getattr(a, "strings", ())):
+            out[name] = tuple(s.decode() if isinstance(s, bytes) else s
+                              for s in a.strings)
     return out
 
 
@@ -102,9 +105,19 @@ def import_graph(graph):
         params[init.name] = _tensor_to_np(init)
 
     env: Dict[str, object] = {}
+    declared: Dict[str, tuple] = {}   # static shapes from ValueInfos
+    for vi in (list(graph.input) + list(graph.output) +
+               list(getattr(graph, "value_info", ()) or ())):
+        if vi.type is None or vi.type.tensor_type is None or \
+                vi.type.tensor_type.shape is None:
+            continue
+        dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+        if dims and all(d > 0 for d in dims):
+            declared[vi.name] = dims
     for inp in graph.input:
         if inp.name not in params:
-            env[inp.name] = S.var(inp.name)
+            env[inp.name] = S.var(inp.name,
+                                  shape=declared.get(inp.name))
     for name in params:
         env[name] = S.var(name, shape=params[name].shape)
 
@@ -227,7 +240,8 @@ def import_graph(graph):
         if len(node.input) > 1:
             pads = tuple(int(x) for x in const_input(node, 1, "pads"))
             if len(node.input) > 2 and node.input[2]:
-                value = float(const_input(node, 2, "constant_value"))
+                value = float(np.asarray(
+                    const_input(node, 2, "constant_value")).ravel()[0])
         else:
             pads = attrs.get("pads", attrs.get("paddings"))
         n = len(pads) // 2
@@ -381,6 +395,140 @@ def import_graph(graph):
     def unary(op_name):
         return lambda n: getattr(S, op_name)(env[n.input[0]])
 
+    def one_hot(node):
+        attrs = _attrs_of(node)
+        axis = attrs.get("axis", -1)
+        if axis != -1:
+            raise MXNetError("ONNX OneHot with axis != -1 unsupported")
+        depth = int(np.asarray(
+            const_input(node, 1, "depth")).ravel()[0])
+        oh = S.one_hot(env[node.input[0]], depth=depth)
+        if len(node.input) > 2 and node.input[2]:
+            off, on = np.asarray(
+                const_input(node, 2, "values")).ravel()[:2]
+            if float(off) != 0.0 or float(on) != 1.0:
+                oh = oh * (float(on) - float(off)) + float(off)
+        return oh
+
+    def reduce_logsumexp(node):
+        """Numerically stable: m + log(sum(exp(x - m)))."""
+        attrs = _attrs_of(node)
+        axes = axes_of(node, attrs)
+        keepdims = bool(attrs.get("keepdims", 1))
+        x = env[node.input[0]]
+        m = getattr(S, "max")(x, axis=axes, keepdims=True)
+        s = getattr(S, "sum")(S.exp(S.broadcast_sub(x, m)), axis=axes,
+                              keepdims=True)
+        out = S.broadcast_add(m, S.log(s))
+        if not keepdims:
+            out = S.squeeze(out, axis=axes)
+        return out
+
+    def onnx_rnn(mode):
+        """ONNX RNN/GRU/LSTM -> the fused RNN op (ops/rnn.py).
+
+        Covers forward and bidirectional single-layer cells with constant
+        weights; gate orders are remapped (ONNX LSTM iofc -> ifgo, GRU
+        zrh -> rzn).  B (batch) must be statically known — from
+        ``initial_h`` or the declared input ValueInfo — to synthesize
+        zero initial states.
+        """
+        def f(node):
+            attrs = _attrs_of(node)
+            h = int(attrs["hidden_size"])
+            direction = attrs.get("direction", "forward")
+            if direction == "reverse":
+                raise MXNetError("ONNX %s direction=reverse unsupported "
+                                 "(forward/bidirectional only)" % mode)
+            bidir = direction == "bidirectional"
+            dirs = 2 if bidir else 1
+            if mode == "GRU" and attrs.get("linear_before_reset", 0) == 0:
+                raise MXNetError("ONNX GRU linear_before_reset=0 "
+                                 "unsupported (cuDNN variant only)")
+            W = const_input(node, 1, "W")       # (dirs, ng*h, in)
+            R = const_input(node, 2, "R")       # (dirs, ng*h, h)
+            ng = {"RNN": 1, "GRU": 3, "LSTM": 4}[mode]
+            Bp = (const_input(node, 3, "B")
+                  if len(node.input) > 3 and node.input[3]
+                  else np.zeros((dirs, 2 * ng * h), np.float32))
+            if len(node.input) > 4 and node.input[4]:
+                raise MXNetError("ONNX %s with sequence_lens input "
+                                 "unsupported (fixed-length only)" % mode)
+            if mode == "LSTM" and len(node.input) > 7 and node.input[7]:
+                raise MXNetError("ONNX LSTM with peephole weights (P) "
+                                 "unsupported")
+
+            def reorder(mat, axis):
+                if mode == "LSTM":      # iofc -> ifgo (g = c)
+                    order = [0, 2, 3, 1]
+                elif mode == "GRU":     # zrh -> rzn
+                    order = [1, 0, 2]
+                else:
+                    return mat
+                parts = np.split(mat, ng, axis=axis)
+                return np.concatenate([parts[i] for i in order],
+                                      axis=axis)
+
+            flat = []
+            for d in range(dirs):
+                flat.append(reorder(W[d], 0).ravel())
+                flat.append(reorder(R[d], 0).ravel())
+            for d in range(dirs):
+                bW, bR = Bp[d][:ng * h], Bp[d][ng * h:]
+                flat.append(reorder(bW, 0))
+                flat.append(reorder(bR, 0))
+            pname = (node.name or node.output[0]) + "_packed"
+            params[pname] = np.concatenate(flat).astype(np.float32)
+            env[pname] = S.var(pname, shape=params[pname].shape)
+
+            # initial states: inputs 5 (h) / 6 (c), else zeros with the
+            # statically-declared batch
+            def state(idx, what):
+                if len(node.input) > idx and node.input[idx]:
+                    return env[node.input[idx]]
+                xshape = declared.get(node.input[0])
+                if xshape is None or len(xshape) != 3:
+                    raise MXNetError(
+                        "ONNX %s without %s needs a static input shape "
+                        "to synthesize zero states" % (mode, what))
+                sname = "%s_%s0" % (node.name or node.output[0], what)
+                params[sname] = np.zeros((dirs, xshape[1], h), np.float32)
+                env[sname] = S.var(sname, shape=params[sname].shape)
+                return env[sname]
+
+            ins = [env[node.input[0]], env[pname], state(5, "h")]
+            mx_mode = {"RNN": "rnn_tanh", "GRU": "gru",
+                       "LSTM": "lstm"}[mode]
+            if mode == "RNN":
+                acts = attrs.get("activations", ("Tanh",))
+                act = acts[0] if isinstance(acts, (tuple, list)) else acts
+                if isinstance(act, bytes):
+                    act = act.decode()
+                if act == "Relu":
+                    mx_mode = "rnn_relu"
+                elif act != "Tanh":
+                    raise MXNetError("ONNX RNN activation %r unsupported"
+                                     % (act,))
+            if mode == "LSTM":
+                ins.append(state(6, "c"))
+            out = S.RNN(*ins, state_size=h, num_layers=1,
+                        bidirectional=bidir, mode=mx_mode,
+                        state_outputs=True,
+                        name=node.name or node.output[0])
+            # ONNX Y is (T, dirs, B, h); ours is (T, B, dirs*h)
+            y = out[0]
+            if bidir:
+                y = S.transpose(S.Reshape(y, shape=(0, 0, 2, -1)),
+                                axes=(0, 2, 1, 3))
+            else:
+                y = S.expand_dims(y, axis=1)
+            env[node.output[0]] = y
+            for i, oname in enumerate(node.output[1:], start=1):
+                if oname:
+                    env[oname] = out[i]
+            return None  # outputs registered explicitly above
+        return f
+
     simple = {
         # activations
         "Relu": lambda n: S.Activation(env[n.input[0]], act_type="relu"),
@@ -468,6 +616,50 @@ def import_graph(graph):
         "Upsample": upsample,
         "Constant": constant,
         "ImageScaler": image_scaler,
+        # recurrent
+        "RNN": onnx_rnn("RNN"),
+        "GRU": onnx_rnn("GRU"),
+        "LSTM": onnx_rnn("LSTM"),
+        # comparison / logical (float outputs, mxnet convention)
+        "Equal": lambda n: S.broadcast_equal(env[n.input[0]],
+                                             env[n.input[1]]),
+        "Greater": lambda n: S.broadcast_greater(env[n.input[0]],
+                                                 env[n.input[1]]),
+        "Less": lambda n: S.broadcast_lesser(env[n.input[0]],
+                                             env[n.input[1]]),
+        "And": lambda n: S.broadcast_logical_and(env[n.input[0]],
+                                                 env[n.input[1]]),
+        "Or": lambda n: S.broadcast_logical_or(env[n.input[0]],
+                                               env[n.input[1]]),
+        "Not": unary("logical_not"),
+        "Where": lambda n: S.where(env[n.input[0]], env[n.input[1]],
+                                   env[n.input[2]]),
+        # more activations / elementwise
+        "Softsign": unary("softsign"),
+        "Erf": unary("erf"),
+        "Expand": lambda n: S.broadcast_to(
+            env[n.input[0]],
+            shape=tuple(int(x) for x in const_input(n, 1, "shape"))),
+        "OneHot": one_hot,
+        "DepthToSpace": lambda n: S.depth_to_space(
+            env[n.input[0]], block_size=_attrs_of(n)["blocksize"]),
+        "SpaceToDepth": lambda n: S.space_to_depth(
+            env[n.input[0]], block_size=_attrs_of(n)["blocksize"]),
+        "ArgMin": lambda n: S.argmin(
+            env[n.input[0]], axis=_attrs_of(n).get("axis", 0),
+            keepdims=bool(_attrs_of(n).get("keepdims", 1))),
+        "ReduceL1": lambda n: S.norm(
+            env[n.input[0]], ord=1, axis=axes_of(n, _attrs_of(n)),
+            keepdims=bool(_attrs_of(n).get("keepdims", 1))),
+        "ReduceL2": lambda n: S.norm(
+            env[n.input[0]], ord=2, axis=axes_of(n, _attrs_of(n)),
+            keepdims=bool(_attrs_of(n).get("keepdims", 1))),
+        "ReduceLogSumExp": reduce_logsumexp,
+        "ReduceSumSquare": lambda n: getattr(S, "sum")(
+            S.square(env[n.input[0]]) if hasattr(S, "square")
+            else env[n.input[0]] * env[n.input[0]],
+            axis=axes_of(n, _attrs_of(n)),
+            keepdims=bool(_attrs_of(n).get("keepdims", 1))),
     }
 
     for node in graph.node:
@@ -476,6 +668,8 @@ def import_graph(graph):
             raise MXNetError("unsupported ONNX op %r (supported: %s)"
                              % (node.op_type, sorted(simple)))
         out_sym = fn(node)
+        if out_sym is None:
+            continue  # converter registered its outputs in env itself
         avail = len(out_sym.list_outputs())
         for i, oname in enumerate(node.output):
             if i >= avail:

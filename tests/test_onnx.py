@@ -386,3 +386,228 @@ def test_pad_constant_value_input():
     out = ex.forward(is_train=False,
                      x=nd.array(np.ones((2, 2), np.float32)))[0].asnumpy()
     np.testing.assert_array_equal(out[:, -1], np.full((2,), 5.0))
+
+
+def _np_lstm(X, W, R, B, h0=None, c0=None):
+    """Numpy ONNX-semantics LSTM (iofc gate order), forward dir."""
+    T, Bn, _ = X.shape
+    H = R.shape[2]
+    Wi, Wo, Wf, Wc = np.split(W[0], 4, axis=0)
+    Ri, Ro, Rf, Rc = np.split(R[0], 4, axis=0)
+    bW = B[0][:4 * H]
+    bR = B[0][4 * H:]
+    bWi, bWo, bWf, bWc = np.split(bW, 4)
+    bRi, bRo, bRf, bRc = np.split(bR, 4)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((Bn, H), np.float32) if h0 is None else h0[0]
+    c = np.zeros((Bn, H), np.float32) if c0 is None else c0[0]
+    ys = []
+    for t in range(T):
+        x = X[t]
+        i = sig(x @ Wi.T + bWi + h @ Ri.T + bRi)
+        o = sig(x @ Wo.T + bWo + h @ Ro.T + bRo)
+        f = sig(x @ Wf.T + bWf + h @ Rf.T + bRf)
+        g = np.tanh(x @ Wc.T + bWc + h @ Rc.T + bRc)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        ys.append(h.copy())
+    return np.stack(ys)[:, None], h[None], c[None]
+
+
+def test_onnx_lstm_import():
+    rng = np.random.RandomState(0)
+    T, B, I, H = 5, 3, 4, 6
+    W = rng.randn(1, 4 * H, I).astype(np.float32) * 0.5
+    R = rng.randn(1, 4 * H, H).astype(np.float32) * 0.5
+    Bb = rng.randn(1, 8 * H).astype(np.float32) * 0.1
+    X = rng.randn(T, B, I).astype(np.float32)
+    ref_y, ref_h, ref_c = _np_lstm(X, W, R, Bb)
+
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="LSTM", input=["x", "W", "R", "B"],
+                          output=["y", "yh", "yc"], name="lstm",
+                          attribute=[onnx_mx._attr("hidden_size", H)])],
+        initializer=[onnx_mx._np_to_tensor("W", W),
+                     onnx_mx._np_to_tensor("R", R),
+                     onnx_mx._np_to_tensor("B", Bb)],
+        input=[onnx_mx._vi("x", (T, B, I))],
+        output=[onnx_mx._vi("y", (T, 1, B, H))])
+    sym, args, auxs = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(T, B, I))
+    ex.copy_params_from(args, auxs)
+    got = ex.forward(is_train=False, x=nd.array(X))[0].asnumpy()
+    np.testing.assert_allclose(got, ref_y, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_gru_import_and_multi_output():
+    """GRU (zrh -> rzn remap, linear_before_reset=1) with Y_h consumed."""
+    rng = np.random.RandomState(1)
+    T, B, I, H = 4, 2, 3, 5
+    W = rng.randn(1, 3 * H, I).astype(np.float32) * 0.5
+    R = rng.randn(1, 3 * H, H).astype(np.float32) * 0.5
+    Bb = rng.randn(1, 6 * H).astype(np.float32) * 0.1
+    X = rng.randn(T, B, I).astype(np.float32)
+
+    # numpy ONNX GRU, linear_before_reset=1
+    Wz, Wr, Wh = np.split(W[0], 3, axis=0)
+    Rz, Rr, Rh = np.split(R[0], 3, axis=0)
+    bWz, bWr, bWh = np.split(Bb[0][:3 * H], 3)
+    bRz, bRr, bRh = np.split(Bb[0][3 * H:], 3)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        x = X[t]
+        z = sig(x @ Wz.T + bWz + h @ Rz.T + bRz)
+        r = sig(x @ Wr.T + bWr + h @ Rr.T + bRr)
+        n = np.tanh(x @ Wh.T + bWh + r * (h @ Rh.T + bRh))
+        h = (1 - z) * n + z * h
+    ref_h = h[None]
+
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="GRU", input=["x", "W", "R", "B"],
+                          output=["y", "yh"], name="gru",
+                          attribute=[onnx_mx._attr("hidden_size", H),
+                                     onnx_mx._attr(
+                                         "linear_before_reset", 1)])],
+        initializer=[onnx_mx._np_to_tensor("W", W),
+                     onnx_mx._np_to_tensor("R", R),
+                     onnx_mx._np_to_tensor("B", Bb)],
+        input=[onnx_mx._vi("x", (T, B, I))],
+        output=[onnx_mx._vi("yh", (1, B, H))])
+    sym, args, auxs = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(T, B, I))
+    ex.copy_params_from(args, auxs)
+    got = ex.forward(is_train=False, x=nd.array(X))[0].asnumpy()
+    np.testing.assert_allclose(got, ref_h, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_lstm_bidirectional_shape():
+    rng = np.random.RandomState(2)
+    T, B, I, H = 3, 2, 4, 5
+    W = rng.randn(2, 4 * H, I).astype(np.float32) * 0.4
+    R = rng.randn(2, 4 * H, H).astype(np.float32) * 0.4
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="LSTM", input=["x", "W", "R"],
+                          output=["y"], name="bilstm",
+                          attribute=[onnx_mx._attr("hidden_size", H),
+                                     onnx_mx._attr("direction",
+                                                   "bidirectional")])],
+        initializer=[onnx_mx._np_to_tensor("W", W),
+                     onnx_mx._np_to_tensor("R", R)],
+        input=[onnx_mx._vi("x", (T, B, I))],
+        output=[onnx_mx._vi("y", (T, 2, B, H))])
+    sym, args, auxs = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(T, B, I))
+    ex.copy_params_from(args, auxs)
+    X = rng.randn(T, B, I).astype(np.float32)
+    got = ex.forward(is_train=False, x=nd.array(X))[0].asnumpy()
+    assert got.shape == (T, 2, B, H)
+    assert np.isfinite(got).all()
+
+
+def test_onnx_misc_new_converters():
+    """Where/comparison/Expand/OneHot/reductions import and run."""
+    g = P.GraphProto(
+        name="g",
+        node=[
+            P.NodeProto(op_type="Greater", input=["x", "y"], output=["m"]),
+            P.NodeProto(op_type="Where", input=["m", "x", "y"],
+                        output=["w"]),
+            P.NodeProto(op_type="ReduceL2", input=["w"], output=["out"],
+                        attribute=[onnx_mx._attr("axes", (1,)),
+                                   onnx_mx._attr("keepdims", 0)]),
+        ],
+        input=[onnx_mx._vi("x", (2, 3)), onnx_mx._vi("y", (2, 3))],
+        output=[onnx_mx._vi("out", (2,))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 3),
+                         y=(2, 3))
+    x = np.array([[1, -2, 3], [0, 5, -6]], np.float32)
+    y = np.array([[0, 0, 4], [1, 1, 1]], np.float32)
+    got = ex.forward(is_train=False, x=nd.array(x),
+                     y=nd.array(y))[0].asnumpy()
+    expect = np.linalg.norm(np.where(x > y, x, y), axis=1)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_onnx_rnn_relu_activation():
+    """STRINGS-typed activations attribute parses; Relu RNN computes relu
+    recurrences (regression: silently imported as tanh)."""
+    rng = np.random.RandomState(3)
+    T, B, I, H = 3, 2, 3, 4
+    W = np.abs(rng.randn(1, H, I)).astype(np.float32)
+    R = np.abs(rng.randn(1, H, H)).astype(np.float32) * 0.1
+    a = P.AttributeProto(name="activations", strings=[b"Relu"],
+                         type=P.AttributeProto.STRINGS)
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="RNN", input=["x", "W", "R"],
+                          output=["y"], name="rnn",
+                          attribute=[onnx_mx._attr("hidden_size", H), a])],
+        initializer=[onnx_mx._np_to_tensor("W", W),
+                     onnx_mx._np_to_tensor("R", R)],
+        input=[onnx_mx._vi("x", (T, B, I))],
+        output=[onnx_mx._vi("y", (T, 1, B, H))])
+    sym, args, auxs = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(T, B, I))
+    ex.copy_params_from(args, auxs)
+    X = np.abs(rng.randn(T, B, I)).astype(np.float32) * 2
+    got = ex.forward(is_train=False, x=nd.array(X))[0].asnumpy()
+    # relu recurrence on positive weights/inputs grows past tanh's bound
+    assert got.max() > 1.5, got.max()
+
+
+def test_onnx_stable_logsumexp_and_onehot_values():
+    g = P.GraphProto(
+        name="g",
+        node=[
+            P.NodeProto(op_type="ReduceLogSumExp", input=["x"],
+                        output=["lse"],
+                        attribute=[onnx_mx._attr("axes", (1,)),
+                                   onnx_mx._attr("keepdims", 0)]),
+            P.NodeProto(op_type="OneHot", input=["idx", "depth", "vals"],
+                        output=["oh"]),
+        ],
+        initializer=[
+            onnx_mx._np_to_tensor("depth", np.asarray([3], np.int64)),
+            onnx_mx._np_to_tensor("vals",
+                                  np.asarray([2.0, 10.0], np.float32))],
+        input=[onnx_mx._vi("x", (2, 2)), onnx_mx._vi("idx", (2,))],
+        output=[onnx_mx._vi("lse", (2,)), onnx_mx._vi("oh", (2, 3))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 2),
+                         idx=(2,))
+    ex.copy_params_from(args, {})
+    x = np.array([[100.0, 0.0], [1.0, 2.0]], np.float32)
+    outs = ex.forward(is_train=False, x=nd.array(x),
+                      idx=nd.array(np.array([0, 2], np.float32)))
+    lse = outs[0].asnumpy()
+    assert np.isfinite(lse).all()
+    np.testing.assert_allclose(
+        lse, np.log(np.exp(x - x.max(1, keepdims=True)).sum(1))
+        + x.max(1), rtol=1e-5)
+    oh = outs[1].asnumpy()
+    np.testing.assert_allclose(
+        oh, np.array([[10, 2, 2], [2, 2, 10]], np.float32))
+
+
+def test_onnx_lstm_peephole_raises():
+    W = np.zeros((1, 16, 3), np.float32)
+    R = np.zeros((1, 16, 4), np.float32)
+    Pw = np.zeros((1, 12), np.float32)
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="LSTM",
+                          input=["x", "W", "R", "", "", "", "", "P"],
+                          output=["y"],
+                          attribute=[onnx_mx._attr("hidden_size", 4)])],
+        initializer=[onnx_mx._np_to_tensor("W", W),
+                     onnx_mx._np_to_tensor("R", R),
+                     onnx_mx._np_to_tensor("P", Pw)],
+        input=[onnx_mx._vi("x", (2, 2, 3))],
+        output=[onnx_mx._vi("y", (2, 1, 2, 4))])
+    with pytest.raises(mx.base.MXNetError, match="peephole"):
+        onnx_mx.import_graph(g)
