@@ -108,10 +108,12 @@ def import_graph(graph):
     declared: Dict[str, tuple] = {}   # static shapes from ValueInfos
     for vi in (list(graph.input) + list(graph.output) +
                list(getattr(graph, "value_info", ()) or ())):
-        if vi.type is None or vi.type.tensor_type is None or \
-                vi.type.tensor_type.shape is None:
+        # duck-typed graphs may omit type info entirely
+        tt = getattr(getattr(vi, "type", None), "tensor_type", None)
+        shape = getattr(tt, "shape", None)
+        if shape is None:
             continue
-        dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+        dims = tuple(d.dim_value for d in shape.dim)
         if dims and all(d > 0 for d in dims):
             declared[vi.name] = dims
     for inp in graph.input:
@@ -395,6 +397,16 @@ def import_graph(graph):
     def unary(op_name):
         return lambda n: getattr(S, op_name)(env[n.input[0]])
 
+    def expand(node):
+        """ONNX Expand = bidirectional numpy broadcast: adding zeros of
+        the target shape handles rank expansion and 1-dims on either
+        side (broadcast_to alone rejects both)."""
+        shape = tuple(int(x) for x in const_input(node, 1, "shape"))
+        zname = (node.name or node.output[0]) + "_expand_zeros"
+        params[zname] = np.zeros(shape, np.float32)
+        env[zname] = S.var(zname, shape=shape)
+        return S.broadcast_add(env[node.input[0]], env[zname])
+
     def one_hot(node):
         attrs = _attrs_of(node)
         axis = attrs.get("axis", -1)
@@ -402,13 +414,12 @@ def import_graph(graph):
             raise MXNetError("ONNX OneHot with axis != -1 unsupported")
         depth = int(np.asarray(
             const_input(node, 1, "depth")).ravel()[0])
-        oh = S.one_hot(env[node.input[0]], depth=depth)
+        kw = {}
         if len(node.input) > 2 and node.input[2]:
             off, on = np.asarray(
                 const_input(node, 2, "values")).ravel()[:2]
-            if float(off) != 0.0 or float(on) != 1.0:
-                oh = oh * (float(on) - float(off)) + float(off)
-        return oh
+            kw = {"on_value": float(on), "off_value": float(off)}
+        return S.one_hot(env[node.input[0]], depth=depth, **kw)
 
     def reduce_logsumexp(node):
         """Numerically stable: m + log(sum(exp(x - m)))."""
@@ -637,9 +648,7 @@ def import_graph(graph):
         # more activations / elementwise
         "Softsign": unary("softsign"),
         "Erf": unary("erf"),
-        "Expand": lambda n: S.broadcast_to(
-            env[n.input[0]],
-            shape=tuple(int(x) for x in const_input(n, 1, "shape"))),
+        "Expand": expand,
         "OneHot": one_hot,
         "DepthToSpace": lambda n: S.depth_to_space(
             env[n.input[0]], block_size=_attrs_of(n)["blocksize"]),
@@ -656,8 +665,7 @@ def import_graph(graph):
             keepdims=bool(_attrs_of(n).get("keepdims", 1))),
         "ReduceLogSumExp": reduce_logsumexp,
         "ReduceSumSquare": lambda n: getattr(S, "sum")(
-            S.square(env[n.input[0]]) if hasattr(S, "square")
-            else env[n.input[0]] * env[n.input[0]],
+            S.square(env[n.input[0]]),
             axis=axes_of(n, _attrs_of(n)),
             keepdims=bool(_attrs_of(n).get("keepdims", 1))),
     }

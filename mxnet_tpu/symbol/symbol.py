@@ -561,7 +561,10 @@ def load_json(json_str: str) -> Symbol:
     nodes_js = g["nodes"]
     built: List[_Node] = []
     for nj in nodes_js:
-        attrs = dict(nj.get("attrs") or nj.get("param") or {})
+        # 'attrs' (1.x), 'attr' (0.x-era), 'param' (pre-NNVM) — the
+        # legacy_json_util.cc upgrade chain collapsed into one lookup
+        attrs = dict(nj.get("attrs") or nj.get("attr")
+                     or nj.get("param") or {})
         inputs = [(built[int(e[0])], int(e[1])) for e in nj.get("inputs", [])]
         if nj["op"] == "null":
             built.append(_Node(None, nj["name"], attrs, []))

@@ -130,3 +130,60 @@ def test_env_selects_engine(monkeypatch):
     monkeypatch.setenv("MXNET_ENGINE_TYPE", "ThreadedEngine")
     assert type(eng.get()) is eng.ThreadedEngine
     eng.set_engine(None)
+
+
+def test_multithreaded_imperative_ops_race():
+    """Concurrent imperative op streams from many Python threads must not
+    corrupt results or drop exceptions (parity:
+    tests/nightly/test_tlocal_racecondition.py + test_thread_local.py —
+    the engine's thread-safety contract)."""
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    errs = []
+    results = [None] * 8
+
+    def worker(tid):
+        try:
+            rng = np.random.RandomState(tid)
+            a = nd.array(rng.rand(32, 32).astype(np.float32))
+            b = nd.array(rng.rand(32, 32).astype(np.float32))
+            acc = nd.zeros((32, 32))
+            for i in range(30):
+                c = nd.dot(a, b)
+                acc = acc + c * (1.0 / (i + 1))
+                if i % 7 == 0:
+                    acc.wait_to_read()
+            # autograd inside a thread (thread-local recording state)
+            w = nd.array(rng.rand(16, 8).astype(np.float32))
+            w.attach_grad()
+            with mx.autograd.record():
+                loss = (nd.dot(nd.ones((4, 16)), w) ** 2).sum()
+            loss.backward()
+            assert w.grad is not None
+            results[tid] = float(acc.asnumpy().sum())
+        except Exception as e:  # pragma: no cover
+            errs.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    hung = [i for i, t in enumerate(threads) if t.is_alive()]
+    assert not hung, "worker threads deadlocked: %s" % hung
+    assert not errs, errs
+    # each thread's result must match its own serial recomputation
+    for tid in range(8):
+        rng = np.random.RandomState(tid)
+        a = rng.rand(32, 32).astype(np.float32)
+        b = rng.rand(32, 32).astype(np.float32)
+        acc = np.zeros((32, 32), np.float32)
+        for i in range(30):
+            acc = acc + (a @ b) * (1.0 / (i + 1))
+        np.testing.assert_allclose(results[tid], acc.sum(), rtol=1e-3)

@@ -611,3 +611,26 @@ def test_onnx_lstm_peephole_raises():
         output=[onnx_mx._vi("y", (2, 1, 2, 4))])
     with pytest.raises(mx.base.MXNetError, match="peephole"):
         onnx_mx.import_graph(g)
+
+
+def test_expand_rank_and_one_dims():
+    """Expand with rank expansion and 1-dims (ONNX bidirectional
+    broadcast) — regression: broadcast_to rejected both forms."""
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="Expand", input=["x", "shp"],
+                          output=["y"]),
+              P.NodeProto(op_type="Expand", input=["y", "shp2"],
+                          output=["z"])],
+        initializer=[
+            onnx_mx._np_to_tensor("shp", np.asarray([2, 3], np.int64)),
+            onnx_mx._np_to_tensor("shp2", np.asarray([1, 3], np.int64))],
+        input=[onnx_mx._vi("x", (3,))],
+        output=[onnx_mx._vi("z", (2, 3))])
+    sym, args, _ = onnx_mx.import_graph(g)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", x=(3,))
+    ex.copy_params_from(args, {})
+    got = ex.forward(is_train=False,
+                     x=nd.array(np.array([1, 2, 3], np.float32)))[0]
+    np.testing.assert_allclose(got.asnumpy(),
+                               np.tile([1, 2, 3], (2, 1)))

@@ -41,7 +41,8 @@ def _train(dtype, epochs=8, batch=128):
                          dtype=dtype)
     n = 1500
     for _ in range(epochs):
-        for s in range(0, n, batch):
+        # last start index keeps s+batch <= n: no leak into the eval split
+        for s in range(0, n - batch + 1, batch):
             ft.step(nd.array(X[s:s + batch]), nd.array(y[s:s + batch]))
     ft.sync_params()
     logits = net(nd.array(X[n:])).asnumpy()
