@@ -37,6 +37,7 @@ from . import lr_scheduler
 from . import callback
 from . import io
 from . import recordio
+from . import rnn
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import parallel

@@ -398,14 +398,11 @@ def import_graph(graph):
         return lambda n: getattr(S, op_name)(env[n.input[0]])
 
     def expand(node):
-        """ONNX Expand = bidirectional numpy broadcast: adding zeros of
-        the target shape handles rank expansion and 1-dims on either
-        side (broadcast_to alone rejects both)."""
+        """ONNX Expand = bidirectional numpy broadcast: adding symbolic
+        zeros of the target shape handles rank expansion and 1-dims on
+        either side (broadcast_to alone rejects both)."""
         shape = tuple(int(x) for x in const_input(node, 1, "shape"))
-        zname = (node.name or node.output[0]) + "_expand_zeros"
-        params[zname] = np.zeros(shape, np.float32)
-        env[zname] = S.var(zname, shape=shape)
-        return S.broadcast_add(env[node.input[0]], env[zname])
+        return S.broadcast_add(env[node.input[0]], S.zeros(shape=shape))
 
     def one_hot(node):
         attrs = _attrs_of(node)

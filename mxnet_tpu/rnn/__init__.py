@@ -1,0 +1,11 @@
+"""Legacy RNN package: bucketing IO (parity: ``python/mxnet/rnn/``).
+
+The modern RNN API lives in ``gluon.rnn``; this package carries the
+symbolic-era pieces that the BucketingModule workflow needs — chiefly
+:class:`BucketSentenceIter` (``python/mxnet/rnn/io.py:84``), the
+variable-length sequence iterator that assigns each sentence to its
+length bucket.
+"""
+from .io import BucketSentenceIter
+
+__all__ = ["BucketSentenceIter"]

@@ -1,0 +1,131 @@
+"""Bucketing data iterator for variable-length sequences.
+
+Reference analog: ``python/mxnet/rnn/io.py:84`` (BucketSentenceIter): each
+sentence is padded to the smallest bucket that fits it; every batch is
+drawn from ONE bucket, and ``provide_data`` advertises the default-bucket
+shape so BucketingModule can bind the largest executor first.  On TPU a
+bucket is one static-shape XLA compilation — this iterator is what keeps
+the number of distinct compiled shapes small.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over tokenized sentences.
+
+    Parameters
+    ----------
+    sentences : list of list of int
+    batch_size : int
+    buckets : list of int, optional
+        Bucket sizes (sorted); defaults to the sizes with enough data.
+    invalid_label : int
+        Padding/label id for positions past the sentence end.
+    data_name / label_name : str
+    label : list of list of int, optional
+        Per-position labels; defaults to next-token (shift by one).
+    """
+
+    def __init__(self, sentences: Sequence[Sequence[int]], batch_size: int,
+                 buckets: Optional[List[int]] = None, invalid_label: int = -1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT", label=None):
+        super().__init__(batch_size)
+        if buckets is None:
+            lens = np.array([len(s) for s in sentences])
+            buckets = sorted({int(b) for b in np.unique(lens)
+                              if (lens == b).sum() >= batch_size})
+            if not buckets:
+                buckets = [int(lens.max())]
+        buckets = sorted(buckets)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.buckets = buckets
+        self.invalid_label = invalid_label
+        self.default_bucket_key = max(buckets)
+        self.dtype = dtype
+
+        self._bucket_data = [[] for _ in buckets]
+        self._bucket_label = [[] for _ in buckets]
+        ndiscard = 0
+        for i, sent in enumerate(sentences):
+            bkt = next((b for b in buckets if b >= len(sent)), None)
+            if bkt is None:
+                ndiscard += 1
+                continue
+            buf = np.full((bkt,), invalid_label, np.float32)
+            buf[:len(sent)] = sent
+            lab = np.full((bkt,), invalid_label, np.float32)
+            if label is not None:
+                lab[:len(label[i])] = label[i][:bkt]
+            elif len(sent) > 1:   # empty/1-token sentences have no targets
+                lab[:len(sent) - 1] = sent[1:]
+            idx = buckets.index(bkt)
+            self._bucket_data[idx].append(buf)
+            self._bucket_label[idx].append(lab)
+        if ndiscard:
+            import logging
+            logging.warning("discarded %d sentences longer than the "
+                            "largest bucket", ndiscard)
+        self._bucket_data = [np.asarray(b).astype(dtype) if b else
+                             np.zeros((0, k), dtype)
+                             for b, k in zip(self._bucket_data, buckets)]
+        self._bucket_label = [np.asarray(b).astype(dtype) if b else
+                              np.zeros((0, k), dtype)
+                              for b, k in zip(self._bucket_label, buckets)]
+        self._plan = []       # (bucket_idx, start) per batch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self.dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self.dtype)]
+
+    def reset(self):
+        # reshuffle sentences WITHIN each bucket too (reference reset():
+        # batch composition must differ between epochs, not just order)
+        for i in range(len(self._bucket_data)):
+            if len(self._bucket_data[i]):
+                perm = np.random.permutation(len(self._bucket_data[i]))
+                self._bucket_data[i] = self._bucket_data[i][perm]
+                self._bucket_label[i] = self._bucket_label[i][perm]
+        self._plan = []
+        for i, data in enumerate(self._bucket_data):
+            for start in range(0, len(data) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((i, start))
+        random.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, start = self._plan[self._cursor]
+        self._cursor += 1
+        from .. import ndarray as nd
+        data = nd.array(self._bucket_data[i][start:start + self.batch_size])
+        lab = nd.array(self._bucket_label[i][start:start + self.batch_size])
+        bkt = self.buckets[i]
+        return DataBatch(
+            data=[data], label=[lab], pad=0,
+            bucket_key=bkt,
+            provide_data=[DataDesc(self.data_name,
+                                   (self.batch_size, bkt), self.dtype)],
+            provide_label=[DataDesc(self.label_name,
+                                    (self.batch_size, bkt), self.dtype)])

@@ -229,3 +229,21 @@ def test_kvstore_row_sparse_pull():
     assert np.allclose(got[1], [3, 4, 5])
     assert np.allclose(got[3], [9, 10, 11])
     assert np.allclose(got[0], 0)
+
+
+def test_bucket_sentence_iter_edge_cases():
+    """Empty/1-token sentences get no next-token targets (regression:
+    broadcast crash); reset() reshuffles WITHIN buckets so batch
+    composition changes across epochs."""
+    from mxnet_tpu.rnn import BucketSentenceIter
+    it = BucketSentenceIter([[1, 2, 3], [], [7]], batch_size=1,
+                            buckets=[4])
+    batches = list(it)
+    assert len(batches) == 3
+    np.random.seed(0)
+    sents = [[i, i + 1, i + 2] for i in range(64)]
+    it2 = BucketSentenceIter(sents, batch_size=8, buckets=[4])
+    first = [b.data[0].asnumpy().copy() for b in it2]
+    it2.reset()
+    second = [b.data[0].asnumpy().copy() for b in it2]
+    assert any(not np.array_equal(a, b) for a, b in zip(first, second))

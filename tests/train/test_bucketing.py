@@ -1,0 +1,63 @@
+"""Bucketed variable-length training (parity: reference
+tests/python/train/test_bucketing.py + rnn/io.py BucketSentenceIter):
+BucketingModule shares parameters across per-bucket executors and
+converges on a synthetic sequence task."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.rnn import BucketSentenceIter
+
+
+def _sentences(rng, n, vocab):
+    """Synthetic 'grammar': next token = (3*prev + 1) % vocab with noise;
+    lengths vary so bucketing is exercised."""
+    out = []
+    for _ in range(n):
+        ln = rng.choice([6, 10, 14])
+        s = [int(rng.randint(vocab))]
+        for _ in range(ln - 1):
+            s.append((3 * s[-1] + 1) % vocab if rng.rand() < 0.9
+                     else int(rng.randint(vocab)))
+        out.append(s)
+    return out
+
+
+def test_bucketing_module_converges():
+    vocab = 16
+    rng = np.random.RandomState(0)
+    train = BucketSentenceIter(_sentences(rng, 600, vocab), batch_size=32,
+                               buckets=[6, 10, 14], invalid_label=0)
+    assert train.default_bucket_key == 14
+    assert train.provide_data[0].shape == (32, 14)
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab, output_dim=16,
+                              name="embed")
+        h = sym.FullyConnected(embed, num_hidden=32, flatten=False,
+                               name="fc1")
+        h = sym.Activation(h, act_type="relu")
+        pred = sym.FullyConnected(h, num_hidden=vocab, flatten=False,
+                                  name="fc2")
+        pred = sym.Reshape(pred, shape=(-1, vocab))
+        label_flat = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(pred, label_flat, name="softmax",
+                                normalization="batch")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=14)
+    metric = mx.metric.Perplexity(ignore_label=None)
+    mod.fit(train, num_epoch=10, eval_metric=metric,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    # three distinct bucket executors were bound, parameters shared
+    assert len(mod._buckets) == 3
+    train.reset()
+    metric.reset()
+    mod.score(train, metric)
+    ppl = dict(metric.get_name_value())["perplexity"]
+    # the deterministic rule dominates: perplexity far below uniform (16)
+    assert ppl < 5.0, "bucketed LM failed to learn: ppl %.2f" % ppl
