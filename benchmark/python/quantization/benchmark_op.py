@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Quantized-op benchmark (parity:
+benchmark/python/quantization/benchmark_op.py — int8 vs fp32 conv/FC
+timing; on TPU the int8 path rides the MXU s8 systolic mode).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def bench(fn, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn()
+    float(out.asnumpy().ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    float(out.asnumpy().ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--channels", type=int, default=64)
+    ap.add_argument("--size", type=int, default=56)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    B, C, S = args.batch, args.channels, args.size
+    x = nd.array(rng.uniform(-1, 1, (B, C, S, S)).astype(np.float32))
+    w = nd.array(rng.uniform(-1, 1, (C, C, 3, 3)).astype(np.float32))
+
+    def conv_fp32():
+        return nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                              num_filter=C, no_bias=True)
+
+    xq, xmin, xmax = nd.contrib.quantize(
+        x, nd.array([-1.0]), nd.array([1.0]), out_type="int8")
+    wq, wmin, wmax = nd.contrib.quantize(
+        w, nd.array([-1.0]), nd.array([1.0]), out_type="int8")
+
+    def conv_int8():
+        out, _, _ = nd.contrib.quantized_conv(
+            xq, wq, xmin, xmax, wmin, wmax, kernel=(3, 3), pad=(1, 1),
+            num_filter=C, no_bias=True)
+        return out
+
+    t32 = bench(conv_fp32)
+    t8 = bench(conv_int8)
+    print("conv fp32: %.2f ms   conv int8: %.2f ms   ratio %.2fx"
+          % (t32 * 1e3, t8 * 1e3, t32 / t8))
+
+
+if __name__ == "__main__":
+    main()

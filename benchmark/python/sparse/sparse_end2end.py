@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Sparse end-to-end training benchmark (parity:
+benchmark/python/sparse/sparse_end2end.py — linear regression over sparse
+features with row_sparse kvstore pull, reporting samples/sec split by
+compute vs. pull cost).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def synthetic_csr(num_rows, num_cols, nnz_per_row, rng):
+    dense = np.zeros((num_rows, num_cols), np.float32)
+    for i in range(num_rows):
+        cols = rng.choice(num_cols, nnz_per_row, replace=False)
+        dense[i, cols] = rng.rand(nnz_per_row)
+    return dense
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-features", type=int, default=10000)
+    ap.add_argument("--num-samples", type=int, default=4096)
+    ap.add_argument("--nnz", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    Xd = synthetic_csr(args.num_samples, args.num_features, args.nnz, rng)
+    true_w = rng.randn(args.num_features, 1).astype(np.float32)
+    y = Xd @ true_w + 0.01 * rng.randn(args.num_samples, 1).astype(
+        np.float32)
+    X = nd.array(Xd).tostype("csr")
+
+    kv = mx.kv.create(args.kv_store)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    kv.init("w", nd.zeros((args.num_features, 1)))
+
+    pull_t, comp_t = 0.0, 0.0
+    n = 0
+    t_start = time.perf_counter()
+    for it in range(args.iters):
+        s = (it * args.batch_size) % (args.num_samples - args.batch_size)
+        xb = X[s:s + args.batch_size]
+        yb = nd.array(y[s:s + args.batch_size])
+        t0 = time.perf_counter()
+        row_ids = nd.array(np.unique(xb.indices.asnumpy()))
+        w_rsp = nd.zeros((args.num_features, 1)).tostype("row_sparse")
+        kv.row_sparse_pull("w", out=w_rsp, row_ids=row_ids)
+        w = w_rsp.tostype("default")
+        t1 = time.perf_counter()
+        xd = xb.tostype("default")
+        err = nd.dot(xd, w) - yb
+        grad = nd.dot(xd.T, err) / args.batch_size
+        kv.push("w", grad.tostype("row_sparse"))
+        float(err.abs().mean().asnumpy())    # sync
+        t2 = time.perf_counter()
+        pull_t += t1 - t0
+        comp_t += t2 - t1
+        n += args.batch_size
+    total = time.perf_counter() - t_start
+    print("samples/sec: %.1f  (pull %.1f%%, compute+push %.1f%%)"
+          % (n / total, 100 * pull_t / total, 100 * comp_t / total))
+    w_out = nd.zeros((args.num_features, 1))
+    kv.pull("w", out=w_out)
+    corr = np.corrcoef(w_out.asnumpy().ravel(), true_w.ravel())[0, 1]
+    print("weight corr vs ground truth: %.3f" % corr)
+
+
+if __name__ == "__main__":
+    main()

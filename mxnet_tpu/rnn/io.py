@@ -46,6 +46,9 @@ class BucketSentenceIter(DataIter):
                               if (lens == b).sum() >= batch_size})
             if not buckets:
                 buckets = [int(lens.max())]
+        if layout not in ("NT", "TN"):
+            raise ValueError("layout must be 'NT' (batch-major) or 'TN' "
+                             "(time-major), got %r" % (layout,))
         buckets = sorted(buckets)
         self.data_name = data_name
         self.label_name = label_name
@@ -53,6 +56,8 @@ class BucketSentenceIter(DataIter):
         self.invalid_label = invalid_label
         self.default_bucket_key = max(buckets)
         self.dtype = dtype
+        self.layout = layout
+        self._major_axis = 0 if layout == "NT" else 1
 
         self._bucket_data = [[] for _ in buckets]
         self._bucket_label = [[] for _ in buckets]
@@ -62,9 +67,9 @@ class BucketSentenceIter(DataIter):
             if bkt is None:
                 ndiscard += 1
                 continue
-            buf = np.full((bkt,), invalid_label, np.float32)
+            buf = np.full((bkt,), invalid_label, dtype)
             buf[:len(sent)] = sent
-            lab = np.full((bkt,), invalid_label, np.float32)
+            lab = np.full((bkt,), invalid_label, dtype)
             if label is not None:
                 lab[:len(label[i])] = label[i][:bkt]
             elif len(sent) > 1:   # empty/1-token sentences have no targets
@@ -85,16 +90,20 @@ class BucketSentenceIter(DataIter):
         self._plan = []       # (bucket_idx, start) per batch
         self.reset()
 
+    def _shape(self, bucket):
+        return ((self.batch_size, bucket) if self.layout == "NT"
+                else (bucket, self.batch_size))
+
     @property
     def provide_data(self):
         return [DataDesc(self.data_name,
-                         (self.batch_size, self.default_bucket_key),
+                         self._shape(self.default_bucket_key),
                          self.dtype)]
 
     @property
     def provide_label(self):
         return [DataDesc(self.label_name,
-                         (self.batch_size, self.default_bucket_key),
+                         self._shape(self.default_bucket_key),
                          self.dtype)]
 
     def reset(self):
@@ -119,13 +128,15 @@ class BucketSentenceIter(DataIter):
         i, start = self._plan[self._cursor]
         self._cursor += 1
         from .. import ndarray as nd
-        data = nd.array(self._bucket_data[i][start:start + self.batch_size])
-        lab = nd.array(self._bucket_label[i][start:start + self.batch_size])
+        d = self._bucket_data[i][start:start + self.batch_size]
+        l = self._bucket_label[i][start:start + self.batch_size]
+        if self.layout == "TN":
+            d, l = d.T, l.T
         bkt = self.buckets[i]
         return DataBatch(
-            data=[data], label=[lab], pad=0,
+            data=[nd.array(d)], label=[nd.array(l)], pad=0,
             bucket_key=bkt,
-            provide_data=[DataDesc(self.data_name,
-                                   (self.batch_size, bkt), self.dtype)],
-            provide_label=[DataDesc(self.label_name,
-                                    (self.batch_size, bkt), self.dtype)])
+            provide_data=[DataDesc(self.data_name, self._shape(bkt),
+                                   self.dtype)],
+            provide_label=[DataDesc(self.label_name, self._shape(bkt),
+                                    self.dtype)])

@@ -1,0 +1,41 @@
+"""Benchmark harnesses stay runnable (parity: benchmark/python/* in the
+reference — sparse_end2end, control_flow rnn, quantization benchmark_op).
+Smoke-level: tiny shapes, assert they execute and report."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(rel, *args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, rel), *args],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    return r.stdout
+
+
+def test_sparse_end2end_bench():
+    out = _run("benchmark/python/sparse/sparse_end2end.py",
+               "--num-features", "500", "--num-samples", "256",
+               "--batch-size", "64", "--iters", "8")
+    assert "samples/sec" in out
+    assert "weight corr" in out
+
+
+def test_control_flow_rnn_bench():
+    out = _run("benchmark/python/control_flow/rnn.py",
+               "--seq-len", "8", "--batch-size", "4", "--hidden", "16")
+    assert "foreach" in out and "speedup" in out
+
+
+def test_quantization_bench():
+    out = _run("benchmark/python/quantization/benchmark_op.py",
+               "--batch", "2", "--channels", "8", "--size", "8")
+    assert "conv fp32" in out and "int8" in out

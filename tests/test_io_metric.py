@@ -247,3 +247,24 @@ def test_bucket_sentence_iter_edge_cases():
     it2.reset()
     second = [b.data[0].asnumpy().copy() for b in it2]
     assert any(not np.array_equal(a, b) for a, b in zip(first, second))
+
+
+def test_bucket_sentence_iter_layout_and_dtype():
+    """TN layout emits time-major batches; integer dtypes avoid the
+    float32 intermediate (regressions from review)."""
+    from mxnet_tpu.rnn import BucketSentenceIter
+    big = 2 ** 24 + 1   # not representable in float32
+    sents = [[big, 1, 2, 3]] * 8
+    it = BucketSentenceIter(sents, batch_size=4, buckets=[4],
+                            dtype="int64", layout="TN")
+    assert it.provide_data[0].shape == (4, 4)
+    b = next(iter(it))
+    arr = b.data[0].asnumpy()
+    assert arr.shape == (4, 4)
+    assert arr[0, 0] == big          # time-major: token 0 in row 0
+    # integer path end to end (jax x64-off maps int64 -> int32 on device;
+    # the value above would have been corrupted by a float32 intermediate)
+    assert arr.dtype in (np.int32, np.int64)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="layout"):
+        BucketSentenceIter(sents, batch_size=4, buckets=[4], layout="XY")
