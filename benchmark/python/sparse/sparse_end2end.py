@@ -47,11 +47,14 @@ def main():
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
     kv.init("w", nd.zeros((args.num_features, 1)))
 
+    if args.batch_size > args.num_samples:
+        sys.exit("--batch-size must be <= --num-samples")
+    span = max(args.num_samples - args.batch_size, 1)
     pull_t, comp_t = 0.0, 0.0
     n = 0
     t_start = time.perf_counter()
     for it in range(args.iters):
-        s = (it * args.batch_size) % (args.num_samples - args.batch_size)
+        s = (it * args.batch_size) % span
         xb = X[s:s + args.batch_size]
         yb = nd.array(y[s:s + args.batch_size])
         t0 = time.perf_counter()

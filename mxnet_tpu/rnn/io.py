@@ -57,7 +57,6 @@ class BucketSentenceIter(DataIter):
         self.default_bucket_key = max(buckets)
         self.dtype = dtype
         self.layout = layout
-        self._major_axis = 0 if layout == "NT" else 1
 
         self._bucket_data = [[] for _ in buckets]
         self._bucket_label = [[] for _ in buckets]

@@ -496,6 +496,10 @@ def _create(op_name: str, sym_inputs: Sequence[Symbol],
                        "AttentionConvolution") and \
                 op.parse_attrs(dict(kwargs)).get("no_bias"):
             needed -= 1
+        if op.name == "LeakyReLU" and \
+                op.parse_attrs(dict(kwargs)).get("act_type",
+                                                 "leaky") != "prelu":
+            needed -= 1    # gamma exists only for the prelu variant
         while len(entries) < needed:
             argname = op.arg_names[len(entries)]
             v = _Node(None, "%s_%s" % (name, argname), {}, [])
