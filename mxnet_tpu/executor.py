@@ -113,6 +113,107 @@ class _Plan:
         outputs = [env[e] for e in self.out_entries]
         return outputs, new_aux
 
+    # -- coarse model parallel: segment bulking ---------------------------
+    def build_segments(self, placements, default_device):
+        """Partition the step list into contiguous same-device segments
+        (the reference's engine bulking, graph_executor.cc:1455): each
+        segment compiles into ONE jitted XLA program on its device, so a
+        2-group model dispatches 2 programs per pass instead of one per
+        op.  Unplaced nodes inherit the running segment's device
+        (AssignContext propagation, graph_executor.cc:315)."""
+        segments = []
+        cur_dev, cur_steps = None, []
+        for step in self.steps:
+            node = step[0]
+            dev = placements.get(id(node),
+                                 cur_dev if cur_dev is not None
+                                 else default_device)
+            if cur_steps and dev is not cur_dev:
+                segments.append([cur_dev, cur_steps])
+                cur_steps = []
+            cur_dev = dev
+            cur_steps.append(step)
+        if cur_steps:
+            segments.append([cur_dev, cur_steps])
+
+        out_set = set(self.out_entries)
+        built = []
+        for si, (dev, steps) in enumerate(segments):
+            local = {id(node) for (node, _, _, _) in steps}
+            ins, seen = [], set()
+            for (node, _, _, _) in steps:
+                for p, i in node.inputs:
+                    e = (id(p), i)
+                    if id(p) not in local and e not in seen:
+                        seen.add(e)
+                        ins.append(e)
+            # exports: exactly the demanded entries whose producer is local
+            consumers_after = set()
+            for sj in range(si + 1, len(segments)):
+                for (node, _, _, _) in segments[sj][1]:
+                    for p, i in node.inputs:
+                        consumers_after.add((id(p), i))
+            outs = sorted(
+                {e for e in (consumers_after | out_set) if e[0] in local},
+                key=lambda e: e[1])
+            built.append(_Segment(dev, steps, ins, outs))
+        return built
+
+    def execute_bulked(self, arg_vals, aux_vals, keys, segments):
+        """execute() with per-segment jit (coarse model parallel)."""
+        import jax as _jax
+
+        env = {}
+        for node in self.topo:
+            if node.is_var:
+                if node.name in arg_vals:
+                    env[(id(node), 0)] = arg_vals[node.name]
+                elif node.name in aux_vals:
+                    env[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+        new_aux = dict(aux_vals)
+        for seg in segments:
+            ins = [_jax.device_put(env[e], seg.device)
+                   for e in seg.in_entries]
+            outs, aux_updates = seg.fn(ins, keys)
+            for e, v in zip(seg.out_entries, outs):
+                env[e] = v
+            new_aux.update(aux_updates)
+        outputs = [env[e] for e in self.out_entries]
+        return outputs, new_aux
+
+
+class _Segment:
+    """One bulked same-device slice of a plan, compiled as one program."""
+
+    def __init__(self, device, steps, in_entries, out_entries):
+        import jax as _jax
+
+        self.device = device
+        self.steps = steps
+        self.in_entries = list(in_entries)
+        self.out_entries = list(out_entries)
+        in_entries = self.in_entries
+        out_entries = self.out_entries
+
+        def fn(ins, keys):
+            env = dict(zip(in_entries, ins))
+            aux_updates = {}
+            for (node, attrs, rng_slot, wb) in steps:
+                vals = [env[(id(p), i)] for p, i in node.inputs]
+                if rng_slot is not None:
+                    vals = [keys[rng_slot]] + vals
+                res = node.op.fn(attrs, *vals)
+                outs = res if isinstance(res, tuple) else (res,)
+                for i, o in enumerate(outs):
+                    env[(id(node), i)] = o
+                for oi, aux_name in wb.items():
+                    aux_updates[aux_name] = outs[oi]
+            return [env[e] for e in out_entries], aux_updates
+
+        self.fn = _jax.jit(fn)
+
 
 class Executor:
     """A bound executor (parity: mxnet.executor.Executor)."""
@@ -171,6 +272,14 @@ class Executor:
         ks = [_random.next_key() for _ in range(plan.n_rng)]
         return jnp.stack(ks)
 
+    def _segments(self, plan, placements):
+        """Cached bulked segments for a placed plan (engine bulking)."""
+        key = ("segs", id(plan))
+        if key not in self._jitted:
+            self._jitted[key] = plan.build_segments(
+                placements, self._ctx.jax_device)
+        return self._jitted[key]
+
     def _fwd_fn(self, train: bool):
         key = ("fwd", train)
         if key not in self._jitted:
@@ -178,16 +287,27 @@ class Executor:
             arg_names, aux_names = plan.arg_names, plan.aux_names
             placements = self._placements(plan)
 
-            def fn(arg_list, aux_list, keys):
-                outs, new_aux = plan.execute(
-                    dict(zip(arg_names, arg_list)),
-                    dict(zip(aux_names, aux_list)), keys,
-                    placements=placements)
-                return outs, [new_aux[n] for n in aux_names]
+            if placements:
+                # coarse model parallel: one XLA program per same-device
+                # SEGMENT (reference bulking, graph_executor.cc:1455) —
+                # transfers only at group boundaries, not per op
+                segments = self._segments(plan, placements)
 
-            # coarse model parallel runs eagerly: one XLA program executes
-            # on one device, so cross-group transfers preclude whole-plan jit
-            self._jitted[key] = fn if placements else jax.jit(fn)
+                def fn(arg_list, aux_list, keys):
+                    outs, new_aux = plan.execute_bulked(
+                        dict(zip(arg_names, arg_list)),
+                        dict(zip(aux_names, aux_list)), keys, segments)
+                    return outs, [new_aux[n] for n in aux_names]
+
+                self._jitted[key] = fn
+            else:
+                def fn(arg_list, aux_list, keys):
+                    outs, new_aux = plan.execute(
+                        dict(zip(arg_names, arg_list)),
+                        dict(zip(aux_names, aux_list)), keys)
+                    return outs, [new_aux[n] for n in aux_names]
+
+                self._jitted[key] = jax.jit(fn)
         return self._jitted[key]
 
     def _fwd_bwd_fn(self):
@@ -198,15 +318,22 @@ class Executor:
             grad_args = self._grad_args
             placements = self._placements(plan)
 
+            segments = (self._segments(plan, placements)
+                        if placements else None)
+
             def fn(arg_list, aux_list, keys, ograds):
                 base = dict(zip(arg_names, arg_list))
 
                 def pure(gvals):
                     av = dict(base)
                     av.update(dict(zip(grad_args, gvals)))
-                    outs, new_aux = plan.execute(
-                        av, dict(zip(aux_names, aux_list)), keys,
-                        placements=placements)
+                    if segments is not None:
+                        outs, new_aux = plan.execute_bulked(
+                            av, dict(zip(aux_names, aux_list)), keys,
+                            segments)
+                    else:
+                        outs, new_aux = plan.execute(
+                            av, dict(zip(aux_names, aux_list)), keys)
                     return outs, [new_aux[n] for n in aux_names]
 
                 gvals = [base[n] for n in grad_args]

@@ -8,7 +8,9 @@ matmul per layer (see :mod:`mxnet_tpu.ops.rnn`).
 from __future__ import annotations
 
 from ... import ndarray
+from ... import symbol as _symbol
 from ...ndarray import NDArray
+from ...symbol import Symbol
 from ..block import HybridBlock
 from . import rnn_cell
 
@@ -132,13 +134,17 @@ class _RNNLayer(HybridBlock):
         return states
 
     def __call__(self, inputs, *states):
+        if self._input_size == 0 and not isinstance(inputs, NDArray):
+            raise ValueError(
+                "Symbolic use of %s with unknown input size: pass "
+                "input_size= at construction or run one imperative batch "
+                "first to resolve deferred shapes." % type(self).__name__)
         if self._input_size == 0:
-            for i in range(self._dir):
-                self.params.get("l0_i2h_weight").shape = (
+            self.params.get("l0_i2h_weight").shape = (
+                self._gates * self._hidden_size, inputs.shape[2])
+            if self._dir == 2:
+                self.params.get("r0_i2h_weight").shape = (
                     self._gates * self._hidden_size, inputs.shape[2])
-                if self._dir == 2:
-                    self.params.get("r0_i2h_weight").shape = (
-                        self._gates * self._hidden_size, inputs.shape[2])
             self._input_size = inputs.shape[2]
         # deferred init resolves here, not in HybridBlock.__call__: this
         # class overrides __call__/forward, so finish explicitly once the
@@ -163,8 +169,13 @@ class _RNNLayer(HybridBlock):
         return out[0] if skip_states else out
 
     def forward(self, inputs, states=None):
-        if isinstance(states, NDArray):
+        if isinstance(states, (NDArray, Symbol)):
             states = [states]
+        if isinstance(inputs, Symbol):
+            # symbolic (hybridize / FusedTrainer) path: shapes resolve at
+            # bind time; zero states are built shape-polymorphically in
+            # _forward_kernel (ref rnn_layer.py:217 F.zeros path)
+            return self._forward_kernel(inputs, list(states or []))
         batch_size = inputs.shape[self._layout.find("N")]
         if states is None or len(states) == 0:
             states = self.begin_state(batch_size, ctx=inputs.context)
@@ -178,31 +189,39 @@ class _RNNLayer(HybridBlock):
         return out
 
     def _forward_kernel(self, inputs, states):
-        """Forward using the fused RNN operator."""
+        """Forward using the fused RNN operator (NDArray or Symbol)."""
+        symbolic = isinstance(inputs, Symbol)
+        F = _symbol if symbolic else ndarray
         if self._layout == "NTC":
-            inputs = ndarray.swapaxes(inputs, 0, 1)
+            inputs = F.swapaxes(inputs, 0, 1)
+
+        def flat_param(name):
+            p = getattr(self, name)
+            v = p.var() if symbolic else p.data(inputs.context)
+            return v.reshape((-1,))
+
         # pack parameters in the fused-op layout: all (W, R) then all biases
         ws, bs = [], []
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
-                ws.append(getattr(
-                    self, "{}{}_i2h_weight".format(j, i)).data(
-                        inputs.context).reshape((-1,)))
-                ws.append(getattr(
-                    self, "{}{}_h2h_weight".format(j, i)).data(
-                        inputs.context).reshape((-1,)))
+                ws.append(flat_param("{}{}_i2h_weight".format(j, i)))
+                ws.append(flat_param("{}{}_h2h_weight".format(j, i)))
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
-                bs.append(getattr(
-                    self, "{}{}_i2h_bias".format(j, i)).data(
-                        inputs.context).reshape((-1,)))
-                bs.append(getattr(
-                    self, "{}{}_h2h_bias".format(j, i)).data(
-                        inputs.context).reshape((-1,)))
-        params = ndarray.concat(*(ws + bs), dim=0)
+                bs.append(flat_param("{}{}_i2h_bias".format(j, i)))
+                bs.append(flat_param("{}{}_h2h_bias".format(j, i)))
+        params = F.concat(*(ws + bs), dim=0)
+
+        if symbolic and not states:
+            # (L*dir, B, h) zeros with B inferred from the data symbol
+            z = F.zeros_like(F.mean(inputs, axis=(0, 2), keepdims=True))
+            z = F.broadcast_axis(
+                z, axis=(0, 2),
+                size=(self._num_layers * self._dir, self._hidden_size))
+            states = [z, z] if self._mode == "lstm" else [z]
 
         rnn_args = [inputs, params] + states
-        outputs = ndarray.RNN(
+        outputs = F.RNN(
             *rnn_args, state_size=self._hidden_size,
             num_layers=self._num_layers, bidirectional=self._dir == 2,
             p=self._dropout, state_outputs=True, mode=self._mode)
@@ -211,7 +230,7 @@ class _RNNLayer(HybridBlock):
         else:
             outputs, states = outputs[0], [outputs[1]]
         if self._layout == "NTC":
-            outputs = ndarray.swapaxes(outputs, 0, 1)
+            outputs = F.swapaxes(outputs, 0, 1)
         return outputs, states
 
 

@@ -46,6 +46,73 @@ _CONV_PARAMS = {
 }
 
 
+def _stem_s2d_eligible(attrs, data, nd):
+    """True for thin-input stride-2 2-D stems (e.g. ResNet 7x7s2 on RGB).
+
+    The MXU pads the contraction dim to a full lane tile, so C_in=3 convs
+    run at <25 TF while C_in>=64 convs reach 150+ TF (measured,
+    docs/perf_analysis.md round 3).  Space-to-depth(2) rewrites the conv
+    EXACTLY into a stride-1 conv on 4x the channels.
+    """
+    import os
+    if os.environ.get("MXNET_TPU_STEM_S2D", "1") == "0":
+        return False
+    if nd != 2 or attrs["num_group"] != 1:
+        return False
+    stride = attrs["stride"] or (1,) * nd
+    dilate = attrs["dilate"] or (1,) * nd
+    k = attrs["kernel"]
+    if stride != (2, 2) or dilate != (1, 1):
+        return False
+    if data.shape[1] > 4 or data.shape[2] % 2 or data.shape[3] % 2:
+        return False
+    return k[0] % 2 == 1 and k[1] % 2 == 1 and k[0] > 1
+
+
+def _stem_s2d_conv(attrs, data, weight):
+    """stride-2 kxk conv on (N,C,H,W) == stride-1 conv on space-to-depth(2).
+
+    y[ho] = sum_dh x[2*ho + dh - pad]; writing dh - pad = 2e + p maps tap
+    dh to s2d parity plane p at spatial offset e — a ceil(k/2)-tap
+    stride-1 conv over the (N, 4C, H/2, W/2) s2d input (exact rewrite;
+    the TPU-MLPerf ResNet stem trick).
+    """
+    k = attrs["kernel"]
+    pad = attrs["pad"] or (0, 0)
+    N, C, H, W = data.shape
+    O = weight.shape[0]
+
+    def tap_range(kk, p):
+        e0 = -(p // 2) - (p % 2)            # floor((0 - p) / 2)
+        e1 = (kk - 1 - p) // 2
+        return e0, e1
+    eh0, eh1 = tap_range(k[0], pad[0])
+    ew0, ew1 = tap_range(k[1], pad[1])
+    kh, kw = eh1 - eh0 + 1, ew1 - ew0 + 1
+
+    # kernel transform is itself an inverse space-to-depth: shift w so tap
+    # dh aligns with (2*e' + p), then fold each spatial parity into the
+    # channel dim — layout (p, q, c) -> p*2C + q*C + c, matching x below
+    lh, lw = -(2 * eh0 + pad[0]), -(2 * ew0 + pad[1])
+    wp = jnp.pad(weight, ((0, 0), (0, 0),
+                          (lh, 2 * kh - k[0] - lh),
+                          (lw, 2 * kw - k[1] - lw)))
+    w4 = wp.reshape(O, C, kh, 2, kw, 2)
+    w4 = w4.transpose(0, 3, 5, 1, 2, 4).reshape(O, 4 * C, kh, kw)
+
+    xs = data.reshape(N, C, H // 2, 2, W // 2, 2)
+    xs = xs.transpose(0, 3, 5, 1, 2, 4).reshape(N, 4 * C, H // 2, W // 2)
+    # high pad sized so the output length matches the strided original:
+    # Ho = (H + 2p - k)//2 + 1
+    ho = (H + 2 * pad[0] - k[0]) // 2 + 1
+    wo = (W + 2 * pad[1] - k[1]) // 2 + 1
+    return jax.lax.conv_general_dilated(
+        xs, w4, window_strides=(1, 1),
+        padding=[(-eh0, ho + kh - H // 2 + eh0 - 1),
+                 (-ew0, wo + kw - W // 2 + ew0 - 1)],
+        dimension_numbers=_conv_dnums(2))
+
+
 @register("Convolution", nin=-1, aliases=("convolution", "Convolution_v1"),
           params=dict(_CONV_PARAMS))
 def _convolution(attrs, data, weight, *maybe_bias):
@@ -55,13 +122,16 @@ def _convolution(attrs, data, weight, *maybe_bias):
     stride = attrs["stride"] or (1,) * nd
     dilate = attrs["dilate"] or (1,) * nd
     pad = attrs["pad"] or (0,) * nd
-    out = jax.lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=_conv_dnums(nd),
-        feature_group_count=attrs["num_group"])
+    if _stem_s2d_eligible(attrs, data, nd):
+        out = _stem_s2d_conv(attrs, data, weight)
+    else:
+        out = jax.lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=_conv_dnums(nd),
+            feature_group_count=attrs["num_group"])
     # NOTE: no preferred_element_type here — the MXU accumulates bf16 convs
     # in f32 natively, and an explicit f32 preference breaks the conv
     # transpose rule (mixed-dtype cotangents) under jax.vjp

@@ -1,0 +1,298 @@
+"""Pallas fused LSTM recurrence — the cuDNN-RNN role on TPU.
+
+Reference analog: ``src/operator/cudnn_rnn-inl.h`` (fused GPU RNN) and the
+2,357-LoC CPU fallback ``src/operator/rnn_impl.h``.  The reference fuses the
+whole recurrence into one cuDNN call; the TPU design fuses it into ONE
+Pallas kernel whose grid iterates the time axis with the hidden/cell state
+resident in VMEM scratch — zero per-timestep dispatch, per-gate h2h matmuls
+on the MXU, all gate elementwise math fused on the VPU.
+
+Layout notes:
+  * gates are carried on a leading dim of 4 (``(T, 4, B, H)``) instead of a
+    packed ``4H`` lane axis, so no lane-slicing at non-128-aligned
+    boundaries (the reference packs ``[i f g o]`` along the feature dim,
+    which would force misaligned lane shifts for H like 650);
+  * recurrent weights arrive pre-transposed per gate ``(4, H, H)``;
+  * cell state is f32 in VMEM (bf16 h, f32 c — cuDNN's fp16-RNN split);
+  * forward saves gate activations + raw cell states (the cuDNN
+    "reserve space") for the reverse-time backward kernel, which
+    accumulates ``dR``/``db`` in VMEM f32 across the whole sequence,
+    seeds its state grads from the terminal cotangents (exact dhT/dcT
+    handling), and emits per-step pre-activation gate grads; their
+    projection back to the layer input is one large MXU matmul outside
+    (ops/rnn.py).
+
+Used when ``MXNET_TPU_PALLAS_RNN`` != "0" on TPU, dims are tile-aligned,
+and sizes fit VMEM; otherwise ops/rnn.py falls back to ``lax.scan``.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["lstm_scan", "lstm_scan_available"]
+
+# set True (tests) to run kernels through the Pallas interpreter on CPU
+INTERPRET = False
+
+
+def lstm_scan_available(B, H, dtype=None, data=None) -> bool:
+    """Pallas path eligibility: TPU backend + VMEM fit (~14 MB budget).
+
+    ``data`` (optional): a concrete array whose committed device decides
+    the platform — a CPU-context LSTM on a TPU host must NOT pick the
+    Mosaic kernel.  Tracers carry no device; then the default backend
+    (what jit compiles for absent explicit placement) is used.
+    """
+    if os.environ.get("MXNET_TPU_PALLAS_RNN", "1") == "0":
+        return False
+    platform = None
+    if data is not None and isinstance(data, jax.Array) \
+            and not isinstance(data, jax.core.Tracer):
+        try:
+            platform = next(iter(data.devices())).platform
+        except Exception:
+            platform = None
+    if platform is None:
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            return False
+    if platform not in ("tpu", "axon"):
+        return False
+    if H > 2048 or B > 1024:   # all blocks are whole-array (no tile
+        return False           # alignment constraints); VMEM only
+    es = 2 if dtype is None or jnp.dtype(dtype).itemsize == 2 else 4
+    # backward kernel is the VMEM high-water mark: rt4 (model dtype) +
+    # dr4 accumulator (f32) + double-buffered per-step blocks
+    # (gates in model dtype, 4x f32 (B,H) inputs, f32 dxp out) + scratch.
+    # Budget measured on v5e: the H=650/B=128 LM config (~17.5 MB by this
+    # estimate) compiles and runs — Mosaic streams the per-step blocks, so
+    # only the resident weights/accumulators truly pin VMEM.
+    vmem = (4 * H * H * (es + 4)
+            + 2 * B * H * (4 * es + 4 * 4 + 4 * 4)
+            + 2 * B * H * 4)
+    return vmem < 28 * 1024 * 1024
+
+
+# --------------------------------------------------------------- forward
+def _fwd_kernel(xp_ref, h0_ref, c0_ref, rt_ref, b_ref,
+                ys_ref, gates_ref, cs_ref, hT_ref, cT_ref,
+                h_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    pre = [None] * 4
+    for k in range(4):
+        pre[k] = (xp_ref[0, k].astype(jnp.float32)
+                  + jax.lax.dot_general(
+                      h, rt_ref[k], (((1,), (0,)), ((), ())),
+                      preferred_element_type=jnp.float32)
+                  + b_ref[k].astype(jnp.float32))
+    i = jax.nn.sigmoid(pre[0])
+    f = jax.nn.sigmoid(pre[1])
+    g = jnp.tanh(pre[2])
+    o = jax.nn.sigmoid(pre[3])
+    c = f * c_scr[:] + i * g
+    h_new = (o * jnp.tanh(c)).astype(ys_ref.dtype)
+    c_scr[:] = c
+    h_scr[:] = h_new
+    ys_ref[0] = h_new
+    # reserve space for backward
+    gates_ref[0, 0] = i.astype(gates_ref.dtype)
+    gates_ref[0, 1] = f.astype(gates_ref.dtype)
+    gates_ref[0, 2] = g.astype(gates_ref.dtype)
+    gates_ref[0, 3] = o.astype(gates_ref.dtype)
+    cs_ref[0] = c
+    # constant-index outputs: the final grid step's value is what lands
+    hT_ref[:] = h_new
+    cT_ref[:] = c
+
+
+def _lstm_fwd_impl(xp4, h0, c0, rt4, b4):
+    T, _, B, H = xp4.shape
+    dt = xp4.dtype
+    ys, gates, cs, hT, cT = pl.pallas_call(
+        _fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, 4, B, H), lambda t: (t, 0, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((4, H, H), lambda t: (0, 0, 0)),
+            pl.BlockSpec((4, 1, H), lambda t: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, 4, B, H), lambda t: (t, 0, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, 4, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(xp4, h0, c0, rt4, b4)
+    return (ys, hT, cT.astype(c0.dtype)), (gates, cs, ys, h0, c0)
+
+
+# -------------------------------------------------------------- backward
+def _bwd_kernel(gates_ref, cs_ref, cprev_ref, dys_ref,
+                dhT_ref, dcT_ref, rt_ref,
+                dxp_ref, dh0_ref, dc0_ref,
+                dh_scr, dc_scr):
+    """Grid step j processes t = T-1-j (reversed via index maps).
+
+    Emits only the per-step pre-activation gate grads; the dR/db
+    reductions happen OUTSIDE as 4 large MXU GEMMs over (T*B, H) — keeping
+    them in-kernel needs a (4,H,H) f32 VMEM accumulator that blows the
+    16 MB scoped-vmem limit at H=650 (measured: 19.4 M requested)."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        # seed the reverse recursion with the terminal-state cotangents
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+
+    i = gates_ref[0, 0].astype(jnp.float32)
+    f = gates_ref[0, 1].astype(jnp.float32)
+    g = gates_ref[0, 2].astype(jnp.float32)
+    o = gates_ref[0, 3].astype(jnp.float32)
+    tc = jnp.tanh(cs_ref[0])
+    c_prev = cprev_ref[0].astype(jnp.float32)
+
+    dh = dh_scr[:] + dys_ref[0].astype(jnp.float32)
+    dct = dh * o * (1.0 - tc * tc) + dc_scr[:]
+    d_pre = [
+        (dct * g) * i * (1.0 - i),           # di_pre
+        (dct * c_prev) * f * (1.0 - f),      # df_pre
+        (dct * i) * (1.0 - g * g),           # dg_pre
+        (dh * tc) * o * (1.0 - o),           # do_pre
+    ]
+    dc_new = dct * f
+    dc_scr[:] = dc_new
+
+    cdt = rt_ref.dtype
+    dh_new = None
+    for k in range(4):
+        dk = d_pre[k]
+        dxp_ref[0, k] = dk.astype(dxp_ref.dtype)
+        # dh_prev += d_pre_k @ Rt_k^T  (contract Rt dim 1)
+        part = jax.lax.dot_general(
+            dk.astype(cdt), rt_ref[k], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dh_new = part if dh_new is None else dh_new + part
+    dh_scr[:] = dh_new
+    # after the final grid step (t=0) these hold d h0 / d c0
+    dh0_ref[:] = dh_new
+    dc0_ref[:] = dc_new
+
+
+@jax.custom_vjp
+def _lstm_pallas(xp4, h0, c0, rt4, b4):
+    out, _ = _lstm_fwd_impl(xp4, h0, c0, rt4, b4)
+    return out
+
+
+def _lstm_vjp_fwd(xp4, h0, c0, rt4, b4):
+    out, res = _lstm_fwd_impl(xp4, h0, c0, rt4, b4)
+    return out, res + (rt4,)
+
+
+def _lstm_vjp_bwd(res, cts):
+    gates, cs, ys, h0, c0, rt4 = res
+    dys, dhT, dcT = cts
+    T, _, B, H = gates.shape
+    dt = gates.dtype
+
+    cprev = jnp.concatenate(
+        [c0[None].astype(jnp.float32), cs[:-1]], axis=0).astype(ys.dtype)
+    dys = dys.astype(ys.dtype)
+    zero = jnp.zeros((B, H), jnp.float32)
+    dhT = zero if dhT is None else dhT.astype(jnp.float32)
+    dcT = zero if dcT is None else dcT.astype(jnp.float32)
+
+    rev4 = lambda j: (T - 1 - j, 0, 0, 0)   # noqa: E731
+    rev3 = lambda j: (T - 1 - j, 0, 0)      # noqa: E731
+    dxp, dh0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, 4, B, H), rev4),
+            pl.BlockSpec((1, B, H), rev3),
+            pl.BlockSpec((1, B, H), rev3),
+            pl.BlockSpec((1, B, H), rev3),
+            pl.BlockSpec((B, H), lambda j: (0, 0)),
+            pl.BlockSpec((B, H), lambda j: (0, 0)),
+            pl.BlockSpec((4, H, H), lambda j: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 4, B, H), rev4),
+            pl.BlockSpec((B, H), lambda j: (0, 0)),
+            pl.BlockSpec((B, H), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, 4, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(gates, cs, cprev, dys, dhT, dcT, rt4)
+
+    # dR_k = h_prev^T @ d_pre_k and db_k = sum_B d_pre_k — big MXU GEMMs
+    # over the whole (T*B, H) sequence (the hoisted-projection trick in
+    # reverse; doing this in-kernel needs a VMEM accumulator that exceeds
+    # the 16 MB scoped limit)
+    hprev = jnp.concatenate([h0[None].astype(ys.dtype), ys[:-1]], axis=0)
+    hp2 = hprev.reshape(T * B, H)
+    dxp2 = dxp.transpose(1, 0, 2, 3).reshape(4, T * B, H)
+    dr4 = jax.lax.dot_general(
+        hp2, dxp2, (((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (H, 4, H)
+    dr4 = dr4.transpose(1, 0, 2)                     # (4, H, H)
+    db4 = jnp.sum(dxp2.astype(jnp.float32), axis=1)[:, None, :]
+
+    return (dxp.astype(dt), dh0.astype(h0.dtype), dc0.astype(c0.dtype),
+            dr4.astype(rt4.dtype), db4.astype(jnp.float32))
+
+
+_lstm_pallas.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)
+
+
+def lstm_scan(xproj, h0, c0, R, bR):
+    """Drop-in replacement for the lax.scan LSTM recurrence.
+
+    xproj: (T, B, 4H) packed [i f g o] input projections (x @ W^T + bW);
+    h0, c0: (B, H); R: (4H, H); bR: (4H,).
+    Returns ys (T, B, H), hT, cT — matching ops/rnn.py:_cell_scan.
+    """
+    T, B, H4 = xproj.shape
+    H = H4 // 4
+    xp4 = xproj.reshape(T, B, 4, H).transpose(0, 2, 1, 3)   # (T,4,B,H)
+    rt4 = R.reshape(4, H, H).transpose(0, 2, 1)             # per-gate R^T
+    b4 = bR.reshape(4, 1, H).astype(jnp.float32)
+    ys, hT, cT = _lstm_pallas(xp4, h0, c0, rt4.astype(xproj.dtype), b4)
+    return ys, hT, cT

@@ -62,6 +62,13 @@ def _cell_scan(mode, xproj, h0, c0, R, bR):
     h_sz = h0.shape[-1]
 
     if mode == "lstm":
+        from . import pallas_rnn
+        if pallas_rnn.lstm_scan_available(xproj.shape[1], h_sz,
+                                          xproj.dtype, data=xproj):
+            # fused Pallas recurrence (cuDNN-RNN role): whole time loop in
+            # one kernel, h/c resident in VMEM, custom VJP
+            return pallas_rnn.lstm_scan(xproj, h0, c0, R, bR)
+
         def step(carry, xp):
             h, c = carry
             gates = xp + h @ R.T + bR

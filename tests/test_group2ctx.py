@@ -127,3 +127,28 @@ def test_attr_precedence_and_variable_scope():
     # the op parameter must NOT be clobbered by the attr dict
     assert node.parsed_attrs()["num_hidden"] == 10
     assert node.attrs["ctx_group"] == "override"
+
+
+def test_group2ctx_bulks_into_segments():
+    """Engine bulking (ref graph_executor.cc:1455): the 2-group MLP must
+    compile into exactly 2 same-device segments — one jitted program per
+    group, not one dispatch per op."""
+    import jax
+    net = _two_group_mlp()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    ex = net.simple_bind(ctx=mx.cpu(0), group2ctx=g2c, data=(4, 12))
+    for n, arr in ex.arg_dict.items():
+        if n != "data":
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    x = np.random.uniform(size=(4, 12)).astype(np.float32)
+    ex.forward(is_train=True, data=mx.nd.array(x))
+
+    plan = ex._plan(True)
+    segs = ex._segments(plan, ex._placements(plan))
+    assert len(segs) == 2, [s.device for s in segs]
+    assert segs[0].device == mx.cpu(0).jax_device
+    assert segs[1].device == mx.cpu(1).jax_device
+    # every step is inside a segment; nothing dispatches per-op
+    assert sum(len(s.steps) for s in segs) == len(plan.steps)
+    # the boundary carries the cross-group activation(s)
+    assert len(segs[1].in_entries) >= 1

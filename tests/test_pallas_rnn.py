@@ -1,0 +1,82 @@
+"""Pallas fused-LSTM kernel vs the lax.scan reference recurrence.
+
+Runs through the Pallas interpreter on CPU (same jaxpr the TPU compiles).
+Reference analog: the reference cross-checks cuDNN RNN against the CPU
+rnn_impl.h path (tests/python/gpu/test_operator_gpu.py RNN consistency).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops import pallas_rnn
+
+
+def _scan_ref(xproj, h0, c0, R, bR):
+    def step(carry, xp):
+        h, c = carry
+        gates = xp + h @ R.T + bR
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), xproj)
+    return ys, hT, cT
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    pallas_rnn.INTERPRET = True
+    yield
+    pallas_rnn.INTERPRET = False
+
+
+def _rand_case(T=5, B=8, H=16, seed=0):
+    rng = np.random.default_rng(seed)
+    xproj = jnp.asarray(rng.standard_normal((T, B, 4 * H)), jnp.float32)
+    h0 = jnp.asarray(rng.standard_normal((B, H)) * 0.3, jnp.float32)
+    c0 = jnp.asarray(rng.standard_normal((B, H)) * 0.3, jnp.float32)
+    R = jnp.asarray(rng.standard_normal((4 * H, H)) * 0.2, jnp.float32)
+    bR = jnp.asarray(rng.standard_normal((4 * H,)) * 0.1, jnp.float32)
+    return xproj, h0, c0, R, bR
+
+
+def test_forward_matches_scan():
+    args = _rand_case()
+    ys_p, hT_p, cT_p = pallas_rnn.lstm_scan(*args)
+    ys_r, hT_r, cT_r = _scan_ref(*args)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(hT_p), np.asarray(hT_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cT_p), np.asarray(cT_r),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_backward_matches_scan():
+    args = _rand_case(seed=3)
+
+    def loss_p(xproj, h0, c0, R, bR):
+        ys, hT, cT = pallas_rnn.lstm_scan(xproj, h0, c0, R, bR)
+        # weight all three outputs so every cotangent path is exercised
+        return (jnp.sum(ys * ys) + jnp.sum(jnp.sin(hT))
+                + jnp.sum(cT * 0.5))
+
+    def loss_r(xproj, h0, c0, R, bR):
+        ys, hT, cT = _scan_ref(xproj, h0, c0, R, bR)
+        return (jnp.sum(ys * ys) + jnp.sum(jnp.sin(hT))
+                + jnp.sum(cT * 0.5))
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3, 4))(*args)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(*args)
+    names = ["dxproj", "dh0", "dc0", "dR", "dbR"]
+    for name, a, b in zip(names, gp, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4,
+            err_msg=name)
+
+
+def test_rnn_op_uses_fallback_on_cpu():
+    # on CPU the availability gate must be closed (scan path covers it)
+    assert not pallas_rnn.lstm_scan_available(8, 16)
