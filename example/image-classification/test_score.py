@@ -26,6 +26,7 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
 # model name -> (epoch, expected accuracy on the digits val split)
 PRETRAINED = {
     "digits-lenet": (20, 0.973),
+    "digits-resnet": (25, 0.979),   # residual net, train_digits_resnet.py
 }
 
 

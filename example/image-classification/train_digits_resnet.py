@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Train the second in-repo pretrained artifact: a small residual conv
+net on sklearn digits (parity: example/image-classification README's
+pretrained-model recipes; zero-egress stand-in for the ImageNet zoo).
+
+Architecture: 8x8 -> conv16/BN/relu -> 2 residual blocks (16, then 32
+with a strided projection) -> global pool -> dense 10.  Trained with the
+Module.fit path (symbolic, BatchNorm aux states, momentum SGD) so the
+artifact exercises the same machinery as the reference's resnet recipes.
+
+Saves models/digits-resnet-00NN.params.npz + -symbol.json and prints the
+validation accuracy; tests/train/test_score.py asserts it keeps
+reproducing.
+
+Run:  python example/image-classification/train_digits_resnet.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as S  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+
+
+def residual_unit(data, num_filter, stride, dim_match, name):
+    bn1 = S.BatchNorm(data, fix_gamma=False, name=name + "_bn1")
+    act1 = S.Activation(bn1, act_type="relu", name=name + "_relu1")
+    conv1 = S.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
+                          stride=stride, pad=(1, 1), no_bias=True,
+                          name=name + "_conv1")
+    bn2 = S.BatchNorm(conv1, fix_gamma=False, name=name + "_bn2")
+    act2 = S.Activation(bn2, act_type="relu", name=name + "_relu2")
+    conv2 = S.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
+                          stride=(1, 1), pad=(1, 1), no_bias=True,
+                          name=name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = S.Convolution(act1, num_filter=num_filter,
+                                 kernel=(1, 1), stride=stride,
+                                 no_bias=True, name=name + "_sc")
+    return conv2 + shortcut
+
+
+def build_symbol(num_classes=10):
+    data = S.var("data")
+    body = S.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                         no_bias=True, name="conv0")
+    body = residual_unit(body, 16, (1, 1), False, "stage1_unit1")
+    body = residual_unit(body, 16, (1, 1), True, "stage1_unit2")
+    body = residual_unit(body, 32, (2, 2), False, "stage2_unit1")
+    body = residual_unit(body, 32, (1, 1), True, "stage2_unit2")
+    bn = S.BatchNorm(body, fix_gamma=False, name="bn_final")
+    act = S.Activation(bn, act_type="relu", name="relu_final")
+    pool = S.Pooling(act, global_pool=True, pool_type="avg",
+                     kernel=(2, 2), name="pool_final")
+    flat = S.Flatten(pool)
+    fc = S.FullyConnected(flat, num_hidden=num_classes, name="fc")
+    return S.SoftmaxOutput(fc, name="softmax")
+
+
+def digits_iters(batch_size=64):
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32).reshape(-1, 1, 8, 8)
+    y = y.astype(np.float32)
+    rng = np.random.RandomState(7)          # split shared with test_score
+    idx = rng.permutation(len(X))
+    X, y = X[idx], y[idx]
+    train = mx.io.NDArrayIter(X[:1500], y[:1500], batch_size=batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(X[1500:], y[1500:], batch_size=99)
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=25)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--prefix", default=os.path.join(REPO, "models",
+                                                     "digits-resnet"))
+    args = ap.parse_args()
+
+    mx.random.seed(42)
+    np.random.seed(42)
+    train, val = digits_iters()
+    net = build_symbol()
+    mod = mx.mod.Module(net)
+    mod.fit(train,
+            eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            num_epoch=args.epochs,
+            epoch_end_callback=mx.callback.do_checkpoint(
+                args.prefix, period=args.epochs),
+            batch_end_callback=None)
+    score = mod.score(val, mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    print("final val accuracy: %.4f (artifact %s-%04d)"
+          % (acc, args.prefix, args.epochs))
+    return 0 if acc > 0.95 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,11 @@ reference's contracts.  See SURVEY.md for the blueprint.
 """
 __version__ = "0.1.0"
 
+# memory-pool env knobs must translate to XLA client settings BEFORE the
+# first backend init (storage manager N2; no-op if jax already started)
+from .storage import apply_pool_env as _apply_pool_env
+_apply_pool_env()
+
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus)

@@ -136,9 +136,16 @@ def quantize_graph(sym, excluded_sym_names: Sequence[str] = (),
         attrs = {k: v for k, v in node.attrs.items()
                  if not k.startswith("__")}
         new_node = _create(op_name, in_syms, attrs, name=node.name)
-        for i in range(node.num_outputs()):
-            fp32[(id(node), i)] = new_node[i] \
-                if node.num_outputs() > 1 else new_node
+        # multi-output nodes (e.g. BatchNorm: out + hidden mean/var) may
+        # expose fewer VISIBLE outputs on the rebuilt symbol than
+        # node.num_outputs(); map what exists — consumers only reference
+        # visible entries in inference graphs
+        n_vis = len(new_node._outputs)
+        if n_vis > 1:
+            for i in range(n_vis):
+                fp32[(id(node), i)] = new_node[i]
+        else:
+            fp32[(id(node), 0)] = new_node
 
     outs = [fp32_of(e) for e in sym._outputs]
     qsym = outs[0] if len(outs) == 1 else S.Group(outs)

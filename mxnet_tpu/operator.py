@@ -36,12 +36,25 @@ _WORKER = concurrent.futures.ThreadPoolExecutor(
 
 
 def _on_worker(fn, *args):
+    import os
     import threading
     if threading.current_thread().name.startswith("mxnet_custom_op"):
         # nested Custom op (an op whose forward invokes another Custom op):
         # run inline — re-submitting to the single worker would deadlock
         return fn(*args)
-    return _WORKER.submit(fn, *args).result()
+    # bounded wait: a wedged worker surfaces as a loud MXNetError instead
+    # of an indefinite futex hang (the reference's engine would likewise
+    # abort on a stuck callback rather than stall the scheduler)
+    timeout = float(os.environ.get("MXNET_CUSTOM_OP_TIMEOUT_SEC", "600"))
+    fut = _WORKER.submit(fn, *args)
+    try:
+        return fut.result(timeout=timeout)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()      # prune if not yet started; never run it late
+        raise MXNetError(
+            "Custom-op callback did not complete within %.0fs "
+            "(MXNET_CUSTOM_OP_TIMEOUT_SEC): worker thread wedged or the "
+            "callback deadlocked" % timeout)
 
 
 class CustomOp:

@@ -1,0 +1,156 @@
+"""Pallas flash-attention: the blockwise inner loop of ring attention.
+
+SURVEY.md §7.1 maps ring attention's hot loop to a hand-written Pallas
+kernel.  ``parallel/ring_attention.py``'s building block is a
+``lax.scan`` of (Q-block x K-block) updates; this module is the same
+math — online-softmax with running max/sum — as ONE Pallas kernel per
+(batch*head, Q-block): K/V live in VMEM, the K-block loop runs on-core,
+scores/accumulators never touch HBM.  Numerics match the scan
+formulation (f32 accumulation, running-max rescaling).
+
+Backward: a ``jax.custom_vjp`` whose reverse pass differentiates the XLA
+blockwise formulation (identical function values), so training code can
+call this transparently; the forward — the long-context memory
+bottleneck — runs the Pallas kernel.
+
+Used by ``parallel/ring_attention.blockwise_attention`` on TPU when
+``MXNET_TPU_PALLAS_ATTN`` != "0" and K/V fit VMEM; larger shapes fall
+back to the scan.  Reference analog: none (the 2018 reference predates
+flash attention); ref for the surrounding design: SURVEY.md §5.7.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "flash_attention_available"]
+
+INTERPRET = False
+
+
+def flash_attention_available(B, H, Tq, Tk, D, dtype=None) -> bool:
+    if os.environ.get("MXNET_TPU_PALLAS_ATTN", "1") == "0":
+        return False
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    if D % 8 or Tq % 8 or Tk % 128:
+        return False
+    # K+V resident in VMEM per (b,h) program, double-buffered by the
+    # pipeline.  Measured crossover (tools/bench_ring_attention.py):
+    # the kernel wins 1.9x while K/V stream from VMEM comfortably
+    # (T=4096/D=128), loses once the resident set crowds the 16 MB
+    # scoped-vmem limit (T=8192: 0.84x; T=16384: compile failure) —
+    # larger shapes use the HBM-blocked lax.scan formulation instead.
+    esize = jnp.dtype(dtype).itemsize if dtype is not None else 2
+    kv_bytes = 2 * Tk * D * esize
+    return 2 * kv_bytes <= 5 * 1024 * 1024
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, TQ, BK, Tk, causal,
+                  scale, q_chunk_count):
+    qi = pl.program_id(1)
+    qb = q_ref[0]                                    # (TQ, D)
+    D = qb.shape[-1]
+
+    m0 = jnp.full((TQ,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((TQ,), jnp.float32)
+    a0 = jnp.zeros((TQ, D), jnp.float32)
+
+    q_pos = qi * TQ + jax.lax.broadcasted_iota(jnp.int32, (TQ, BK), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(i * BK, BK), :]        # (BK, D)
+        vblk = v_ref[0, pl.ds(i * BK, BK), :]
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (TQ, BK)
+        if causal:
+            k_pos = i * BK + jax.lax.broadcasted_iota(
+                jnp.int32, (TQ, BK), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        # guard fully-masked rows: exp(-inf - (-inf)) -> use finite base
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        acc2 = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l2, acc2
+
+    m, l, acc = jax.lax.fori_loop(0, Tk // BK, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-37)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    q3 = q.reshape(BH, Tq, D)
+    k3 = k.reshape(BH, Tk, D)
+    v3 = v.reshape(BH, Tk, D)
+    TQ = min(block_q, Tq)
+    while Tq % TQ:
+        TQ //= 2
+    BK = min(block_k, Tk)
+    while Tk % BK:
+        BK //= 2
+
+    kern = functools.partial(
+        _flash_kernel, TQ=TQ, BK=BK, Tk=Tk, causal=causal, scale=scale,
+        q_chunk_count=Tq // TQ)
+    out = pl.pallas_call(
+        kern,
+        grid=(BH, Tq // TQ),
+        in_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        interpret=INTERPRET,
+    )(q3, k3, v3)
+    return out.reshape(B, H, Tq, D)
+
+
+def _xla_blockwise(q, k, v, causal, scale):
+    # import here to avoid a parallel<->ops import cycle at module load
+    from ..parallel.ring_attention import blockwise_attention
+    return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                               use_pallas=False)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512):
+    """[B,H,T,D] attention; Pallas forward, XLA-recompute backward."""
+    sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, causal, sc, block_q, block_k)
+
+
+def _fa_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    return (flash_attention(q, k, v, causal, scale, block_q, block_k),
+            (q, k, v))
+
+
+def _fa_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    _, vjp = jax.vjp(lambda a, b, c: _xla_blockwise(a, b, c, causal, sc),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)

@@ -50,11 +50,21 @@ def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev, scale):
 
 
 def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
-                        scale: Optional[float] = None):
-    """Flash-style attention via lax.scan over K/V blocks.  [B,H,T,D]."""
+                        scale: Optional[float] = None,
+                        use_pallas: bool = True):
+    """Flash-style attention via lax.scan over K/V blocks.  [B,H,T,D].
+
+    On TPU, shapes whose K/V fit VMEM dispatch to the Pallas flash
+    kernel (ops/pallas_attention.py): same online-softmax math, but the
+    whole K-loop runs on-core with scores never touching HBM."""
     B, H, T, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if use_pallas:
+        from ..ops import pallas_attention as pa
+        if pa.flash_attention_available(B, H, T, Tk, D, q.dtype):
+            return pa.flash_attention(q, k, v, causal, scale,
+                                      block_size, block_size)
     bs = min(block_size, Tk)
     nblocks = (Tk + bs - 1) // bs
     pad = nblocks * bs - Tk

@@ -1,25 +1,81 @@
-"""Storage inspection (parity shim for SURVEY.md N2).
+"""Storage manager (SURVEY.md N2): pooling config, lifecycle, inspection.
 
 Reference analog: ``include/mxnet/storage.h`` + ``src/storage/
 pooled_storage_manager.h`` — per-device memory pools with env-tunable
-reserve/page knobs.  On TPU, device memory is owned by PjRt/XLA (its own
-HBM pooling), so the *management* half has no user surface; what remains
-useful is the *inspection* half: per-device usage stats for the profiler
-and OOM debugging.
+strategy/reserve knobs (``MXNET_GPU_MEM_POOL_TYPE``,
+``MXNET_GPU_MEM_POOL_RESERVE``) plus ``DirectFree``/``ReleaseAll``.
+
+TPU-native split of those duties:
+  * the *allocator* is PjRt/XLA's BFC pool — its knobs are process-level
+    environment settings that must land before backend init;
+    :func:`apply_pool_env` translates the reference's env-var surface to
+    the XLA client knobs (and is called from ``mxnet_tpu/__init__`` so
+    ``MXNET_*`` spellings work for TPU runs too);
+  * *lifecycle*: :func:`release_all` is the ReleaseAll/empty-cache
+    analog — drops compiled-executable caches and triggers host GC so
+    dead device buffers return to the pool;
+  * *inspection*: allocator stats, live-buffer census, and a
+    ``gpu_memory_info``-style (free, total) pair for the profiler and
+    OOM debugging.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+import gc
+import os
+from typing import Dict, Optional, Tuple
 
 import jax
 
-__all__ = ["memory_stats", "bytes_allocated", "bytes_limit", "report"]
+__all__ = ["apply_pool_env", "memory_stats", "bytes_allocated",
+           "bytes_limit", "memory_info", "live_arrays", "release_all",
+           "report"]
+
+
+def apply_pool_env(environ=None) -> Dict[str, str]:
+    """Map the reference's memory-pool env knobs onto XLA client settings.
+
+    Must run BEFORE the jax backend initializes (imported from
+    ``mxnet_tpu/__init__``).  Mappings:
+
+    - ``MXNET_GPU_MEM_POOL_TYPE=Unpooled`` -> ``XLA_PYTHON_CLIENT_ALLOCATOR=platform``
+    - ``MXNET_GPU_MEM_POOL_RESERVE=<pct>`` -> ``XLA_PYTHON_CLIENT_MEM_FRACTION=(100-pct)/100``
+    - ``MXNET_TPU_PREALLOCATE=0`` -> ``XLA_PYTHON_CLIENT_PREALLOCATE=false``
+
+    Returns the settings it exported (for tests/logging).  Existing XLA
+    settings are never overwritten.
+    """
+    env = environ if environ is not None else os.environ
+    applied = {}
+    pool = env.get("MXNET_GPU_MEM_POOL_TYPE", "")
+    if pool.lower() == "unpooled" and \
+            "XLA_PYTHON_CLIENT_ALLOCATOR" not in env:
+        applied["XLA_PYTHON_CLIENT_ALLOCATOR"] = "platform"
+    reserve = env.get("MXNET_GPU_MEM_POOL_RESERVE", "")
+    if reserve and "XLA_PYTHON_CLIENT_MEM_FRACTION" not in env:
+        try:
+            frac = max(0.0, min(1.0, (100.0 - float(reserve)) / 100.0))
+            applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] = "%.2f" % frac
+        except ValueError:
+            pass
+    if env.get("MXNET_TPU_PREALLOCATE", "") == "0" and \
+            "XLA_PYTHON_CLIENT_PREALLOCATE" not in env:
+        applied["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
+    env.update(applied)
+    return applied
+
+
+def _as_device(device):
+    """Accept a jax Device or an mxnet Context (Context.jax_device)."""
+    if device is None:
+        return None
+    return getattr(device, "jax_device", device)
 
 
 def memory_stats(device: Optional[object] = None) -> Dict:
     """Raw allocator stats of a device (PjRt ``memory_stats``); {} when the
-    backend doesn't expose them (e.g. CPU)."""
-    dev = device or jax.devices()[0]
+    backend doesn't expose them (e.g. CPU).  Accepts a jax Device or an
+    mxnet Context."""
+    dev = _as_device(device) or jax.devices()[0]
     try:
         return dict(dev.memory_stats() or {})
     except (AttributeError, jax.errors.JaxRuntimeError):
@@ -32,6 +88,42 @@ def bytes_allocated(device=None) -> int:
 
 def bytes_limit(device=None) -> int:
     return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def memory_info(device=None) -> Tuple[int, int]:
+    """(free_bytes, total_bytes) — the ``mx.context.gpu_memory_info``
+    analog for the current accelerator."""
+    st = memory_stats(device)
+    total = int(st.get("bytes_limit", 0))
+    used = int(st.get("bytes_in_use", 0))
+    return max(total - used, 0), total
+
+
+def live_arrays(device=None) -> Tuple[int, int]:
+    """(count, total_bytes) of live jax arrays, optionally filtered to one
+    device — the storage manager's live-allocation census."""
+    device = _as_device(device)
+    count = 0
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if device is not None and device not in a.devices():
+                continue
+            count += 1
+            total += a.nbytes
+        except Exception:       # deleted/donated buffers
+            continue
+    return count, total
+
+
+def release_all() -> None:
+    """ReleaseAll/empty-cache analog: drop compiled-executable caches and
+    collect host garbage so dead device buffers return to the pool.
+    (Live NDArrays are untouched — PjRt frees buffers on refcount zero.)
+    """
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
 
 
 def report() -> str:

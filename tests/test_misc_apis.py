@@ -254,3 +254,43 @@ class TestIm2Rec:
                                    data_shape=(3, 16, 16), batch_size=2)
         batch = it.next()
         assert batch.data[0].shape == (2, 3, 16, 16)
+
+
+def test_storage_manager_surface():
+    """N2 storage manager: pool-env translation, census, lifecycle."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import storage
+
+    # env translation (pure dict, no process effects)
+    env = {"MXNET_GPU_MEM_POOL_TYPE": "Unpooled",
+           "MXNET_GPU_MEM_POOL_RESERVE": "20",
+           "MXNET_TPU_PREALLOCATE": "0"}
+    applied = storage.apply_pool_env(env)
+    assert applied["XLA_PYTHON_CLIENT_ALLOCATOR"] == "platform"
+    assert applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.80"
+    assert applied["XLA_PYTHON_CLIENT_PREALLOCATE"] == "false"
+    # never overwrites explicit XLA settings
+    env2 = {"MXNET_GPU_MEM_POOL_RESERVE": "50",
+            "XLA_PYTHON_CLIENT_MEM_FRACTION": "0.33"}
+    assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in \
+        storage.apply_pool_env(env2)
+    assert env2["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.33"
+
+    # live-array census sees a new allocation
+    c0, b0 = storage.live_arrays()
+    keep = mx.nd.array(np.ones((64, 64), np.float32))
+    keep.wait_to_read()
+    c1, b1 = storage.live_arrays()
+    assert c1 >= c0 + 1 and b1 >= b0 + 64 * 64 * 4
+
+    # memory_info returns (free, total); CPU backends report (0, 0)
+    free, total = storage.memory_info()
+    assert free >= 0 and total >= 0
+
+    # release_all drops executable caches without touching live arrays
+    storage.release_all()
+    np.testing.assert_allclose(keep.asnumpy(), 1.0)
+    assert storage.report().startswith("Device") or "Device" in \
+        storage.report()

@@ -152,3 +152,37 @@ def test_quantize_model_excluded_layer():
     assert "fc1_weight" in qsym.list_arguments()
     assert "fc1_weight_quantize" not in qsym.list_arguments()
     assert "conv1_weight_quantize" in qsym.list_arguments()
+
+
+def test_quantize_model_with_batchnorm():
+    """BN networks quantize end-to-end (regression: quantize_graph used to
+    index hidden outputs of multi-output nodes like BatchNorm and crash
+    with IndexError on every BN model, e.g. the ResNet zoo)."""
+    rng = np.random.RandomState(1)
+    data = sym.var("data")
+    h = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        no_bias=True, name="convq")
+    h = sym.BatchNorm(h, fix_gamma=False, name="bnq")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out = sym.FullyConnected(sym.Flatten(h), num_hidden=4, name="fcq")
+
+    xs = nd.array(rng.rand(4, 3, 8, 8).astype(np.float32))
+    arg_shapes, _, aux_shapes = out.infer_shape(data=(4, 3, 8, 8))
+    args, auxs = {}, {}
+    for n, s in zip(out.list_arguments(), arg_shapes):
+        if n != "data":
+            args[n] = nd.array((rng.randn(*s) * 0.1).astype(np.float32))
+    for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+        auxs[n] = nd.array(
+            np.ones(s, np.float32) if "var" in n else np.zeros(s, np.float32))
+
+    qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+        out, args, auxs, calib_mode="none", calib_data=None)
+    assert any(n.endswith("_quantize") for n in qargs)
+    ex_q = qsym.bind(mx.cpu(), {**qargs, "data": xs}, aux_states=qauxs)
+    q_out = ex_q.forward(is_train=False)[0].asnumpy()
+    ex_f = out.bind(mx.cpu(), {**args, "data": xs}, aux_states=auxs)
+    f_out = ex_f.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(q_out).all()
+    assert np.abs(q_out - f_out).max() < 0.25

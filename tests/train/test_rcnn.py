@@ -1,27 +1,38 @@
 """Faster R-CNN end-to-end example (parity: example/rcnn/train_end2end.py
 — exercises Proposal, ROIPooling, SoftmaxOutput ignore labels, smooth_l1,
-and the ProposalTarget custom-op bridge in one training graph)."""
-import argparse
-import importlib.util
+and the ProposalTarget custom-op bridge in one training graph).
+
+Runs in a fresh subprocess: the example is long (40 train iters through
+the custom-op worker thread), and after a long in-process suite the
+accumulated thread/cache state has twice produced a main<->worker futex
+deadlock that a clean interpreter never reproduces.  Subprocess isolation
+keeps the suite deterministic AND still fails on any real regression in
+the rcnn graph (the loss-drop assertion is parsed from the run).
+"""
 import os
+import re
+import subprocess
+import sys
 
-import numpy as np
-
-
-def _module():
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "..", "example", "rcnn",
-        "train_end2end.py")
-    spec = importlib.util.spec_from_file_location("rcnn_example", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def test_rcnn_end2end_loss_drops():
-    np.random.seed(0)
-    mod = _module()
-    first, last = mod.train(argparse.Namespace(num_iter=40, lr=0.02))
-    assert np.isfinite(last)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # repo only — an accelerator sitecustomize on PYTHONPATH (axon) would
+    # re-register the real backend and override JAX_PLATFORMS=cpu (same
+    # pattern as __graft_entry__._dryrun_subprocess / test_benchmarks)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example", "rcnn", "train_end2end.py"),
+         "--num-iter", "40", "--lr", "0.02"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert r.returncode == 0, r.stderr[-1500:]
+    m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", r.stdout)
+    assert m, "no loss line in output:\n%s" % r.stdout[-500:]
+    first, last = float(m.group(1)), float(m.group(2))
     assert last < first * 0.8, \
         "rcnn loss did not drop: %.3f -> %.3f" % (first, last)

@@ -27,6 +27,15 @@ def test_pretrained_digits_lenet_score():
         % (acc, mod.PRETRAINED["digits-lenet"][1] - 0.01)
 
 
+def test_pretrained_digits_resnet_score():
+    """Second shipped architecture (residual net) keeps its accuracy —
+    covers BatchNorm aux-state checkpointing and residual topology."""
+    mod = _score_module()
+    acc, ok = mod.score("digits-resnet", 25)
+    assert ok, "digits-resnet scored %.4f, expected >= %.4f" \
+        % (acc, mod.PRETRAINED["digits-resnet"][1] - 0.01)
+
+
 def test_model_store_resolves_repo_artifact():
     """get_model_file falls back to the in-repo models/ directory."""
     path = get_model_file("digits-lenet")

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""int8 vs bf16 ResNet-50 inference on the real chip (VERDICT r2 item 6).
+
+Reference analog: docs/faq/perf.md:163-177 publishes fp16 inference at
+1.9x fp32 on V100; the TPU equivalent claim is the MXU's native
+s8xs8->s32 path.  This bench quantizes the model zoo ResNet-50 with the
+calibration pass (contrib/quantization.py) and times both variants with
+an in-jit data-dependent chain (each forward feeds a perturbation of the
+previous logits back into the input, so steps serialize on-device),
+measured differentially (2N vs N chains cancels the ~100 ms tunnel RTT).
+Inference has no donated-state chain, so bench.py's window protocol
+cannot serialize it — this is the honest timing for forward-only
+workloads.  Each dtype variant runs in its own subprocess (full-model
+chains at batch 128 exhaust HBM when both live in one process).
+
+Run:  python tools/bench_int8_inference.py
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPS = 3
+CHAIN = 24
+
+
+def chain_time(plan_fn, x0, chain=CHAIN):
+    import jax
+    import jax.numpy as jnp
+
+    def build(n):
+        @jax.jit
+        def f(x):
+            def body(c, _):
+                out = plan_fn(c)
+                eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(
+                    c.dtype)
+                return c + eps, None
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return jnp.sum(y.astype(jnp.float32))
+        return f
+
+    f1, f2 = build(chain), build(2 * chain)
+    float(f1(x0)); float(f2(x0))
+    b1 = b2 = 1e9
+    for _ in range(REPS):
+        t0 = time.perf_counter(); float(f1(x0))
+        b1 = min(b1, time.perf_counter() - t0)
+        t0 = time.perf_counter(); float(f2(x0))
+        b2 = min(b2, time.perf_counter() - t0)
+    return max(b2 - b1, 1e-9) / chain
+
+
+def run_variant(variant):
+    """Executed in a subprocess: print one JSON line for the variant."""
+    import numpy as np
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.executor import _Plan
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import NDArrayIter
+    import jax.numpy as jnp
+
+    ctx = mx.tpu(0) if mx.context.num_tpus() else mx.cpu(0)
+    batch = 128 if ctx.device_type == "tpu" else 8
+    size = 224 if ctx.device_type == "tpu" else 32
+
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    x = mx.nd.random.uniform(0, 1, shape=(batch, 3, size, size), ctx=ctx)
+    net(x).wait_to_read()
+
+    sym = net(S.var("data"))
+    params = net.collect_params()
+    args = {n: params[n].data()._data for n in sym.list_arguments()
+            if n != "data"}
+    auxs = {n: params[n].data()._data
+            for n in sym.list_auxiliary_states()}
+
+    if variant == "bf16":
+        plan = _Plan(sym, train=False)
+        vals = {n: v.astype(jnp.bfloat16) for n, v in args.items()}
+        avals = {n: v.astype(jnp.bfloat16) for n, v in auxs.items()}
+        keys = jnp.zeros((max(1, plan.n_rng), 2), jnp.uint32)
+
+        def fwd(data):
+            outs, _ = plan.execute({**vals, "data": data}, avals, keys)
+            return outs[0]
+
+        t = chain_time(fwd, x._data.astype(jnp.bfloat16))
+        print(json.dumps({"variant": "bf16", "ms": t * 1e3,
+                          "img_per_sec": batch / t, "batch": batch}))
+        return 0
+
+    # int8
+    import numpy as np
+    # small calib batch: the calibration pass materializes every
+    # conv/FC output at once (53 layers x batch) — batch 32 at 224px
+    # exhausts HBM
+    calib = NDArrayIter(data=x.asnumpy()[:8], batch_size=8)
+    qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+        sym, {n: mx.nd.array(np.asarray(v, np.float32))
+              for n, v in args.items()},
+        {n: mx.nd.array(np.asarray(v, np.float32))
+         for n, v in auxs.items()},
+        ctx=ctx, calib_mode="naive", calib_data=calib,
+        num_calib_examples=8)
+    qplan = _Plan(qsym, train=False)
+    qvals = {n: (v._data if hasattr(v, "_data") else jnp.asarray(v))
+             for n, v in qargs.items()}
+    qaux = {n: (v._data if hasattr(v, "_data") else jnp.asarray(v))
+            for n, v in qauxs.items()}
+    qkeys = jnp.zeros((max(1, qplan.n_rng), 2), jnp.uint32)
+
+    def fwdq(data):
+        outs, _ = qplan.execute({**qvals, "data": data}, qaux, qkeys)
+        return outs[0]
+
+    t = chain_time(fwdq, x._data)
+    ref = net(x).asnumpy().argmax(1)
+    # jit: the eager per-op replay would hold every layer's s32
+    # activations live at once and exhaust HBM at batch 128
+    q_top1 = np.asarray(jax.jit(fwdq)(x._data)).argmax(1)
+    agree = float((q_top1 == ref).mean())
+    print(json.dumps({"variant": "int8", "ms": t * 1e3,
+                      "img_per_sec": batch / t,
+                      "top1_agreement_vs_fp32": agree, "batch": batch}))
+    return 0
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] in ("bf16", "int8"):
+        return run_variant(sys.argv[1])
+
+    env = dict(os.environ)
+    extra = [REPO]
+    if os.path.isdir("/root/.axon_site"):   # axon PJRT sitecustomize
+        extra.append("/root/.axon_site")
+    env["PYTHONPATH"] = os.pathsep.join(
+        extra + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    rows = {}
+    for variant in ("bf16", "int8"):
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), variant],
+            env=env, capture_output=True, text=True, timeout=1500)
+        if p.returncode != 0:
+            rows[variant] = {"error": p.stderr[-400:]}
+            continue
+        rows[variant] = json.loads(p.stdout.strip().splitlines()[-1])
+
+    out = {"metric": "resnet50_int8_vs_bf16_inference"}
+    out.update(rows)
+    if "error" not in rows.get("bf16", {}) and \
+            "error" not in rows.get("int8", {}):
+        out["int8_speedup"] = round(rows["bf16"]["ms"]
+                                    / rows["int8"]["ms"], 3)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())
