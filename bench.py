@@ -44,9 +44,11 @@ TRAIN_GFLOPS_PER_IMG = 12.3
 _DEFAULT_PEAK = {"bfloat16": 197.0, "float16": 197.0, "float32": 99.0}
 
 
-def _measure(step, fetch, batch_items, warmup, iters):
+def _measure(step, fetch, batch_items, warmup, iters, window_iters=None):
     """Shared measurement protocol: per-step hard-blocked latencies, then
-    windowed steady-state with the 2x linear-scaling validation."""
+    windowed steady-state with the 2x linear-scaling validation.
+    ``window_iters`` widens only the scaling windows (retry path)."""
+    window_iters = window_iters or iters
     for _ in range(warmup):
         fetch(step())
 
@@ -67,11 +69,11 @@ def _measure(step, fetch, batch_items, warmup, iters):
         lval = fetch(loss)
         return time.perf_counter() - t0, lval
 
-    w1, lval = window(iters)
-    w2, lval = window(2 * iters)
+    w1, lval = window(window_iters)
+    w2, lval = window(2 * window_iters)
     scaling = w2 / w1 if w1 > 0 else 0.0
     scaling_ok = 1.55 <= scaling <= 2.6
-    window_rate = batch_items * 3 * iters / (w1 + w2)
+    window_rate = batch_items * 3 * window_iters / (w1 + w2)
     rate = window_rate if scaling_ok else blocked_rate
     return {
         "rate": rate, "blocked_rate": blocked_rate,
@@ -139,6 +141,15 @@ def bench_lstm_lm(ctx, dtype, peak_tflops):
         return float(loss.asnumpy().ravel()[0])
 
     m = _measure(lambda: ft.step(x, y), fetch, bptt * batch, warmup, iters)
+    retried = False
+    if m["window_suspect"] and ctx.device_type != "cpu":
+        # the scaling validation can flake when dispatch latency jitters;
+        # one retry with doubled windows (blocked phase kept short) before
+        # settling for the conservative blocked number — recorded in the
+        # output so a passed retry is distinguishable from a clean pass
+        retried = True
+        m = _measure(lambda: ft.step(x, y), fetch, bptt * batch, 1,
+                     iters, window_iters=2 * iters)
     if not np.isfinite(m["last_loss"]):
         return {"metric": "lstm_lm_train_tokens_per_sec", "value": 0.0,
                 "unit": "tokens/s/chip", "error": "non-finite loss"}, 1
@@ -166,6 +177,7 @@ def bench_lstm_lm(ctx, dtype, peak_tflops):
         "blocked_tokens_per_sec": round(m["blocked_rate"], 1),
         "window_scaling_ratio": round(m["window_scaling_ratio"], 3),
         "window_suspect": m["window_suspect"],
+        "window_retried": retried,
         "achieved_tflops": round(achieved, 2),
         "mfu_pct": round(100 * mfu, 2),
     }, 0
