@@ -25,12 +25,25 @@ def test_rcnn_end2end_loss_drops():
     # re-register the real backend and override JAX_PLATFORMS=cpu (same
     # pattern as __graft_entry__._dryrun_subprocess / test_benchmarks)
     env["PYTHONPATH"] = REPO
-    r = subprocess.run(
-        [sys.executable,
-         os.path.join(REPO, "example", "rcnn", "train_end2end.py"),
-         "--num-iter", "40", "--lr", "0.02"],
-        capture_output=True, text=True, env=env, timeout=900)
-    assert r.returncode == 0, r.stderr[-1500:]
+    # the custom-op host-callback bridge has a rare wedge under load
+    # (jax host-callback thread vs re-entrant dispatch from the worker;
+    # see operator.py _on_worker) — bound it tightly and retry once in a
+    # fresh interpreter rather than eat 10 minutes of suite time
+    env["MXNET_CUSTOM_OP_TIMEOUT_SEC"] = "120"
+    last_err = ""
+    for attempt in range(2):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "example", "rcnn", "train_end2end.py"),
+             "--num-iter", "40", "--lr", "0.02"],
+            capture_output=True, text=True, env=env, timeout=900)
+        if r.returncode == 0:
+            break
+        last_err = r.stderr[-1500:]
+        wedged = "Custom-op callback did not complete" in r.stderr
+        assert wedged, last_err     # real failures don't get a retry
+    else:
+        raise AssertionError("custom-op worker wedged twice:\n" + last_err)
     m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", r.stdout)
     assert m, "no loss line in output:\n%s" % r.stdout[-500:]
     first, last = float(m.group(1)), float(m.group(2))
