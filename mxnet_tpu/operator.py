@@ -33,6 +33,28 @@ __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
 # worker thread (custom-inl.h:50-173); mirror that
 _WORKER = concurrent.futures.ThreadPoolExecutor(
     max_workers=1, thread_name_prefix="mxnet_custom_op")
+_WORKER_WARM = False
+
+
+def _warm_worker():
+    """Pre-warm the worker thread's jax dispatch path from a NORMAL
+    python thread (trace time), before any XLA host callback exists.
+    First-use lazy init (thread spawn + first eager dispatch in that
+    thread) racing under a host-callback context is the prime suspect
+    for the rare bridge wedge (docs/DEVIATIONS.md)."""
+    global _WORKER_WARM
+    if _WORKER_WARM:
+        return
+    _WORKER_WARM = True
+
+    def _w():
+        from . import ndarray as nd
+        nd.array(np.zeros((1,), np.float32)).asnumpy()
+
+    try:
+        _WORKER.submit(_w).result(timeout=60)
+    except Exception:
+        pass
 
 
 def _on_worker(fn, *args):
@@ -187,6 +209,7 @@ def _custom_aux_writeback(attrs):
 def _custom(attrs, *inputs):
     """The Custom op: host-callback execution of user Python code."""
     from . import ndarray as nd
+    _warm_worker()   # trace-time: worker + its jax path init OUTSIDE callbacks
     prop = _prop_of(attrs)
     n_args = len(prop.list_arguments())
     n_aux = len(prop.list_auxiliary_states())

@@ -29,13 +29,13 @@ def test_rcnn_end2end_loss_drops():
     # (jax host-callback thread vs re-entrant dispatch from the worker;
     # see operator.py _on_worker) — bound it tightly and retry once in a
     # fresh interpreter rather than eat 10 minutes of suite time
-    env["MXNET_CUSTOM_OP_TIMEOUT_SEC"] = "120"
+    env["MXNET_CUSTOM_OP_TIMEOUT_SEC"] = "300"
     last_err = ""
-    for attempt in range(2):
+    for attempt in range(3):
         r = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "example", "rcnn", "train_end2end.py"),
-             "--num-iter", "40", "--lr", "0.02"],
+             "--num-iter", "35", "--lr", "0.02"],
             capture_output=True, text=True, env=env, timeout=900)
         if r.returncode == 0:
             break
@@ -43,7 +43,7 @@ def test_rcnn_end2end_loss_drops():
         wedged = "Custom-op callback did not complete" in r.stderr
         assert wedged, last_err     # real failures don't get a retry
     else:
-        raise AssertionError("custom-op worker wedged twice:\n" + last_err)
+        raise AssertionError("custom-op worker wedged 3x:\n" + last_err)
     m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", r.stdout)
     assert m, "no loss line in output:\n%s" % r.stdout[-500:]
     first, last = float(m.group(1)), float(m.group(2))
