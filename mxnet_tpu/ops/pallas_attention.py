@@ -33,13 +33,13 @@ INTERPRET = False
 
 
 def flash_attention_available(B, H, Tq, Tk, D, dtype=None) -> bool:
+    """SIZE/ENV eligibility only — would the kernel compile on a TPU.
+
+    No platform check here: callers resolve TPU-vs-other at LOWERING time
+    via ``jax.lax.platform_dependent`` (parallel/ring_attention.py), so
+    CPU-committed arrays on a TPU host lower the scan formulation instead
+    of Mosaic (advisor r03)."""
     if os.environ.get("MXNET_TPU_PALLAS_ATTN", "1") == "0":
-        return False
-    try:
-        platform = jax.default_backend()
-    except Exception:
-        return False
-    if platform not in ("tpu", "axon"):
         return False
     if D % 8 or Tq % 8 or Tk % 128:
         return False

@@ -63,22 +63,36 @@ def _cell_scan(mode, xproj, h0, c0, R, bR):
 
     if mode == "lstm":
         from . import pallas_rnn
+
+        def _lstm_scan_xla(xp, h, c):
+            def step(carry, row):
+                hh, cc = carry
+                gates = row + hh @ R.T + bR
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * cc \
+                    + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+
+            (hT, cT), ys = jax.lax.scan(step, (h, c), xp)
+            return ys, hT, cT
+
         if pallas_rnn.lstm_scan_available(xproj.shape[1], h_sz,
-                                          xproj.dtype, data=xproj):
+                                          xproj.dtype):
+            if pallas_rnn.INTERPRET:   # test hook: force the interpreter
+                return pallas_rnn.lstm_scan(xproj, h0, c0, R, bR)
             # fused Pallas recurrence (cuDNN-RNN role): whole time loop in
-            # one kernel, h/c resident in VMEM, custom VJP
-            return pallas_rnn.lstm_scan(xproj, h0, c0, R, bR)
-
-        def step(carry, xp):
-            h, c = carry
-            gates = xp + h @ R.T + bR
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
-            return (h_new, c_new), h_new
-
-        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xproj)
-        return ys, hT, cT
+            # one kernel, h/c resident in VMEM, custom VJP.  The platform
+            # branch is resolved at LOWERING time, so CPU-committed arrays
+            # on a TPU host compile the scan, never Mosaic (advisor r03).
+            # The axon PJRT plugin registers platform name "tpu" (verified:
+            # the compiled LM step carries the Mosaic custom-call through
+            # the tunnel), so the tpu= key covers it.
+            return jax.lax.platform_dependent(
+                xproj, h0, c0,
+                tpu=lambda xp, h, c: pallas_rnn.lstm_scan(xp, h, c, R, bR),
+                default=_lstm_scan_xla)
+        return _lstm_scan_xla(xproj, h0, c0)
 
     if mode == "gru":
         Rr, Rz, Rn = jnp.split(R, 3, axis=0)

@@ -63,8 +63,17 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
     if use_pallas:
         from ..ops import pallas_attention as pa
         if pa.flash_attention_available(B, H, T, Tk, D, q.dtype):
-            return pa.flash_attention(q, k, v, causal, scale,
-                                      block_size, block_size)
+            flash = partial(pa.flash_attention, causal=causal, scale=scale,
+                            block_q=block_size, block_k=block_size)
+            if pa.INTERPRET:   # test hook: force the interpreter on CPU
+                return flash(q, k, v)
+            # platform resolved at LOWERING time: CPU-committed arrays on
+            # a TPU host get the scan branch, never Mosaic (advisor r03)
+            return jax.lax.platform_dependent(
+                q, k, v, tpu=flash,
+                default=partial(blockwise_attention, block_size=block_size,
+                                causal=causal, scale=scale,
+                                use_pallas=False))
     bs = min(block_size, Tk)
     nblocks = (Tk + bs - 1) // bs
     pad = nblocks * bs - Tk

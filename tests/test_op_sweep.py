@@ -222,6 +222,10 @@ FD_SPECS = {
         lambda: sym.pick(sym.var("x"), sym.var("idx"), axis=1),
         lambda r: {"x": _u((3, 4), r=r), "idx": np.array([0., 3., 1.])},
         {"grad_nodes": ["x"]}),
+    "streaming_softmax_ce": (
+        lambda: sym.streaming_softmax_ce(sym.var("x"), sym.var("lab")),
+        lambda r: {"x": _u((3, 5), r=r), "lab": np.array([0., 4., 2.])},
+        {"grad_nodes": ["x"]}),
     "Embedding": (
         lambda: sym.Embedding(sym.var("idx"), sym.var("w"), input_dim=5,
                               output_dim=3),

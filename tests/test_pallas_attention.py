@@ -56,5 +56,29 @@ def test_backward_matches_blockwise():
                                    rtol=1e-4, atol=1e-4, err_msg=n)
 
 
-def test_availability_gate_closed_on_cpu():
-    assert not pa.flash_attention_available(1, 8, 1024, 1024, 128)
+def test_blockwise_lowering_selects_scan_off_tpu():
+    """Advisor r03 regression: with the size gate open and INTERPRET off,
+    a CPU compilation of blockwise_attention must lower the scan branch
+    (lax.platform_dependent), never the Mosaic kernel — which would error
+    at CPU lowering, so compiling+running proves the selection.  Gradient
+    must flow through the platform branch too."""
+    pa.INTERPRET = False             # defeat the autouse interpret fixture
+    q, k, v = _case(T=128)
+    assert pa.flash_attention_available(2, 2, 128, 128, 16)
+
+    f = jax.jit(lambda q, k, v: blockwise_attention(
+        q, k, v, block_size=128, causal=True))
+    txt = f.lower(q, k, v).compile().as_text()
+    assert "tpu_custom_call" not in txt and "Mosaic" not in txt
+    got = f(q, k, v)
+    ref = blockwise_attention(q, k, v, block_size=32, causal=True,
+                              use_pallas=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.grad(lambda q: jnp.sum(blockwise_attention(
+        q, k, v, block_size=128, causal=True) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(blockwise_attention(
+        q, k, v, block_size=32, causal=True, use_pallas=False) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-4, atol=1e-4)

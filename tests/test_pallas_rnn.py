@@ -77,6 +77,25 @@ def test_backward_matches_scan():
             err_msg=name)
 
 
-def test_rnn_op_uses_fallback_on_cpu():
-    # on CPU the availability gate must be closed (scan path covers it)
-    assert not pallas_rnn.lstm_scan_available(8, 16)
+def test_lstm_lowering_selects_scan_off_tpu():
+    """Advisor r03 regression: the TPU-vs-other choice is made at
+    LOWERING time (lax.platform_dependent), so a CPU compilation must
+    take the scan branch even though the size gate is open and the host
+    may have a TPU default backend.  The Mosaic branch errors at CPU
+    lowering, so merely compiling+running here proves the selection."""
+    pallas_rnn.INTERPRET = False     # defeat the autouse interpret fixture
+    from mxnet_tpu.ops import rnn as rnn_ops
+
+    # the gate is platform-free now: size/env eligibility only
+    assert pallas_rnn.lstm_scan_available(8, 16)
+
+    args = _rand_case(T=3)
+    f = jax.jit(lambda *a: rnn_ops._cell_scan("lstm", *a))
+    txt = f.lower(*args).compile().as_text()
+    assert "tpu_custom_call" not in txt and "Mosaic" not in txt
+    ys, hT, cT = f(*args)
+    ys_r, hT_r, cT_r = _scan_ref(*args)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ys_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(cT_r),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Probe: streaming-CE buffer footprint of the PUBLIC gluon loss on TPU.
+
+Round-3 verdict item 2 evidence: compiles gluon.loss.SoftmaxCrossEntropyLoss
+(forward and gradient) at the LM bench shape (T*B=2560, vocab=33278, bf16)
+on the current default backend and prints the XLA temp-allocation size.
+On TPU both compile to temp=0 B — the logsumexp/convert/exp chain fuses
+entirely into the reductions, so no (N, vocab) buffer of ANY dtype is
+allocated (measured 2026-07-31 on v5e via the axon tunnel; the CPU backend
+instead materializes one converted operand for its reduce-window strategy,
+which is why tests/test_streaming_ce.py asserts the relative-footprint
+form on CPU and the strict form on TPU).
+"""
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import gluon
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+BIG = (2560, 33278)
+F32_BUF = BIG[0] * BIG[1] * 4
+
+
+def public_mean_ce(lg, lab):
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    return jnp.mean(ce(NDArray(lg), NDArray(lab))._data
+                    .astype(jnp.float32))
+
+
+def main():
+    print("backend:", jax.default_backend())
+    lg = jax.ShapeDtypeStruct(BIG, jnp.bfloat16)
+    lab = jax.ShapeDtypeStruct((BIG[0],), jnp.float32)
+    for name, fn in (("forward", public_mean_ce),
+                     ("gradient", jax.grad(public_mean_ce))):
+        ma = jax.jit(fn).lower(lg, lab).compile().memory_analysis()
+        print("%s: temp=%.2f MB (f32 (N,vocab) buffer would be %.1f MB) %s"
+              % (name, ma.temp_size_in_bytes / 1e6, F32_BUF / 1e6,
+                 "OK" if ma.temp_size_in_bytes < F32_BUF else "FAIL"))
+
+
+if __name__ == "__main__":
+    main()
