@@ -152,6 +152,296 @@ def quantize_graph(sym, excluded_sym_names: Sequence[str] = (),
     return qsym, offline
 
 
+def _to_np(v):
+    return np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                      np.float32)
+
+
+def fold_batchnorm(sym, arg_params, aux_params):
+    """Inference-time BatchNorm folding (the graph-level half of the
+    reference's quantize pass; MKLDNN does the same fold inside
+    ``src/operator/subgraph/mkldnn/mkldnn_conv.cc``): every BatchNorm whose
+    sole producer is a Convolution with variable weights is absorbed into
+    that conv's weight/bias::
+
+        W' = W * gamma/sqrt(var+eps)        b' = beta - mean*gamma/sqrt(..)
+                                                 (+ b * gamma/sqrt(..))
+
+    Returns ``(folded_sym, folded_args, remaining_auxs)`` with param VALUES
+    rewritten; unfoldable BatchNorms are kept as-is."""
+    from .. import symbol as S
+    from ..symbol.symbol import _create
+
+    new_args = dict(arg_params)
+    topo = sym._topo()
+    n_cons: Dict[Tuple[int, int], int] = {}
+    for node in topo:
+        if node.is_var:
+            continue
+        for e in node.inputs:
+            n_cons[(id(e[0]), e[1])] = n_cons.get((id(e[0]), e[1]), 0) + 1
+
+    fp32: Dict[Tuple[int, int], object] = {}
+    for node in topo:
+        if node.is_var:
+            fp32[(id(node), 0)] = _symbol_of(node)
+            continue
+        ins = node.inputs
+        if node.op.name == "BatchNorm" and not ins[0][0].is_var:
+            prod = ins[0][0]
+            if (prod.op.name == "Convolution"
+                    and n_cons.get((id(prod), 0)) == 1
+                    and prod.inputs[1][0].is_var):
+                # parsed_attrs applies the op's REGISTERED defaults
+                # (eps=1e-3, fix_gamma=True) — hand-rolled defaults here
+                # silently mis-folded default-attr BatchNorms
+                battrs = node.parsed_attrs()
+                eps = float(battrs["eps"])
+                g = _to_np(arg_params[ins[1][0].name])
+                if battrs["fix_gamma"]:
+                    g = np.ones_like(g)
+                beta = _to_np(arg_params[ins[2][0].name])
+                mu = _to_np(aux_params[ins[3][0].name])
+                var = _to_np(aux_params[ins[4][0].name])
+                sc = g / np.sqrt(var + eps)
+
+                wname = prod.inputs[1][0].name
+                W = _to_np(new_args[wname])
+                new_args[wname] = W * sc.reshape((-1,) + (1,) * (W.ndim - 1))
+                no_bias = prod.parsed_attrs()["no_bias"]
+                if no_bias:
+                    bias_name = prod.name + "_folded_bias"
+                    bias = beta - mu * sc
+                else:
+                    bias_name = prod.inputs[2][0].name
+                    bias = beta + (_to_np(new_args[bias_name]) - mu) * sc
+                new_args[bias_name] = bias
+
+                attrs = {k: v for k, v in prod.attrs.items()
+                         if not k.startswith("__")}
+                attrs["no_bias"] = "False"
+                conv_in = fp32[(id(prod.inputs[0][0]), prod.inputs[0][1])]
+                fp32[(id(node), 0)] = _create(
+                    "Convolution", [conv_in, S.var(wname), S.var(bias_name)],
+                    attrs, name=prod.name)
+                continue
+        in_syms = [fp32[(id(e[0]), e[1])] for e in ins]
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        new_node = _create(node.op.name, in_syms, attrs, name=node.name)
+        n_vis = len(new_node._outputs)
+        if n_vis > 1:
+            for i in range(n_vis):
+                fp32[(id(node), i)] = new_node[i]
+        else:
+            fp32[(id(node), 0)] = new_node
+
+    outs = [fp32[(id(n), i)] for n, i in sym._outputs]
+    fsym = outs[0] if len(outs) == 1 else S.Group(outs)
+    keep_args = set(fsym.list_arguments())
+    keep_aux = set(fsym.list_auxiliary_states())
+    return (fsym,
+            {k: v for k, v in new_args.items() if k in keep_args},
+            {k: v for k, v in aux_params.items() if k in keep_aux})
+
+
+def quantize_graph_fused(sym, arg_params, th_dict,
+                         excluded_sym_names: Sequence[str] = ()):
+    """Static-scale fused int8 rewrite (run AFTER :func:`fold_batchnorm`,
+    with ``th_dict`` covering conv/FC/add outputs and the ``data`` var).
+
+    TPU-native redesign of the reference's MKLDNN int8 subgraph pass: each
+    supported node becomes ONE ``_sg_int8_*`` op whose requantize(+ReLU)
+    epilogue is a static multiply/round/clip XLA fuses into the conv, and
+    residual adds stay int8 (``_sg_int8_elemwise_add``).  No per-layer
+    min/max reductions, no f32 round-trips between quantized ops — the
+    glue that made the unfused path 0.80x bf16.  Unsupported consumers get
+    a ``_contrib_dequantize_v2`` splice; unsupported producers fall back
+    to fp32.  Returns ``(qsym, qargs)`` with qargs holding s8 weights, s32
+    biases, and the untouched fp32 params."""
+    from .. import symbol as S
+    from ..symbol.symbol import _create
+
+    excluded = set(excluded_sym_names)
+    topo = sym._topo()
+    consumers: Dict[Tuple[int, int], list] = {}
+    for node in topo:
+        if node.is_var:
+            continue
+        for e in node.inputs:
+            consumers.setdefault((id(e[0]), e[1]), []).append(node)
+
+    def sole_relu_consumer(node):
+        cons = consumers.get((id(node), 0), [])
+        if len(cons) == 1 and cons[0].op.name == "Activation" \
+                and cons[0].parsed_attrs()["act_type"] == "relu" \
+                and cons[0].name not in excluded:
+            return cons[0]
+        return None
+
+    _Q_CONSUMERS = ("Convolution", "FullyConnected", "elemwise_add",
+                    "broadcast_add", "_plus", "Pooling", "Flatten",
+                    "flatten", "Activation")
+
+    def wants_float(node):
+        """True when every consumer stays fp32 (or the node is a graph
+        output): emit f32 straight from the s32 accumulator instead of
+        s8 + dequantize (skips one rounding, e.g. on logits)."""
+        cons = consumers.get((id(node), 0), [])
+        return not cons or all(c.op.name not in _Q_CONSUMERS
+                               for c in cons)
+
+    fp32: Dict[Tuple[int, int], object] = {}
+    qmemo: Dict[Tuple[int, int], Tuple[object, float]] = {}
+    fused_relu: Dict[int, Tuple[object, float]] = {}   # relu node id -> q
+    qargs: Dict[str, object] = {}
+
+    def fp32_of(entry):
+        key = (id(entry[0]), entry[1])
+        if key not in fp32 and key in qmemo:
+            q, t = qmemo[key]
+            fp32[key] = S.contrib.dequantize_v2(q, threshold=float(t))
+        return fp32[key]
+
+    def q_of(entry):
+        """(s8 symbol, threshold) of an entry, quantizing the fp32 input
+        with its calibrated static range when needed."""
+        key = (id(entry[0]), entry[1])
+        if key in qmemo:
+            return qmemo[key]
+        name = entry[0].name
+        if name in th_dict:
+            t = max(abs(th_dict[name][0]), abs(th_dict[name][1]))
+            qs = S.contrib.quantize_v2(fp32_of(entry),
+                                       min_calib_range=-t,
+                                       max_calib_range=t)
+            qmemo[key] = (qs[0], t)
+            return qmemo[key]
+        return None
+
+    def quant_weight(wnode):
+        W = _to_np(arg_params[wnode.name])
+        t_w = max(float(np.max(np.abs(W))), 1e-30)
+        qargs[wnode.name + "_quantize"] = np.clip(
+            np.round(W * (127.0 / t_w)), -127, 127).astype(np.int8)
+        return S.var(wnode.name + "_quantize"), t_w
+
+    for node in topo:
+        if node.is_var:
+            fp32[(id(node), 0)] = _symbol_of(node)
+            continue
+        if id(node) in fused_relu:          # already emitted with producer
+            qmemo[(id(node), 0)] = fused_relu[id(node)]
+            continue
+        op_name, ins = node.op.name, node.inputs
+
+        pattrs = None if node.is_var else node.parsed_attrs()
+        if op_name in ("Convolution", "FullyConnected") \
+                and node.name not in excluded and node.name in th_dict \
+                and ins[1][0].is_var \
+                and (op_name != "Convolution"
+                     or len(pattrs["kernel"]) == 2) \
+                and q_of(ins[0]) is not None:
+            # (1-D/3-D convs fall through to fp32: _sg_int8_conv lowers
+            # with 2-D NCHW dimension numbers)
+            qd, t_in = q_of(ins[0])
+            qw, t_w = quant_weight(ins[1][0])
+            inputs = [qd, qw]
+            no_bias = pattrs["no_bias"]
+            if not no_bias:
+                b = _to_np(arg_params[ins[2][0].name])
+                bname = ins[2][0].name + "_q32"
+                qargs[bname] = np.round(
+                    b * (127.0 / t_in) * (127.0 / t_w)).astype(np.int64) \
+                    .clip(-2**31 + 1, 2**31 - 1).astype(np.int32)
+                inputs.append(S.var(bname))
+            relu = sole_relu_consumer(node)
+            t_out = max(abs(th_dict[node.name][0]),
+                        abs(th_dict[node.name][1]))
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            attrs["fuse_relu"] = bool(relu)
+            qop = "_sg_int8_conv" if op_name == "Convolution" \
+                else "_sg_int8_fully_connected"
+            if relu is None and wants_float(node):
+                attrs["scale_out"] = t_in * t_w / (127.0 * 127.0)
+                attrs["dequant_out"] = True
+                fp32[(id(node), 0)] = _create(
+                    qop, inputs, attrs, name=node.name + "_int8")
+                continue
+            attrs["scale_out"] = t_in * t_w / (127.0 * t_out)
+            out = _create(qop, inputs, attrs, name=node.name + "_int8")
+            qmemo[(id(node), 0)] = (out, t_out)
+            if relu is not None:
+                fused_relu[id(relu)] = (out, t_out)
+            continue
+
+        if op_name in ("elemwise_add", "broadcast_add", "_plus") \
+                and node.name not in excluded and node.name in th_dict:
+            qa, qb = q_of(ins[0]), q_of(ins[1])
+            if qa is not None and qb is not None:
+                (sa, ta), (sb, tb) = qa, qb
+                relu = sole_relu_consumer(node)
+                t_out = max(abs(th_dict[node.name][0]),
+                            abs(th_dict[node.name][1]))
+                out = _create("_sg_int8_elemwise_add", [sa, sb],
+                              {"scale_a": ta / t_out, "scale_b": tb / t_out,
+                               "fuse_relu": bool(relu)},
+                              name=node.name + "_int8")
+                qmemo[(id(node), 0)] = (out, t_out)
+                if relu is not None:
+                    fused_relu[id(relu)] = (out, t_out)
+                continue
+
+        if op_name == "Pooling" and node.name not in excluded \
+                and pattrs["pool_type"] == "max" \
+                and not pattrs["global_pool"] \
+                and (id(ins[0][0]), ins[0][1]) in qmemo:
+            q, t = qmemo[(id(ins[0][0]), ins[0][1])]
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            out = _create("_sg_int8_pooling", [q], attrs,
+                          name=node.name + "_int8")
+            qmemo[(id(node), 0)] = (out, t)
+            continue
+
+        if op_name in ("Flatten", "flatten", "Activation") \
+                and (id(ins[0][0]), ins[0][1]) in qmemo:
+            q, t = qmemo[(id(ins[0][0]), ins[0][1])]
+            if op_name == "Activation" \
+                    and pattrs["act_type"] == "relu":
+                # unfused standalone relu on s8: clip at zero, free
+                out = _create("_sg_int8_elemwise_add", [q, q],
+                              {"scale_a": 1.0, "scale_b": 0.0,
+                               "fuse_relu": True},
+                              name=node.name + "_int8")
+                qmemo[(id(node), 0)] = (out, t)
+                continue
+            if op_name in ("Flatten", "flatten"):
+                out = S.Flatten(q)
+                qmemo[(id(node), 0)] = (out, t)
+                continue
+
+        # fp32 fallback: rebuild on dequantized inputs
+        in_syms = [fp32_of(e) for e in ins]
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        new_node = _create(op_name, in_syms, attrs, name=node.name)
+        n_vis = len(new_node._outputs)
+        if n_vis > 1:
+            for i in range(n_vis):
+                fp32[(id(node), i)] = new_node[i]
+        else:
+            fp32[(id(node), 0)] = new_node
+
+    outs = [fp32_of(e) for e in sym._outputs]
+    qsym = outs[0] if len(outs) == 1 else S.Group(outs)
+    for name in qsym.list_arguments():
+        if name not in qargs and name in arg_params:
+            qargs[name] = _to_np(arg_params[name])
+    return qsym, qargs
+
+
 def quantize_params(qsym, params):
     """Offline int8 parameter quantization (reference _quantize_params):
     for every ``X_quantize`` argument of ``qsym``, quantize ``params[X]``."""
@@ -257,16 +547,81 @@ def _get_optimal_threshold(arr, num_bins=2001, num_quantized_bins=255):
     return max(best_t, 1e-8)
 
 
+def _calibrate(sym, arg_params, aux_params, ctx, calib_data, collect,
+               calib_mode, num_calib_examples, data_names, label_names,
+               logger=None):
+    outputs = _collect_layer_outputs(
+        sym, arg_params, aux_params, ctx, calib_data, collect,
+        num_calib_examples, data_names, label_names)
+    th_dict = {}
+    for name, arrs in outputs.items():
+        if calib_mode == "naive":
+            t = max(abs(float(np.min([a.min() for a in arrs]))),
+                    abs(float(np.max([a.max() for a in arrs]))))
+        elif calib_mode == "entropy":
+            t = _get_optimal_threshold(arrs)
+        else:
+            raise MXNetError("unknown calib_mode %r" % calib_mode)
+        th_dict[name] = (-t, t)
+        if logger:
+            logger.info("calibrated %s: threshold=%f", name, t)
+    return th_dict
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), ctx=None,
                    excluded_sym_names=None, calib_mode="entropy",
                    calib_data=None, num_calib_examples=None,
-                   quantized_dtype="int8", logger=None):
+                   quantized_dtype="int8", fuse=False, logger=None):
     """Quantize a model (parity: python/mxnet/contrib/quantization.py
-    quantize_model).  Returns (qsym, qarg_params, aux_params)."""
+    quantize_model).  Returns (qsym, qarg_params, aux_params).
+
+    ``fuse=True`` selects the TPU-native static-scale pipeline (the role
+    of the reference's MKLDNN int8 subgraph backend): BatchNorms are
+    folded into convs, calibration covers conv/FC/residual-add outputs
+    plus the data input, and the graph is rewritten with the fused
+    ``_sg_int8_*`` ops — requantize+ReLU epilogues fused into each conv,
+    int8 residual adds, no dynamic range reductions.  Requires
+    ``calib_mode`` != none (static scales need calibration)."""
     from .. import context as _ctx_mod
     ctx = ctx or _ctx_mod.current_context()
     excluded = excluded_sym_names or []
+
+    if fuse:
+        if not calib_mode or calib_mode == "none" or calib_data is None:
+            raise MXNetError("fuse=True needs calib_mode naive/entropy "
+                             "and calib_data (static scales)")
+        from .. import nd
+        fsym, fargs, fauxs = fold_batchnorm(sym, arg_params, aux_params)
+        fargs = {k: (v if hasattr(v, "_data") else nd.array(v))
+                 for k, v in fargs.items()}
+        fauxs = {k: (v if hasattr(v, "_data") else nd.array(v))
+                 for k, v in fauxs.items()}
+        collect = [n.name for n in fsym._topo()
+                   if not n.is_var and n.op.name in
+                   ("Convolution", "FullyConnected", "elemwise_add",
+                    "broadcast_add", "_plus")
+                   and n.name not in excluded]
+        th_dict = _calibrate(fsym, fargs, fauxs, ctx, calib_data, collect,
+                             calib_mode, num_calib_examples, data_names,
+                             label_names, logger)
+        # the data input's own range (naive min/max over the calib set)
+        calib_data.reset()
+        dmax, seen = 0.0, 0
+        for batch in calib_data:
+            for arr in batch.data:
+                dmax = max(dmax, float(np.max(np.abs(
+                    arr.asnumpy() if hasattr(arr, "asnumpy") else arr))))
+            seen += batch.data[0].shape[0]
+            if num_calib_examples is not None and \
+                    seen >= num_calib_examples:
+                break
+        for dn in data_names:
+            th_dict[dn] = (-max(dmax, 1e-8), max(dmax, 1e-8))
+        qsym, qargs = quantize_graph_fused(fsym, fargs, th_dict, excluded)
+        qarg_params = {k: (v if hasattr(v, "asnumpy") else nd.array(v))
+                       for k, v in qargs.items()}
+        return qsym, qarg_params, dict(fauxs)
 
     th_dict = {}
     if calib_mode and calib_mode != "none":
@@ -277,20 +632,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    if not n.is_var and n.op.name in
                    ("Convolution", "FullyConnected")
                    and n.name not in excluded]
-        outputs = _collect_layer_outputs(
-            sym, arg_params, aux_params, ctx, calib_data, collect,
-            num_calib_examples, data_names, label_names)
-        for name, arrs in outputs.items():
-            if calib_mode == "naive":
-                t = max(abs(float(np.min([a.min() for a in arrs]))),
-                        abs(float(np.max([a.max() for a in arrs]))))
-            elif calib_mode == "entropy":
-                t = _get_optimal_threshold(arrs)
-            else:
-                raise MXNetError("unknown calib_mode %r" % calib_mode)
-            th_dict[name] = (-t, t)
-            if logger:
-                logger.info("calibrated %s: threshold=%f", name, t)
+        th_dict = _calibrate(sym, arg_params, aux_params, ctx, calib_data,
+                             collect, calib_mode, num_calib_examples,
+                             data_names, label_names, logger)
 
     qsym, _ = quantize_graph(sym, excluded, th_dict, quantized_dtype)
     qarg_params = quantize_params(qsym, arg_params)

@@ -166,6 +166,132 @@ def _quantized_fully_connected(attrs, data, weight, *rest):
     return out, (-t_out).reshape(1), t_out.reshape(1)
 
 
+# ---------------------------------------------------------------------------
+# fused static-scale int8 inference ops (the TPU analog of the reference's
+# MKLDNN int8 subgraph ops, src/operator/subgraph/mkldnn/mkldnn_conv.cc and
+# quantize_v2 of src/operator/quantization/quantize_v2-inl.h).  Design: after
+# BN folding + calibration every scale is a STATIC attr, so the whole network
+# is s8->s32->s8 with one fused multiply/round/clip epilogue per layer — no
+# per-layer min/max reductions, no f32 round-trips, XLA fuses the epilogue
+# into the conv.  Scale convention: q represents q * t/127 for threshold t.
+# ---------------------------------------------------------------------------
+@register("_contrib_quantize_v2", nin=1, nout=3,
+          aliases=("quantize_v2",),
+          params={"min_calib_range": param(float, None),
+                  "max_calib_range": param(float, None),
+                  "out_type": param(["int8"], "int8")})
+def _quantize_v2(attrs, data):
+    """fp32 -> int8 with a calibrated STATIC range (quantize_v2-inl.h):
+    no on-the-fly min/max reduction; falls back to dynamic extrema when no
+    calib range is given."""
+    if attrs["min_calib_range"] is not None and \
+            attrs["max_calib_range"] is not None:
+        t = jnp.float32(max(abs(attrs["min_calib_range"]),
+                            abs(attrs["max_calib_range"])))
+    else:
+        t = jnp.maximum(jnp.max(jnp.abs(data.astype(jnp.float32))), 1e-30)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * (127.0 / t)),
+                 -127, 127).astype(jnp.int8)
+    return q, jnp.reshape(-t, (1,)), jnp.reshape(t, (1,))
+
+
+@register("_contrib_dequantize_v2", nin=1,
+          params={"threshold": param(float, None, required=True)})
+def _dequantize_v2(attrs, data):
+    """int8 -> fp32 with a static symmetric threshold."""
+    return data.astype(jnp.float32) * (attrs["threshold"] / 127.0)
+
+
+def _requant_epilogue(s32, scale_out, fuse_relu, dequant_out):
+    """Shared s32 epilogue: one static multiply + round + clip to s8, or a
+    straight dequantize to f32 when the consumer is a float op."""
+    real = s32.astype(jnp.float32) * scale_out
+    if dequant_out:
+        return real
+    lo = 0.0 if fuse_relu else -127.0
+    return jnp.clip(jnp.round(real), lo, 127.0).astype(jnp.int8)
+
+
+@register("_sg_int8_conv", nin=-1,
+          params={"kernel": param("shape", None, required=True),
+                  "stride": param("shape", ()),
+                  "dilate": param("shape", ()),
+                  "pad": param("shape", ()),
+                  "num_filter": param(int, None, required=True),
+                  "num_group": param(int, 1),
+                  "no_bias": param(bool, False),
+                  "layout": param(str, None),
+                  "scale_out": param(float, None, required=True),
+                  "fuse_relu": param(bool, False),
+                  "dequant_out": param(bool, False)})
+def _sg_int8_conv(attrs, data, weight, *maybe_bias):
+    """Fused s8 conv + s32 bias + requantize(+ReLU) -> s8 in ONE op
+    (the _sg_mkldnn_conv analog).  ``scale_out`` = t_in*t_w/(127*t_out)
+    (or t_in*t_w/127^2 with dequant_out); bias arrives pre-scaled s32 in
+    accumulator units."""
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(attrs["stride"] or (1, 1)),
+        padding=[(p, p) for p in (attrs["pad"] or (0, 0))],
+        rhs_dilation=tuple(attrs["dilate"] or (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=attrs["num_group"],
+        preferred_element_type=jnp.int32)
+    if maybe_bias:
+        out = out + maybe_bias[0].astype(jnp.int32).reshape(1, -1, 1, 1)
+    return _requant_epilogue(out, attrs["scale_out"], attrs["fuse_relu"],
+                             attrs["dequant_out"])
+
+
+@register("_sg_int8_fully_connected", nin=-1,
+          params={"num_hidden": param(int, None, required=True),
+                  "no_bias": param(bool, False),
+                  "flatten": param(bool, True),
+                  "scale_out": param(float, None, required=True),
+                  "fuse_relu": param(bool, False),
+                  "dequant_out": param(bool, False)})
+def _sg_int8_fully_connected(attrs, data, weight, *maybe_bias):
+    """Fused s8 FC + s32 bias + requantize(+ReLU) (one op, static scale)."""
+    x = data.reshape(data.shape[0], -1) if attrs["flatten"] else data
+    out = jax.lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    if maybe_bias:
+        out = out + maybe_bias[0].astype(jnp.int32)
+    return _requant_epilogue(out, attrs["scale_out"], attrs["fuse_relu"],
+                             attrs["dequant_out"])
+
+
+@register("_sg_int8_elemwise_add", nin=2,
+          params={"scale_a": param(float, None, required=True),
+                  "scale_b": param(float, None, required=True),
+                  "fuse_relu": param(bool, False)})
+def _sg_int8_elemwise_add(attrs, a, b):
+    """int8 residual add (quantized_elemwise_add.cc analog): both operands
+    rescaled into the OUTPUT threshold's units with static scales, so skip
+    connections never leave int8."""
+    real = a.astype(jnp.float32) * attrs["scale_a"] \
+        + b.astype(jnp.float32) * attrs["scale_b"]
+    lo = 0.0 if attrs["fuse_relu"] else -127.0
+    return jnp.clip(jnp.round(real), lo, 127.0).astype(jnp.int8)
+
+
+@register("_sg_int8_pooling", nin=1,
+          params={"kernel": param("shape", ()),
+                  "pool_type": param(["max"], "max"),
+                  "global_pool": param(bool, False),
+                  "stride": param("shape", ()),
+                  "pad": param("shape", ()),
+                  "pooling_convention": param(["valid", "full"], "valid"),
+                  "count_include_pad": param(bool, True),
+                  "p_value": param(int, 2)})
+def _sg_int8_pooling(attrs, data):
+    """Max pooling directly on s8 (range-preserving, no requantize)."""
+    from .nn import _pooling
+    return _pooling(attrs, data.astype(jnp.int8))
+
+
 @register("_contrib_quantized_pooling", nin=3, nout=3,
           params={"kernel": param("shape", ()),
                   "pool_type": param(["max", "avg"], "max"),

@@ -417,6 +417,17 @@ EXEMPT = {
     "_contrib_quantized_fully_connected": "tests/test_quantization.py",
     "_contrib_quantized_pooling": "tests/test_quantization.py",
     "_contrib_quantized_flatten": "tests/test_quantization.py",
+    "_contrib_quantize_v2": "int8 fused pass (static scales); "
+                            "tests/test_quantization.py",
+    "_contrib_dequantize_v2": "int8 fused pass; tests/test_quantization.py",
+    "_sg_int8_conv": "int8 fused inference op (round/clip, no grad); "
+                     "tests/test_quantization.py",
+    "_sg_int8_fully_connected": "int8 fused inference op; "
+                                "tests/test_quantization.py",
+    "_sg_int8_elemwise_add": "int8 fused inference op; "
+                             "tests/test_quantization.py",
+    "_sg_int8_pooling": "int8 fused inference op; "
+                        "tests/test_quantization.py",
     # random / init: stochastic or constant outputs
     "_arange": "deterministic init; tests/test_ndarray.py",
     "_eye": "init", "_full": "init", "_linspace": "init",

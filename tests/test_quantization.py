@@ -186,3 +186,162 @@ def test_quantize_model_with_batchnorm():
     f_out = ex_f.forward(is_train=False)[0].asnumpy()
     assert np.isfinite(q_out).all()
     assert np.abs(q_out - f_out).max() < 0.25
+
+
+# ---------------------------------------------------------------------------
+# fused static-scale pipeline (round-4: BN fold + _sg_int8_* graph)
+# ---------------------------------------------------------------------------
+def _residual_net():
+    """conv-bn-relu -> conv-bn -> (+ projected skip) -> relu -> pool -> fc,
+    the minimal ResNet-shaped graph exercising every fused pattern."""
+    data = sym.var("data")
+    y = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        no_bias=True, name="convA")
+    y = sym.BatchNorm(y, fix_gamma=False, eps=1e-5, name="bnA")
+    y = sym.Activation(y, act_type="relu", name="reluA")
+    y = sym.Convolution(y, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        no_bias=False, name="convB")
+    y = sym.BatchNorm(y, fix_gamma=False, eps=1e-5, name="bnB")
+    s = sym.Convolution(data, kernel=(1, 1), num_filter=8, no_bias=True,
+                        name="convS")
+    s = sym.BatchNorm(s, fix_gamma=False, eps=1e-5, name="bnS")
+    z = sym.broadcast_add(y, s, name="addZ")
+    z = sym.Activation(z, act_type="relu", name="reluZ")
+    z = sym.Pooling(z, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="poolZ")
+    return sym.FullyConnected(sym.Flatten(z), num_hidden=5, name="fcZ")
+
+
+def _init_residual(out, shape=(8, 3, 10, 10), seed=0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = out.infer_shape(data=shape)
+    args, auxs = {}, {}
+    for n, s in zip(out.list_arguments(), arg_shapes):
+        if n != "data":
+            args[n] = nd.array((rng.randn(*s) * 0.2).astype(np.float32))
+    for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+        auxs[n] = nd.array(
+            (np.abs(rng.rand(*s)) + 0.5).astype(np.float32) if "var" in n
+            else (rng.randn(*s) * 0.1).astype(np.float32))
+    x = nd.array(rng.rand(*shape).astype(np.float32))
+    return args, auxs, x
+
+
+def test_fold_batchnorm_exact():
+    out = _residual_net()
+    args, auxs, x = _init_residual(out)
+    ref = out.bind(mx.cpu(), {**args, "data": x}, aux_states=auxs) \
+        .forward(is_train=False)[0].asnumpy()
+
+    from mxnet_tpu.contrib.quantization import fold_batchnorm
+    fsym, fargs, fauxs = fold_batchnorm(out, args, auxs)
+    ops = set(n.op.name for n in fsym._topo() if not n.is_var)
+    assert "BatchNorm" not in ops, ops
+    got = fsym.bind(mx.cpu(), {**{k: nd.array(v) for k, v in fargs.items()},
+                               "data": x}) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_int8_graph_structure_and_accuracy():
+    out = _residual_net()
+    args, auxs, x = _init_residual(out)
+    ref = out.bind(mx.cpu(), {**args, "data": x}, aux_states=auxs) \
+        .forward(is_train=False)[0].asnumpy()
+
+    calib = NDArrayIter(data=x.asnumpy(), batch_size=8)
+    qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+        out, args, auxs, ctx=mx.cpu(), calib_mode="naive",
+        calib_data=calib, num_calib_examples=8, fuse=True)
+
+    ops = [n.op.name for n in qsym._topo() if not n.is_var]
+    # all three convs fused, the residual add stays int8, exactly one
+    # activation quantize (the data input) and one dequantize (head)
+    assert ops.count("_sg_int8_conv") == 3, ops
+    assert ops.count("_sg_int8_elemwise_add") == 1, ops
+    assert ops.count("_contrib_quantize_v2") == 1, ops
+    # head FC emits f32 straight from the accumulator (dequant_out), so
+    # no standalone dequantize survives
+    assert ops.count("_sg_int8_fully_connected") == 1, ops
+    assert ops.count("_contrib_dequantize_v2") == 0, ops
+    assert "Convolution" not in ops and "BatchNorm" not in ops, ops
+    # relu epilogues are folded: no standalone Activation survives
+    assert "Activation" not in ops, ops
+
+    ex = qsym.bind(mx.cpu(), {**qargs, "data": x}, aux_states=qauxs)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(got).all()
+    # int8 path tracks fp32: same ranking on every sample
+    assert (got.argmax(1) == ref.argmax(1)).all()
+    corr = np.corrcoef(got.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.999, corr
+
+
+def test_fused_int8_weight_dtypes():
+    out = _residual_net()
+    args, auxs, x = _init_residual(out, seed=3)
+    calib = NDArrayIter(data=x.asnumpy(), batch_size=8)
+    qsym, qargs, _ = mx.contrib.quantization.quantize_model(
+        out, args, auxs, ctx=mx.cpu(), calib_mode="naive",
+        calib_data=calib, num_calib_examples=8, fuse=True)
+    w = qargs["convA_weight_quantize"]
+    assert w.dtype == np.int8
+    # folded biases ride the s32 accumulator scale
+    b32 = [n for n in qargs if n.endswith("_q32")]
+    assert b32 and all(qargs[n].dtype == np.int32 for n in b32)
+
+
+def test_fold_batchnorm_default_attrs():
+    """Regression (round-4 review): BatchNorm created WITHOUT explicit
+    attrs runs with its registered defaults (fix_gamma=True, eps=1e-3);
+    the fold must read those same defaults via parsed_attrs, not guess."""
+    rng = np.random.RandomState(5)
+    data = sym.var("data")
+    y = sym.Convolution(data, kernel=(3, 3), num_filter=4, no_bias=True,
+                        name="convD")
+    y = sym.BatchNorm(y, name="bnD")          # all-default attrs
+    x = nd.array(rng.rand(2, 3, 8, 8).astype(np.float32))
+    arg_shapes, _, aux_shapes = y.infer_shape(data=(2, 3, 8, 8))
+    args, auxs = {}, {}
+    for n, s in zip(y.list_arguments(), arg_shapes):
+        if n != "data":
+            args[n] = nd.array((rng.randn(*s) * 0.5).astype(np.float32))
+    for n, s in zip(y.list_auxiliary_states(), aux_shapes):
+        auxs[n] = nd.array(
+            (np.abs(rng.rand(*s)) + 0.5).astype(np.float32) if "var" in n
+            else (rng.randn(*s) * 0.2).astype(np.float32))
+    ref = y.bind(mx.cpu(), {**args, "data": x}, aux_states=auxs) \
+        .forward(is_train=False)[0].asnumpy()
+
+    from mxnet_tpu.contrib.quantization import fold_batchnorm
+    fsym, fargs, _ = fold_batchnorm(y, args, auxs)
+    got = fsym.bind(mx.cpu(), {**{k: nd.array(v) for k, v in fargs.items()},
+                               "data": x}) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_int8_skips_1d_conv():
+    """1-D convs can't lower through the 2-D _sg_int8_conv; they must fall
+    back to fp32 instead of crashing (round-4 review finding)."""
+    data = sym.var("data")
+    y = sym.Convolution(data, kernel=(3,), num_filter=4, pad=(1,),
+                        no_bias=True, name="conv1d")
+    out = sym.FullyConnected(sym.Flatten(y), num_hidden=3, name="fc1d")
+    rng = np.random.RandomState(7)
+    x = nd.array(rng.rand(4, 2, 16).astype(np.float32))
+    arg_shapes, _, _ = out.infer_shape(data=(4, 2, 16))
+    args = {n: nd.array((rng.randn(*s) * 0.3).astype(np.float32))
+            for n, s in zip(out.list_arguments(), arg_shapes)
+            if n != "data"}
+    ref = out.bind(mx.cpu(), {**args, "data": x}) \
+        .forward(is_train=False)[0].asnumpy()
+    calib = NDArrayIter(data=x.asnumpy(), batch_size=4)
+    qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+        out, args, {}, ctx=mx.cpu(), calib_mode="naive", calib_data=calib,
+        num_calib_examples=4, fuse=True)
+    ops = [n.op.name for n in qsym._topo() if not n.is_var]
+    assert "_sg_int8_conv" not in ops, ops     # 1-D conv stayed fp32
+    got = qsym.bind(mx.cpu(), {**qargs, "data": x}, aux_states=qauxs) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.05)
