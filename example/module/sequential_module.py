@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Module API tour: Module, checkpointing, and SequentialModule.
+
+Reference analog: ``example/module/`` (mod_demo / sequential_module): the
+pre-Gluon intermediate API — symbol in, bind/init/fit/predict/score out.
+TPU-native: every bound executor compiles its whole symbol into one XLA
+program (mxnet_tpu/executor.py), so the Module-era batching discipline
+(fixed shapes per bind) is exactly what jit wants.
+
+Demonstrates, on a synthetic two-moons-style classification task:
+1. plain ``Module``: bind → init_params → fit → predict → score;
+2. epoch checkpointing with ``save_checkpoint`` / ``Module.load``;
+3. ``SequentialModule``: two Modules chained, trained end-to-end.
+
+Run:  python example/module/sequential_module.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import NDArrayIter
+
+parser = argparse.ArgumentParser(
+    description="Module API demo on synthetic classification",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=10)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--lr", type=float, default=0.1)
+parser.add_argument("--samples", type=int, default=1024)
+parser.add_argument("--checkpoint-prefix", type=str, default=None,
+                    help="save per-epoch checkpoints under this prefix")
+
+
+def make_data(n, seed=0):
+    """Two interleaved half-circles ('moons') + noise, 2 classes."""
+    rng = np.random.RandomState(seed)
+    half = n // 2
+    t = rng.uniform(0, np.pi, half)
+    x0 = np.stack([np.cos(t), np.sin(t)], 1)
+    x1 = np.stack([1 - np.cos(t), 0.5 - np.sin(t)], 1)
+    x = np.concatenate([x0, x1]).astype(np.float32)
+    x += rng.randn(*x.shape).astype(np.float32) * 0.1
+    y = np.concatenate([np.zeros(half), np.ones(half)]).astype(np.float32)
+    idx = rng.permutation(n)
+    return x[idx], y[idx]
+
+
+def mlp_symbol():
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=32, name="fc2")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=2, name="fc3")
+    return sym.SoftmaxOutput(out, sym.var("softmax_label"), name="softmax")
+
+
+def run_module(args, train_iter, val_iter):
+    """Part 1+2: plain Module with fit/score/predict and checkpoints."""
+    mod = mx.mod.Module(mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    cb = (mx.callback.do_checkpoint(args.checkpoint_prefix)
+          if args.checkpoint_prefix else None)
+    mod.fit(train_iter, eval_data=val_iter,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            epoch_end_callback=cb,
+            num_epoch=args.num_epochs)
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    mod.score(val_iter, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    print("Module val accuracy: %.3f" % acc)
+
+    if args.checkpoint_prefix:
+        # resume the final epoch from disk and verify it scores the same
+        loaded = mx.mod.Module.load(args.checkpoint_prefix,
+                                    args.num_epochs,
+                                    data_names=("data",),
+                                    label_names=("softmax_label",))
+        loaded.bind(data_shapes=val_iter.provide_data,
+                    label_shapes=val_iter.provide_label)
+        metric.reset()
+        val_iter.reset()
+        loaded.score(val_iter, metric)
+        print("reloaded checkpoint accuracy: %.3f"
+              % dict(metric.get_name_value())["accuracy"])
+    return acc
+
+
+def run_sequential(args, train_iter, val_iter):
+    """Part 3: SequentialModule — a feature extractor Module feeding a
+    classifier Module, trained as one pipeline."""
+    feat = sym.FullyConnected(sym.var("data"), num_hidden=32, name="feat")
+    feat = sym.Activation(feat, act_type="relu")
+
+    head = sym.FullyConnected(sym.var("data"), num_hidden=2, name="head")
+    head = sym.SoftmaxOutput(head, sym.var("softmax_label"),
+                             name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, data_names=("data",), label_names=()))
+    seq.add(mx.mod.Module(head, data_names=("data",),
+                          label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+
+    seq.fit(train_iter,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            num_epoch=args.num_epochs)
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    seq.score(val_iter, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    print("SequentialModule val accuracy: %.3f" % acc)
+    return acc
+
+
+def main(args):
+    x, y = make_data(args.samples)
+    n_val = args.samples // 4
+    train_iter = NDArrayIter(data=x[n_val:], label=y[n_val:],
+                             batch_size=args.batch_size, shuffle=True,
+                             label_name="softmax_label")
+    val_iter = NDArrayIter(data=x[:n_val], label=y[:n_val],
+                           batch_size=args.batch_size,
+                           label_name="softmax_label")
+    acc1 = run_module(args, train_iter, val_iter)
+    train_iter.reset()
+    acc2 = run_sequential(args, train_iter, val_iter)
+    return acc1, acc2
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -271,16 +271,31 @@ class FusedRNN(Initializer):
         from .ops.rnn import _NGATES
         ng = _NGATES[self._mode]
         h = self._num_hidden
+        L = self._num_layers
         dirs = 2 if self._bidirectional else 1
         total = arr.size
-        n_bias = self._num_layers * dirs * 2 * ng * h
+        n_bias = L * dirs * 2 * ng * h
         n_weight = total - n_bias
+        # recover layer-0 input size from the packed length so each i2h/h2h
+        # matrix can be initialized at its TRUE shape — the reference
+        # (initializer.py FusedRNN via cell.unpack_weights) inits per
+        # matrix; flat-vector init would give Xavier a bogus fan-in of the
+        # whole packed size and near-zero recurrent weights
+        deeper = (L - 1) * dirs * ng * h * (h * dirs + h)
+        in0 = (n_weight - deeper) // (dirs * ng * h) - h
         flat = np.zeros(total, np.float32)
         if self._init is not None:
             from . import ndarray as nd
-            wnd = nd.zeros((1, n_weight))
-            self._init._init_weight(desc, wnd)
-            flat[:n_weight] = wnd.asnumpy().reshape(-1)
+            off = 0
+            for layer in range(L):
+                in_sz = in0 if layer == 0 else h * dirs
+                for _d in range(dirs):
+                    for shape in ((ng * h, in_sz), (ng * h, h)):
+                        blk = nd.zeros(shape)
+                        self._init._init_weight(desc, blk)
+                        flat[off:off + blk.size] = blk.asnumpy().ravel()
+                        off += blk.size
+            assert off == n_weight, (off, n_weight)
         if self._mode == "lstm":
             # bias region: per (layer, dir), [i2h_b, h2h_b] each ng*h long;
             # forget gate is gate index 1 of [i, f, g, o]

@@ -33,9 +33,14 @@ class SequentialModule(BaseModule):
             mod.bind(shapes, label_shapes if take_labels else None,
                      for_training, inputs_need_grad or i > 0,
                      force_rebind, grad_req=grad_req)
-            shapes = [type(shapes[0])(n, s, "float32", "NCHW") if not
-                      hasattr(shapes[0], "_fields") else shapes[0]
-                      for n, s in mod.output_shapes]
+            # next module consumes this module's outputs: rewire the data
+            # descriptors to the output shapes (auto_wiring semantics of
+            # the reference sequential_module.py)
+            from ..io import DataDesc
+            data_names = (mod.data_names if i + 1 >= len(self._modules)
+                          else self._modules[i + 1].data_names)
+            shapes = [DataDesc(dn, s)
+                      for dn, (_n, s) in zip(data_names, mod.output_shapes)]
         self.binded = True
         self.for_training = for_training
 

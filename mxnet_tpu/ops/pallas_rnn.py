@@ -62,6 +62,10 @@ def lstm_scan_available(B, H, dtype=None) -> bool:
     """
     if os.environ.get("MXNET_TPU_PALLAS_RNN", "1") == "0":
         return False
+    if dtype is not None and jnp.dtype(dtype) not in (
+            jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16),
+            jnp.dtype(jnp.float32)):
+        return False           # f64 (x64 mode) has no kernel path
     if H > 2048 or B > 1024:   # all blocks are whole-array (no tile
         return False           # alignment constraints); VMEM only
     es = 2 if dtype is None or jnp.dtype(dtype).itemsize == 2 else 4

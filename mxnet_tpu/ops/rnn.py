@@ -78,7 +78,10 @@ def _cell_scan(mode, xproj, h0, c0, R, bR):
             return ys, hT, cT
 
         if pallas_rnn.lstm_scan_available(xproj.shape[1], h_sz,
-                                          xproj.dtype):
+                                          xproj.dtype) \
+                and h0.dtype == xproj.dtype and c0.dtype == xproj.dtype:
+            # mixed-dtype states (e.g. f64 zeros against f32 activations
+            # under x64) take the promoting scan; the kernel is monodtype
             if pallas_rnn.INTERPRET:   # test hook: force the interpreter
                 return pallas_rnn.lstm_scan(xproj, h0, c0, R, bR)
             # fused Pallas recurrence (cuDNN-RNN role): whole time loop in

