@@ -6,13 +6,21 @@ Reference analog: ``python/mxnet/operator.py`` (CustomOp:426, CustomOpProp:
 backward, executed on a dedicated worker thread so host Python work never
 blocks the scheduler.
 
-TPU-native design: the ``Custom`` op lowers to ``jax.pure_callback`` — the
-XLA host-callback mechanism — wrapped in a ``jax.custom_vjp`` whose backward
-is a second callback into the user's ``backward``.  This works both in the
+TPU-native design: the ``Custom`` op lowers to ``jax.experimental.
+io_callback(ordered=True)`` — the effectful, program-ordered XLA host
+callback — wrapped in a ``jax.custom_vjp`` whose backward is a second
+ordered callback into the user's ``backward``.  This works both in the
 eager path and inside jitted CachedOp/Executor programs (the callback is a
 host node in the compiled graph, the analog of the reference's kAsync custom
 op dispatch).  User code still runs on one dedicated worker thread
-(custom-inl.h:74-173 parity), keeping the no-deadlock guarantee.
+(custom-inl.h:74-173 parity).  ``ordered=True`` is the structural fix for
+the round-3 wedge: the runtime serializes the callbacks in program order on
+the io-callback path instead of firing them from result-buffer completion
+threads, so a callback re-entering jax eager dispatch (user ``mx.nd`` code)
+can no longer interleave with another in-flight callback of the same
+program; combined with the trace-time worker pre-warm this removed the
+intermittent main<->worker futex deadlock (stress test:
+tests/test_custom_op.py::test_custom_op_stress_in_process).
 """
 from __future__ import annotations
 
@@ -31,9 +39,33 @@ __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
 
 # the reference executes all python custom-op callbacks on one dedicated
 # worker thread (custom-inl.h:50-173); mirror that
+import threading as _threading
+
 _WORKER = concurrent.futures.ThreadPoolExecutor(
     max_workers=1, thread_name_prefix="mxnet_custom_op")
 _WORKER_WARM = False
+_WORKER_LOCK = _threading.Lock()
+
+
+def _warm_body():
+    from . import ndarray as nd
+    nd.array(np.zeros((1,), np.float32)).asnumpy()
+
+
+def _reset_worker():
+    """Abandon a wedged worker thread and start a fresh one: a timed-out
+    callback cannot be cancelled (advisor r03), and without this every
+    later Custom op would block the full timeout against the dead thread.
+    The replacement is warmed immediately — cached compiled Custom ops
+    skip the trace-time warm, and an unwarmed worker's first jax dispatch
+    inside a host-callback context is the classic init race."""
+    global _WORKER
+    with _WORKER_LOCK:
+        old = _WORKER
+        _WORKER = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mxnet_custom_op")
+        _WORKER.submit(_warm_body)      # async: don't block the error path
+    old.shutdown(wait=False)
 
 
 def _warm_worker():
@@ -46,13 +78,10 @@ def _warm_worker():
     if _WORKER_WARM:
         return
     _WORKER_WARM = True
-
-    def _w():
-        from . import ndarray as nd
-        nd.array(np.zeros((1,), np.float32)).asnumpy()
-
     try:
-        _WORKER.submit(_w).result(timeout=60)
+        with _WORKER_LOCK:
+            fut = _WORKER.submit(_warm_body)
+        fut.result(timeout=60)
     except Exception:
         pass
 
@@ -68,11 +97,15 @@ def _on_worker(fn, *args):
     # of an indefinite futex hang (the reference's engine would likewise
     # abort on a stuck callback rather than stall the scheduler)
     timeout = float(os.environ.get("MXNET_CUSTOM_OP_TIMEOUT_SEC", "600"))
-    fut = _WORKER.submit(fn, *args)
+    with _WORKER_LOCK:
+        # another waiter's _reset_worker may swap+shutdown concurrently;
+        # the lock pins submit to the live executor
+        fut = _WORKER.submit(fn, *args)
     try:
         return fut.result(timeout=timeout)
     except concurrent.futures.TimeoutError:
         fut.cancel()      # prune if not yet started; never run it late
+        _reset_worker()   # the stuck thread is unrecoverable — replace it
         raise MXNetError(
             "Custom-op callback did not complete within %.0fs "
             "(MXNET_CUSTOM_OP_TIMEOUT_SEC): worker thread wedged or the "
@@ -259,9 +292,15 @@ def _custom(attrs, *inputs):
             return tuple(g.asnumpy() for g in in_grad)
         return _on_worker(work)
 
+    from jax.experimental import io_callback
+
     @jax.custom_vjp
     def _apply(*xs):
-        outs = jax.pure_callback(_run_forward, out_avals + aux_avals, *xs)
+        # ordered=True: program-order serialization of the host callbacks
+        # (the structural fix for the r03 callback-interleaving wedge);
+        # also guarantees the effectful user forward is never elided
+        outs = io_callback(_run_forward, out_avals + aux_avals, *xs,
+                           ordered=True)
         return tuple(outs)
 
     def _apply_fwd(*xs):
@@ -274,8 +313,8 @@ def _custom(attrs, *inputs):
         xs, outs = res
         in_avals = tuple(jax.ShapeDtypeStruct(s, t)
                          for s, t in zip(in_shapes, in_types))
-        grads = jax.pure_callback(_run_backward, in_avals, *xs, *outs,
-                                  *gs[:n_out])
+        grads = io_callback(_run_backward, in_avals, *xs, *outs,
+                            *gs[:n_out], ordered=True)
         # aux inputs receive zero gradient
         aux_zero = tuple(jnp.zeros(x.shape, x.dtype) for x in xs[n_args:])
         return tuple(grads) + aux_zero

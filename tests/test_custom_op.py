@@ -186,3 +186,45 @@ class TestImageOps:
         assert out.shape == (3, 4, 4)
         np.testing.assert_allclose(out.asnumpy(),
                                    (128 / 255 - 0.5) / 0.25, rtol=1e-4)
+
+
+def test_custom_op_stress_in_process():
+    """Round-4 structural-fix regression: >=50 train iterations through the
+    ordered-io_callback bridge in ONE interpreter, callbacks doing real
+    eager mx.nd work (the re-entrant-dispatch pattern that wedged the r03
+    pure_callback bridge ~1/20 runs), no timeout/retry machinery."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    class NdSwish(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0]
+            # deliberate jax re-entry from the worker thread
+            self.assign(out_data[0], req[0], x * nd.Activation(
+                x, act_type="sigmoid"))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            x = in_data[0]
+            s = nd.Activation(x, act_type="sigmoid")
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * (s + x * s * (1 - s)))
+
+    @mx.operator.register("_stress_swish")
+    class NdSwishProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return NdSwish()
+
+    x = nd.array(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    w = nd.array(np.random.RandomState(1).randn(8, 8).astype(np.float32))
+    w.attach_grad()
+    losses = []
+    for _ in range(60):
+        with mx.autograd.record():
+            h = nd.dot(x, w)
+            y = nd.Custom(h, op_type="_stress_swish")
+            loss = (y * y).sum()
+        loss.backward()
+        w[:] = w - 0.001 * w.grad
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
