@@ -44,6 +44,18 @@ TRAIN_GFLOPS_PER_IMG = 12.3
 _DEFAULT_PEAK = {"bfloat16": 197.0, "float16": 197.0, "float32": 99.0}
 
 
+def _spread_stats(step_times):
+    """(median, p90 spread, max-min spread): p90/median-1 is the headline
+    (robust to single tunnel hiccups — r03's max-min spread hit 63% on
+    one outlier); max-min kept for context."""
+    med = statistics.median(step_times)
+    if not med:
+        return med, 0.0, 0.0
+    p90 = float(np.percentile(step_times, 90))
+    return (med, p90 / med - 1.0,
+            (max(step_times) - min(step_times)) / med)
+
+
 def _measure(step, fetch, batch_items, warmup, iters, window_iters=None):
     """Shared measurement protocol: per-step hard-blocked latencies, then
     windowed steady-state with the 2x linear-scaling validation.
@@ -57,8 +69,7 @@ def _measure(step, fetch, batch_items, warmup, iters, window_iters=None):
         t0 = time.perf_counter()
         lval = fetch(step())
         step_times.append(time.perf_counter() - t0)
-    med = statistics.median(step_times)
-    spread = (max(step_times) - min(step_times)) / med if med else 0.0
+    med, spread, spread_maxmin = _spread_stats(step_times)
     blocked_rate = batch_items / med
 
     def window(n):
@@ -78,6 +89,8 @@ def _measure(step, fetch, batch_items, warmup, iters, window_iters=None):
     return {
         "rate": rate, "blocked_rate": blocked_rate,
         "step_ms_median_blocked": med * 1e3, "step_spread_pct": 100 * spread,
+        "step_spread_maxmin_pct": 100 * spread_maxmin,
+        "windowed_rate": window_rate,
         "window_scaling_ratio": scaling, "window_suspect": not scaling_ok,
         "last_loss": lval,
     }
@@ -164,7 +177,10 @@ def bench_lstm_lm(ctx, dtype, peak_tflops):
         "config": "vocab=%d,hidden=%d,layers=%d,bptt=%d,batch=%d"
                   % (vocab, hidden, layers, bptt, batch),
         "step_ms_median_blocked": round(m["step_ms_median_blocked"], 2),
+        "step_spread_pct": round(m["step_spread_pct"], 1),
+        "step_spread_maxmin_pct": round(m["step_spread_maxmin_pct"], 1),
         "blocked_tokens_per_sec": round(m["blocked_rate"], 1),
+        "windowed_tokens_per_sec": round(m["windowed_rate"], 1),
         "window_scaling_ratio": round(m["window_scaling_ratio"], 3),
         "window_suspect": m["window_suspect"],
         "window_retried": retried,
@@ -248,8 +264,7 @@ def main():
         t0 = time.perf_counter()
         lval = fetch(step())
         step_times.append(time.perf_counter() - t0)
-    med = statistics.median(step_times)
-    spread = (max(step_times) - min(step_times)) / med if med else 0.0
+    med, spread, spread_maxmin = _spread_stats(step_times)
     blocked_ips = batch_size / med
 
     # --- phase 2+3: windowed steady-state + linear-scaling validation
@@ -286,7 +301,9 @@ def main():
         "vs_baseline": round(img_per_sec / baseline, 4),
         "step_ms_median_blocked": round(med * 1e3, 2),
         "step_spread_pct": round(100 * spread, 1),
+        "step_spread_maxmin_pct": round(100 * spread_maxmin, 1),
         "blocked_img_per_sec": round(blocked_ips, 2),
+        "windowed_img_per_sec": round(window_ips, 2),
         "window_scaling_ratio": round(scaling, 3),
         "window_suspect": not scaling_ok,
         "dtype": dtype,

@@ -45,6 +45,10 @@ _WORKER = concurrent.futures.ThreadPoolExecutor(
     max_workers=1, thread_name_prefix="mxnet_custom_op")
 _WORKER_WARM = False
 _WORKER_LOCK = _threading.Lock()
+# the future a timed-out wait abandoned; while its thread is still
+# RUNNING no new user callback may start (single-worker serialization,
+# custom-inl.h parity) — submissions fail fast instead
+_WEDGED_FUT = None
 
 
 def _warm_body():
@@ -52,16 +56,20 @@ def _warm_body():
     nd.array(np.zeros((1,), np.float32)).asnumpy()
 
 
-def _reset_worker():
+def _reset_worker(fut):
     """Abandon a wedged worker thread and start a fresh one: a timed-out
     callback cannot be cancelled (advisor r03), and without this every
     later Custom op would block the full timeout against the dead thread.
     The replacement is warmed immediately — cached compiled Custom ops
     skip the trace-time warm, and an unwarmed worker's first jax dispatch
-    inside a host-callback context is the classic init race."""
-    global _WORKER
+    inside a host-callback context is the classic init race.  The
+    abandoned future is remembered: until its thread actually finishes,
+    new callbacks error fast rather than run CONCURRENTLY with it (the
+    one-worker serialization guarantee must survive recovery)."""
+    global _WORKER, _WEDGED_FUT
     with _WORKER_LOCK:
         old = _WORKER
+        _WEDGED_FUT = fut
         _WORKER = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="mxnet_custom_op")
         _WORKER.submit(_warm_body)      # async: don't block the error path
@@ -96,16 +104,24 @@ def _on_worker(fn, *args):
     # bounded wait: a wedged worker surfaces as a loud MXNetError instead
     # of an indefinite futex hang (the reference's engine would likewise
     # abort on a stuck callback rather than stall the scheduler)
+    global _WEDGED_FUT
     timeout = float(os.environ.get("MXNET_CUSTOM_OP_TIMEOUT_SEC", "600"))
     with _WORKER_LOCK:
         # another waiter's _reset_worker may swap+shutdown concurrently;
         # the lock pins submit to the live executor
+        if _WEDGED_FUT is not None:
+            if _WEDGED_FUT.running():
+                raise MXNetError(
+                    "Custom-op worker is still executing a previously "
+                    "timed-out callback; refusing to run a second user "
+                    "callback concurrently (single-worker guarantee)")
+            _WEDGED_FUT = None          # old thread finished — all clear
         fut = _WORKER.submit(fn, *args)
     try:
         return fut.result(timeout=timeout)
     except concurrent.futures.TimeoutError:
         fut.cancel()      # prune if not yet started; never run it late
-        _reset_worker()   # the stuck thread is unrecoverable — replace it
+        _reset_worker(fut)  # the stuck thread is unrecoverable — replace
         raise MXNetError(
             "Custom-op callback did not complete within %.0fs "
             "(MXNET_CUSTOM_OP_TIMEOUT_SEC): worker thread wedged or the "

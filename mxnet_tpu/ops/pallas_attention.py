@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_attention_available"]
+__all__ = ["flash_attention", "flash_attention_available",
+           "flash_attention_stats"]
 
 INTERPRET = False
 
@@ -43,6 +44,12 @@ def flash_attention_available(B, H, Tq, Tk, D, dtype=None) -> bool:
         return False
     if D % 8 or Tq % 8 or Tk % 128:
         return False
+    if not INTERPRET and Tk < 2048:
+        # measured crossover (tools/bench_ring_attention.py ring rows,
+        # B=1 H=8 D=128 bf16): XLA's fused scan hits ~89 TF at Tk=1024
+        # and beats the kernel 4x; the kernel wins ~2x from Tk=2048 up to
+        # the VMEM envelope below
+        return False
     # K+V resident in VMEM per (b,h) program, double-buffered by the
     # pipeline.  Measured crossover (tools/bench_ring_attention.py):
     # the kernel wins 1.9x while K/V stream from VMEM comfortably
@@ -54,8 +61,11 @@ def flash_attention_available(B, H, Tq, Tk, D, dtype=None) -> bool:
     return 2 * kv_bytes <= 5 * 1024 * 1024
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, TQ, BK, Tk, causal,
-                  scale, q_chunk_count):
+def _online_softmax_loop(q_ref, k_ref, v_ref, *, TQ, BK, Tk, causal,
+                         scale):
+    """Shared kernel body: the online-softmax K-block loop, returning the
+    running (m, l, acc) — finalized differently by the normalized-output
+    kernel and the stats-emitting ring kernel."""
     qi = pl.program_id(1)
     qb = q_ref[0]                                    # (TQ, D)
     D = qb.shape[-1]
@@ -89,8 +99,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, TQ, BK, Tk, causal,
             preferred_element_type=jnp.float32)
         return m_new, l2, acc2
 
-    m, l, acc = jax.lax.fori_loop(0, Tk // BK, body, (m0, l0, a0))
+    return jax.lax.fori_loop(0, Tk // BK, body, (m0, l0, a0))
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, TQ, BK, Tk, causal,
+                  scale, q_chunk_count):
+    m, l, acc = _online_softmax_loop(q_ref, k_ref, v_ref, TQ=TQ, BK=BK,
+                                     Tk=Tk, causal=causal, scale=scale)
     o_ref[0] = (acc / jnp.maximum(l, 1e-37)[:, None]).astype(o_ref.dtype)
+
+
+def _out_sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call output, inheriting the caller's
+    varying-mesh-axes set — required when the kernel runs inside
+    shard_map (the ring-attention per-shard pass)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _pick_blocks(Tq, Tk, block_q, block_k):
+    TQ = min(block_q, Tq)
+    while Tq % TQ:
+        TQ //= 2
+    BK = min(block_k, Tk)
+    while Tk % BK:
+        BK //= 2
+    return TQ, BK
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
@@ -100,12 +136,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     q3 = q.reshape(BH, Tq, D)
     k3 = k.reshape(BH, Tk, D)
     v3 = v.reshape(BH, Tk, D)
-    TQ = min(block_q, Tq)
-    while Tq % TQ:
-        TQ //= 2
-    BK = min(block_k, Tk)
-    while Tk % BK:
-        BK //= 2
+    TQ, BK = _pick_blocks(Tq, Tk, block_q, block_k)
 
     kern = functools.partial(
         _flash_kernel, TQ=TQ, BK=BK, Tk=Tk, causal=causal, scale=scale,
@@ -119,10 +150,66 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        out_shape=_out_sds((BH, Tq, D), q.dtype, q),
         interpret=INTERPRET,
     )(q3, k3, v3)
     return out.reshape(B, H, Tq, D)
+
+
+def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                        TQ, BK, Tk, causal, scale):
+    """_flash_kernel's loop, but emitting the UNNORMALIZED accumulator
+    and the online-softmax stats (m, l) instead of the normalized output
+    — the building block for cross-shard merging in ring attention (each
+    ring step computes local stats on the resident K/V shard; the exact
+    combine happens outside in XLA)."""
+    m, l, acc = _online_softmax_loop(q_ref, k_ref, v_ref, TQ=TQ, BK=BK,
+                                     Tk=Tk, causal=causal, scale=scale)
+    acc_ref[0] = acc
+    # stats are lane-replicated to a trailing 128 dim: Mosaic requires the
+    # last two block dims to be (8k, 128k)-aligned, and a (1, TQ) block
+    # is not; callers read lane 0
+    m_ref[0] = jnp.broadcast_to(m[:, None], (TQ, 128))
+    l_ref[0] = jnp.broadcast_to(l[:, None], (TQ, 128))
+
+
+def flash_attention_stats(q, k, v, causal, scale, block_q=512,
+                          block_k=512):
+    """Per-shard flash pass returning (acc, m, l) in f32: acc is the
+    UNNORMALIZED output accumulator, (m, l) the online-softmax running
+    max/sum.  Exact cross-shard merge (ring attention):
+
+        m' = max(m_a, m_b);  l' = l_a*e^{m_a-m'} + l_b*e^{m_b-m'}
+        acc' = acc_a*e^{m_a-m'} + acc_b*e^{m_b-m'};  out = acc'/l'
+    """
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    TQ, BK = _pick_blocks(Tq, Tk, block_q, block_k)
+    kern = functools.partial(_flash_stats_kernel, TQ=TQ, BK=BK, Tk=Tk,
+                             causal=causal, scale=scale)
+    acc, m, l = pl.pallas_call(
+        kern,
+        grid=(BH, Tq // TQ),
+        in_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, TQ, 128), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, TQ, 128), lambda b, t: (b, t, 0)),
+        ],
+        out_shape=[
+            _out_sds((BH, Tq, D), jnp.float32, q),
+            _out_sds((BH, Tq, 128), jnp.float32, q),
+            _out_sds((BH, Tq, 128), jnp.float32, q),
+        ],
+        interpret=INTERPRET,
+    )(q.reshape(BH, Tq, D), k.reshape(BH, Tk, D), v.reshape(BH, Tk, D))
+    return (acc.reshape(B, H, Tq, D), m[..., 0].reshape(B, H, Tq),
+            l[..., 0].reshape(B, H, Tq))
 
 
 def _xla_blockwise(q, k, v, causal, scale):

@@ -114,18 +114,35 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                    causal: bool = False, block_size: int = 512,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, use_pallas: bool = True):
     """Exact attention with sequence sharded on `axis`.
 
-    Inputs [B,H,T,D] with T = full sequence; returns same sharding.  Each of
-    the n ring steps overlaps a K/V ``ppermute`` with blockwise attention on
-    the already-held shard.
+    Inputs [B,H,T,D] with T = full sequence; returns same sharding.  Each
+    of the n ring steps overlaps a K/V ``ppermute`` with attention over
+    the already-held shard.  On TPU (lowering-time platform branch) the
+    per-shard pass is the Pallas flash kernel emitting online-softmax
+    stats (``flash_attention_stats``); the exact cross-shard combine
+    (m/l rescaling) runs in XLA between steps, and for causal masks the
+    per-step mask kind is resolved with ``lax.switch``: fully-visible
+    shards run the kernel unmasked, the diagonal shard runs it causally,
+    and fully-masked shards skip the kernel entirely (the classic ring
+    load-saving).  The ring decomposition is also what makes the kernel
+    APPLICABLE at long T: the VMEM gate sees the per-shard K/V (T/n),
+    not the full sequence.  Backward recomputes through the scan
+    formulation (same rematerialization policy as flash_attention).
     """
     n = mesh.shape[axis]
     D = q.shape[-1]
     sc = scale if scale is not None else 1.0 / (D ** 0.5)
 
-    def per_shard(qs, ks, vs):
+    def _pvary(*xs):
+        # carries become device-varying after the first ppermute, so the
+        # initial values must be marked varying over the ring axis too
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(xs, (axis,), to="varying")
+        return jax.lax.pvary(xs, (axis,))
+
+    def per_shard_scan(qs, ks, vs):
         idx = jax.lax.axis_index(axis)
         T_loc = qs.shape[2]
         B, H = qs.shape[0], qs.shape[1]
@@ -148,21 +165,106 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         m0 = jnp.full((B, H, T_loc), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((B, H, T_loc), jnp.float32)
         o0 = jnp.zeros((B, H, T_loc, qs.shape[-1]), jnp.float32)
-        # the carry becomes device-varying after the first ppermute, so the
-        # initial value must be marked varying over the ring axis too
-        if hasattr(jax.lax, "pcast"):
-            m0, l0, o0 = jax.lax.pcast((m0, l0, o0), (axis,), to="varying")
-        else:
-            m0, l0, o0 = jax.lax.pvary((m0, l0, o0), (axis,))
+        m0, l0, o0 = _pvary(m0, l0, o0)
         (m, l, o, _, _), _ = jax.lax.scan(body, (m0, l0, o0, ks, vs),
                                           jnp.arange(n))
         out = o / jnp.maximum(l[..., None], 1e-37)
         return out.astype(qs.dtype)
 
+    def per_shard_flash(qs, ks, vs):
+        from ..ops import pallas_attention as pa
+        idx = jax.lax.axis_index(axis)
+        T_loc = qs.shape[2]
+        B, H = qs.shape[0], qs.shape[1]
+        bs = block_size
+
+        def kernel_full(kc, vc):
+            return pa.flash_attention_stats(qs, kc, vc, False, sc, bs, bs)
+
+        def kernel_diag(kc, vc):
+            return pa.flash_attention_stats(qs, kc, vc, True, sc, bs, bs)
+
+        def kernel_skip(kc, vc):
+            return (jnp.zeros((B, H, T_loc, qs.shape[-1]), jnp.float32),
+                    jnp.full((B, H, T_loc), -jnp.inf, jnp.float32),
+                    jnp.zeros((B, H, T_loc), jnp.float32))
+
+        def body(carry, step):
+            m, l, acc, kcur, vcur = carry
+            if causal:
+                src = (idx - step) % n
+                # 0: src<idx fully visible; 1: diagonal (local causal);
+                # 2: src>idx fully masked — kernel skipped
+                mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+                acci, mi, li = jax.lax.switch(
+                    mode, [kernel_full, kernel_diag, kernel_skip],
+                    kcur, vcur)
+            else:
+                acci, mi, li = kernel_full(kcur, vcur)
+            # exact online-softmax combine across shards
+            m_new = jnp.maximum(m, mi)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            a = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+            b = jnp.where(jnp.isfinite(mi), jnp.exp(mi - m_safe), 0.0)
+            l_new = l * a + li * b
+            acc_new = acc * a[..., None] + acci * b[..., None]
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            knext = jax.lax.ppermute(kcur, axis, perm)
+            vnext = jax.lax.ppermute(vcur, axis, perm)
+            return (m_new, l_new, acc_new, knext, vnext), None
+
+        m0 = jnp.full((B, H, T_loc), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, T_loc), jnp.float32)
+        a0 = jnp.zeros((B, H, T_loc, qs.shape[-1]), jnp.float32)
+        m0, l0, a0 = _pvary(m0, l0, a0)
+        (m, l, acc, _, _), _ = jax.lax.scan(body, (m0, l0, a0, ks, vs),
+                                            jnp.arange(n))
+        out = acc / jnp.maximum(l[..., None], 1e-37)
+        return out.astype(qs.dtype)
+
+    @jax.custom_vjp
+    def _ring_flash(qs, ks, vs):
+        return per_shard_flash(qs, ks, vs)
+
+    def _rf_fwd(qs, ks, vs):
+        return _ring_flash(qs, ks, vs), (qs, ks, vs)
+
+    def _rf_bwd(res, g):
+        qs, ks, vs = res
+        _, vjp = jax.vjp(per_shard_scan, qs, ks, vs)
+        return vjp(g)
+
+    _ring_flash.defvjp(_rf_fwd, _rf_bwd)
+
+    from ..ops import pallas_attention as pa
+    B, H, T = q.shape[0], q.shape[1], q.shape[2]
+    use_flash = use_pallas and T % n == 0 and \
+        pa.flash_attention_available(B, H, T // n, T // n, D, q.dtype)
+
+    def per_shard(qs, ks, vs):
+        if pa.INTERPRET:        # test hook: force the interpreter on CPU
+            return _ring_flash(qs, ks, vs)
+        return jax.lax.platform_dependent(
+            qs, ks, vs, tpu=_ring_flash, default=per_shard_scan)
+
     from jax.experimental.shard_map import shard_map
     spec = P(None, None, axis, None)
-    f = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
-                  out_specs=spec)
+    kw = {}
+    if use_flash:
+        # pallas_call inside shard_map is not vma-checkable (the per-shard
+        # kernel's internal slices are unvarying); exactness vs the
+        # checked scan formulation is pinned by tests.  Older jax spells
+        # the flag check_rep — probe the signature instead of catching
+        # TypeError, which would mask real errors.
+        import inspect
+        params = inspect.signature(shard_map).parameters
+        flag = ("check_vma" if "check_vma" in params
+                else "check_rep" if "check_rep" in params else None)
+        if flag:
+            kw = {flag: False}
+    f = shard_map(per_shard if use_flash else per_shard_scan,
+                  mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec, **kw)
     return f(q, k, v)
 
 

@@ -63,8 +63,8 @@ def test_blockwise_lowering_selects_scan_off_tpu():
     at CPU lowering, so compiling+running proves the selection.  Gradient
     must flow through the platform branch too."""
     pa.INTERPRET = False             # defeat the autouse interpret fixture
-    q, k, v = _case(T=128)
-    assert pa.flash_attention_available(2, 2, 128, 128, 16)
+    q, k, v = _case(T=2048)          # above the non-interpret min-Tk gate
+    assert pa.flash_attention_available(2, 2, 2048, 2048, 16)
 
     f = jax.jit(lambda q, k, v: blockwise_attention(
         q, k, v, block_size=128, causal=True))
@@ -82,3 +82,65 @@ def test_blockwise_lowering_selects_scan_off_tpu():
         q, k, v, block_size=32, causal=True, use_pallas=False) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=1e-4, atol=1e-4)
+
+
+def _full_ref(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) \
+        / np.sqrt(d)
+    if causal:
+        t = s.shape[-2]
+        mask = np.arange(t)[:, None] >= np.arange(t)[None, :]
+        s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_scan_and_reference(causal):
+    """Round-4 verdict item 4: the ring path dispatches the flash kernel
+    per resident shard (interpret mode here), with the exact (m, l, acc)
+    cross-shard combine.  T_loc = 512/4 = 128 satisfies the kernel's
+    lane-size gate — the ring decomposition is what makes the kernel
+    applicable at long T."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+    r = np.random.default_rng(0)
+    B, H, T, D = 1, 2, 512, 16
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, D)) * 0.5,
+                           jnp.float32) for _ in range(3))
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    got = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                         block_size=128)
+    scan = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                          block_size=128, use_pallas=False)
+    ref = _full_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(scan),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_flash_gradient_matches_scan():
+    """Backward recomputes through the scan formulation (custom VJP);
+    gradients must match differentiating the scan ring directly."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+    r = np.random.default_rng(1)
+    B, H, T, D = 1, 1, 512, 8
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, D)) * 0.5,
+                           jnp.float32) for _ in range(3))
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+
+    def loss(use_pallas):
+        def f(q, k, v):
+            out = ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                                 block_size=128, use_pallas=use_pallas)
+            return jnp.sum(out ** 2)
+        return f
+
+    gp = jax.grad(loss(True), (0, 1, 2))(q, k, v)
+    gs = jax.grad(loss(False), (0, 1, 2))(q, k, v)
+    for a, b, nme in zip(gp, gs, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=nme)

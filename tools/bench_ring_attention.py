@@ -18,8 +18,43 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-REPS = 4
+REPS = 7
 CHAIN = 30
+
+
+def _time_chain(step, x0, chain):
+    import jax
+    import jax.numpy as jnp
+    import statistics
+
+    def build(n):
+        @jax.jit
+        def f(x):
+            def body(c, _):
+                o = step(c)
+                eps = (jnp.sum(o.astype(jnp.float32)) * 1e-12)
+                return c + eps.astype(c.dtype), None
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return jnp.sum(y.astype(jnp.float32))
+        return f
+
+    f1, f2 = build(chain), build(2 * chain)
+    float(f1(x0)); float(f2(x0))
+    # median of PAIRED (2N - N) differences: resists the tunnel's
+    # per-call latency swings, which made min-of-mins go negative
+    diffs = []
+    for _ in range(REPS):
+        t0 = time.perf_counter(); float(f1(x0))
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(f2(x0))
+        d2 = time.perf_counter() - t0
+        diffs.append(d2 - d1)
+    med = statistics.median(diffs)
+    if med <= 0:
+        # tunnel bimodality swamped the differential: flag instead of
+        # clamping (a clamp fabricates astronomical TF rows)
+        return None
+    return med / chain
 
 
 def chip_bench():
@@ -29,26 +64,6 @@ def chip_bench():
 
     from mxnet_tpu.parallel.ring_attention import blockwise_attention
 
-    def time_chain(step, x0, chain):
-        def build(n):
-            @jax.jit
-            def f(x):
-                def body(c, _):
-                    o = step(c)
-                    eps = (jnp.sum(o.astype(jnp.float32)) * 1e-12)
-                    return c + eps.astype(c.dtype), None
-                y, _ = jax.lax.scan(body, x, None, length=n)
-                return jnp.sum(y.astype(jnp.float32))
-            return f
-        f1, f2 = build(chain), build(2 * chain)
-        float(f1(x0)); float(f2(x0))
-        b1 = b2 = 1e9
-        for _ in range(REPS):
-            t0 = time.perf_counter(); float(f1(x0))
-            b1 = min(b1, time.perf_counter() - t0)
-            t0 = time.perf_counter(); float(f2(x0))
-            b2 = min(b2, time.perf_counter() - t0)
-        return max(b2 - b1, 1e-9) / chain
 
     results = []
     r = np.random.default_rng(0)
@@ -67,7 +82,10 @@ def chip_bench():
             fn = lambda c, up=use_pallas: blockwise_attention(
                 c, k, v, block_size=256, causal=True, use_pallas=up)
             # correctness cross-check once
-            t = time_chain(fn, q, CHAIN)
+            t = _time_chain(fn, q, CHAIN)
+            if t is None:
+                row[name + "_timing_suspect"] = True
+                continue
             row[name + "_ms"] = round(t * 1e3, 3)
             row[name + "_tf"] = round(flops / t / 1e12, 1)
             row[name + "_tokens_per_sec"] = round(T / t, 0)
@@ -78,8 +96,62 @@ def chip_bench():
             q, k, v, block_size=256, causal=True,
             use_pallas=True).astype(jnp.float32))
         row["max_err"] = float(np.max(np.abs(got - ref)))
-        row["pallas_speedup"] = round(row["xla_scan_ms"]
-                                      / row["pallas_ms"], 3)
+        if "xla_scan_ms" in row and "pallas_ms" in row:
+            row["pallas_speedup"] = round(
+                row["xla_scan_ms"] / max(row["pallas_ms"], 1e-6), 3)
+        results.append(row)
+    return results
+
+
+def ring_chip_bench():
+    """The RING path itself on the real chip (r03 verdict item 4): a
+    1-device mesh runs the actual per-shard ring code — flash kernel
+    emitting (acc, m, l) stats + the exact cross-shard combine — vs the
+    scan formulation.  The per-shard VMEM gate sees T/n, so the ring
+    decomposition is what keeps the kernel applicable at long T."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+
+
+    mesh = make_mesh({"sp": 1})
+    r = np.random.default_rng(0)
+    B, H, D = 1, 8, 128
+    results = []
+    # T here is the PER-SHARD sequence (the 1-device mesh runs one ring
+    # step); an 8-way ring at global T = 8*T_loc runs exactly this per
+    # step, so the T_loc=1024 row is the per-step cost of ring attention
+    # at global T=8192.  T_loc=8192 single-chip exceeds the kernel's
+    # resident-KV VMEM envelope and documents the scan fallback edge.
+    for T in (1024, 2048, 4096, 8192):
+        q, k, v = (jnp.asarray(r.standard_normal((B, H, T, D)) * 0.3,
+                               jnp.bfloat16) for _ in range(3))
+        flops = 2 * 2 * B * H * T * T * D / 2
+        row = {"T_loc": T, "T_global_8way": 8 * T}
+        for name, up in (("ring_scan", False), ("ring_flash", True)):
+            fn = lambda c, u=up: ring_attention(
+                c, k, v, mesh, axis="sp", causal=True, block_size=256,
+                use_pallas=u)
+            t = _time_chain(fn, q, CHAIN)
+            if t is None:
+                row[name + "_timing_suspect"] = True
+                continue
+            row[name + "_ms"] = round(t * 1e3, 3)
+            row[name + "_tf"] = round(flops / t / 1e12, 1)
+        ref = np.asarray(ring_attention(q, k, v, mesh, axis="sp",
+                                        causal=True, block_size=256,
+                                        use_pallas=False)
+                         .astype(jnp.float32))
+        got = np.asarray(ring_attention(q, k, v, mesh, axis="sp",
+                                        causal=True, block_size=256)
+                         .astype(jnp.float32))
+        row["max_err"] = float(np.max(np.abs(got - ref)))
+        if "ring_scan_ms" in row and "ring_flash_ms" in row:
+            row["flash_speedup"] = round(
+                row["ring_scan_ms"] / max(row["ring_flash_ms"], 1e-6), 3)
         results.append(row)
     return results
 
@@ -111,10 +183,27 @@ ring = np.asarray(ring_attention(qs, ks, vs, mesh, axis="sp",
                                  causal=True, block_size=32))
 uly = np.asarray(ulysses_attention(qs, ks, vs, mesh, axis="sp",
                                    causal=True))
+
+# the PALLAS ring path (interpret mode) on the 8-way mesh: per-shard
+# flash kernel + cross-shard stats combine must be exact too
+from mxnet_tpu.ops import pallas_attention as pa
+T2 = 1024                      # T_loc = 128 satisfies the lane gate
+q2, k2, v2 = (jnp.asarray(r.standard_normal((B, H, T2, D)) * 0.3,
+                          jnp.float32) for _ in range(3))
+ref2 = np.asarray(blockwise_attention(q2, k2, v2, causal=True,
+                                      use_pallas=False))
+pa.INTERPRET = True
+try:
+    ring_fl = np.asarray(ring_attention(
+        *(jax.device_put(a, sh) for a in (q2, k2, v2)),
+        mesh, axis="sp", causal=True, block_size=128))
+finally:
+    pa.INTERPRET = False
 print(json.dumps({
     "devices": 8,
     "ring_max_err": float(np.max(np.abs(ring - ref))),
     "ulysses_max_err": float(np.max(np.abs(uly - ref))),
+    "ring_flash_max_err": float(np.max(np.abs(ring_fl - ref2))),
 }))
 """
     out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
@@ -128,6 +217,7 @@ def main():
     result = {"metric": "ring_attention_microbench"}
     if "--mesh-only" not in sys.argv:
         result["single_chip"] = chip_bench()
+        result["ring_path_chip"] = ring_chip_bench()
     if "--chip-only" not in sys.argv:
         result["virtual_mesh"] = mesh_check()
     print(json.dumps(result))
