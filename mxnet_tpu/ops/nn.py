@@ -113,6 +113,52 @@ def _stem_s2d_conv(attrs, data, weight):
         dimension_numbers=_conv_dnums(2))
 
 
+def _shifted_gemm_eligible(attrs, data, nd):
+    """3x3 / stride 1 / dilate 1 / SAME / ungrouped 2-D convs can run as
+    9 shifted GEMMs — measured STABLE at 175-191 TF on v5e in chained
+    blocks where the lax.conv lowering is bimodal across processes
+    (136 TF fast mode, ~21 TF slow mode; tools/probe_fused_block.py).
+    E2E-MEASURED AND REJECTED as a default: inside the full ResNet-50
+    training graph the same formulation collapses to 125 img/s (~18x
+    slower than lax.conv) — the chain win does not survive whole-graph
+    scheduling (docs/perf_analysis.md round-4 probe).  Kept behind
+    MXNET_TPU_CONV_SHIFTED_GEMM=1 as a probing tool.  NOTE: the flag is
+    read at TRACE time and compiled executables are cached per (op,
+    attrs) — after toggling it, clear ``OPS['Convolution']._jit_cache``
+    (a fresh process is the clean way to probe)."""
+    import os
+    if os.environ.get("MXNET_TPU_CONV_SHIFTED_GEMM", "0") != "1":
+        return False
+    k = attrs["kernel"]
+    return (nd == 2 and tuple(k) == (3, 3)
+            and tuple(attrs["stride"] or (1, 1)) == (1, 1)
+            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
+            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
+            and attrs["num_group"] == 1 and data.ndim == 4)
+
+
+def _shifted_gemm_conv(data, weight):
+    """NCHW 3x3 SAME conv as 9 shifted (NHW, C)x(C, O) GEMMs."""
+    N, C, H, W = data.shape
+    O = weight.shape[0]
+    xh = jnp.transpose(data, (0, 2, 3, 1))               # NHWC
+    xp = jnp.pad(xh, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    acc = None
+    for dy in range(3):
+        for dx in range(3):
+            tap = xp[:, dy:dy + H, dx:dx + W, :].reshape(N * H * W, C)
+            wk = weight[:, :, dy, dx].T                  # (C, O)
+            # f32 accumulation across the 9 taps (matches lax.conv's
+            # single f32 accumulate and the probe formulation — bf16
+            # partial rounding would change the numerics being compared)
+            part = jax.lax.dot_general(
+                tap, wk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = part if acc is None else acc + part
+    return jnp.transpose(acc.reshape(N, H, W, O),
+                         (0, 3, 1, 2)).astype(data.dtype)
+
+
 @register("Convolution", nin=-1, aliases=("convolution", "Convolution_v1"),
           params=dict(_CONV_PARAMS))
 def _convolution(attrs, data, weight, *maybe_bias):
@@ -124,6 +170,8 @@ def _convolution(attrs, data, weight, *maybe_bias):
     pad = attrs["pad"] or (0,) * nd
     if _stem_s2d_eligible(attrs, data, nd):
         out = _stem_s2d_conv(attrs, data, weight)
+    elif _shifted_gemm_eligible(attrs, data, nd):
+        out = _shifted_gemm_conv(data, weight)
     else:
         out = jax.lax.conv_general_dilated(
             data, weight,
