@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""ONNX interchange walkthrough: export a trained model, re-import it,
+and verify prediction parity.
+
+Reference analog: ``example/onnx/`` (super_resolution import demo) over
+``mx.contrib.onnx`` — the interchange story for serving stacks that
+speak ONNX.  This framework ships its own protobuf codec
+(``contrib/onnx_proto.py``) and 85 importer conversions, so the
+round-trip needs no external onnx installation.
+
+Run:  python example/onnx/onnx_roundtrip.py
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu import symbol as S
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="Train a small CNN, export to ONNX, re-import, compare",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--steps", type=int, default=20)
+parser.add_argument("--out", type=str, default=None,
+                    help="where to write the .onnx file (tempdir default)")
+
+
+def main(args):
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize(ctx=mx.cpu())
+    x = mx.nd.random.uniform(shape=(4, 1, 8, 8))
+    y = mx.nd.array(np.random.randint(0, 10, (4,)))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    net(x).wait_to_read()
+    net.hybridize()
+    for _ in range(args.steps):
+        with autograd.record():
+            L = ce(net(x), y).mean()
+        L.backward()
+        tr.step(1)
+    ref = net(x).asnumpy()
+
+    # export: symbol + params -> .onnx
+    sym = net(S.var("data"))
+    params = {}
+    for name, p in net.collect_params().items():
+        params[name] = p.data()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = args.out or os.path.join(tmp, "model.onnx")
+        mx.contrib.onnx.export_model(sym, params, (4, 1, 8, 8),
+                                     onnx_file=path)
+        print("exported:", path, "(%d bytes)" % os.path.getsize(path))
+
+        # re-import and compare
+        sym2, arg2, aux2 = mx.contrib.onnx.import_model(path)
+    ex = sym2.bind(mx.cpu(), {**arg2, "data": x}, aux_states=aux2)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    err = float(np.abs(got - ref).max())
+    print("round-trip max abs err: %.2e" % err)
+    assert err < 1e-4, err
+    print("ONNX round-trip OK")
+    return err
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""INT8 quantization walkthrough: calibrate, fuse, compare.
+
+Reference analog: ``example/quantization/imagenet_gen_qsym.py`` — take a
+trained fp32 model, calibrate activation ranges on sample data, emit the
+int8 symbol + params, and validate accuracy against fp32.
+
+TPU-native pipeline demonstrated here (``quantize_model(fuse=True)``):
+BatchNorms are folded into conv weights, calibration covers conv/FC and
+residual-add outputs plus the data input, and the graph is rewritten
+with fused ``_sg_int8_*`` ops — every scale a static attribute, the
+requantize+ReLU epilogue fused into each conv, residual adds computed
+int8-to-int8.  Measured on a v5e chip this is 1.29x bf16 inference at
+top-1 agreement 1.000 (docs/perf_analysis.md round 4); the reference's
+dynamic-range layout (``fuse=False``) is also available for parity.
+
+With no ImageNet on disk the demo uses a model-zoo ResNet-18 on
+synthetic data; swap in real weights via ``net.load_parameters`` and a
+real ``calib_data`` iterator for production use.
+
+Run:  python example/quantization/quantize_resnet.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as S
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.io import NDArrayIter
+
+parser = argparse.ArgumentParser(
+    description="Quantize a model zoo ResNet to fused int8",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--model", type=str, default="resnet18_v1")
+parser.add_argument("--batch-size", type=int, default=8)
+parser.add_argument("--image-size", type=int, default=32)
+parser.add_argument("--calib-mode", type=str, default="naive",
+                    choices=["naive", "entropy"],
+                    help="entropy (KL) needs representative calib data")
+parser.add_argument("--num-calib", type=int, default=8)
+parser.add_argument("--no-fuse", action="store_true",
+                    help="use the reference-layout dynamic-range pass")
+
+
+def main(args):
+    net = getattr(vision, args.model)()
+    net.initialize()            # default context: tpu(0) if present
+    shape = (args.batch_size, 3, args.image_size, args.image_size)
+    x = mx.nd.random.uniform(0, 1, shape=shape)
+    net(x).wait_to_read()
+    net.hybridize()
+
+    # 1. export the symbol + params (the deploy form)
+    sym = net(S.var("data"))
+    params = net.collect_params()
+    arg_params = {n: params[n].data()
+                  for n in sym.list_arguments() if n != "data"}
+    aux_params = {n: params[n].data()
+                  for n in sym.list_auxiliary_states()}
+
+    # 2. calibrate + quantize
+    calib = NDArrayIter(data=x.asnumpy(), batch_size=args.batch_size)
+    qsym, qargs, qauxs = mx.contrib.quantization.quantize_model(
+        sym, arg_params, aux_params,
+        calib_mode=args.calib_mode, calib_data=calib,
+        num_calib_examples=args.num_calib, fuse=not args.no_fuse)
+
+    # int8 weights carry the _quantize suffix (public naming convention
+    # of the pass) — one per quantized conv/FC layer
+    n_int8 = sum(1 for n in qsym.list_arguments()
+                 if n.endswith("_quantize"))
+    print("quantized layers: %d (%s pass)"
+          % (n_int8, "fused" if not args.no_fuse else "legacy"))
+
+    # 3. validate against fp32
+    ref = net(x).asnumpy()
+    ex = qsym.bind(x.context, {**qargs, "data": x}, aux_states=qauxs)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    agree = float((got.argmax(1) == ref.argmax(1)).mean())
+    corr = float(np.corrcoef(got.ravel(), ref.ravel())[0, 1])
+    print("top-1 agreement vs fp32: %.3f   output corr: %.4f"
+          % (agree, corr))
+    return agree, corr, n_int8
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -46,3 +46,20 @@ def test_module_example_trains(tmp_path):
     # the checkpoint files exist (epoch 8 symbol+params)
     assert (tmp_path / "mod_demo-symbol.json").exists() or \
         (tmp_path / "mod_demo-0008.params").exists()
+
+
+def test_quantization_example():
+    qz = _load("example/quantization/quantize_resnet.py",
+               "quantize_resnet")
+    args = qz.parser.parse_args(["--batch-size", "4", "--image-size", "32"])
+    agree, corr, n_int8 = qz.main(args)
+    assert corr > 0.99, corr
+    assert n_int8 >= 20, n_int8      # resnet18: 20 convs quantized
+
+
+def test_onnx_example(tmp_path):
+    ox = _load("example/onnx/onnx_roundtrip.py", "onnx_roundtrip")
+    args = ox.parser.parse_args(["--steps", "10",
+                                 "--out", str(tmp_path / "m.onnx")])
+    err = ox.main(args)
+    assert err < 1e-4
