@@ -69,6 +69,7 @@ def greedy_decode(logits):
 
 
 def main(args):
+    mx.random.seed(0)      # deterministic init for the smoke tests
     if args.samples < args.batch_size or args.num_epochs < 1:
         parser.error("need --samples >= --batch-size and >= 1 epoch")
     X, Y = make_data(args.samples, args.seq_len, args.max_label)

@@ -67,9 +67,13 @@ def build_nets():
 
 
 def main(args):
+    mx.random.seed(0)        # param init + latents ride the mx RNG
     np.random.seed(0)
     if args.samples < args.batch_size or args.num_epochs < 1:
         parser.error("need --samples >= --batch-size and >= 1 epoch")
+    if args.size != 16:
+        parser.error("the demo generator topology is fixed at 16x16 "
+                     "output; adapt build_nets for other --size values")
     netG, netD = build_nets()
     netG.initialize(init=mx.init.Normal(0.02))
     netD.initialize(init=mx.init.Normal(0.02))
@@ -93,17 +97,19 @@ def main(args):
                                  args.batch_size, seed=epoch):
             realn = mx.nd.array(real)
             z = mx.nd.random.normal(shape=(args.batch_size, args.nz))
-            # D step: real -> 1, fake -> 0
+            # D step: real -> 1, fake -> 0 (G forward recorded once and
+            # reused — detached for D, live for G)
             with autograd.record():
+                fake = netG(z)
                 out_r = netD(realn).reshape((-1,))
-                out_f = netD(netG(z).detach()).reshape((-1,))
+                out_f = netD(fake.detach()).reshape((-1,))
                 errD = (loss_fn(out_r, ones)
                         + loss_fn(out_f, zeros)).mean()
             errD.backward()
             trainerD.step(1)
             # G step: fool D
             with autograd.record():
-                errG = loss_fn(netD(netG(z)).reshape((-1,)), ones).mean()
+                errG = loss_fn(netD(fake).reshape((-1,)), ones).mean()
             errG.backward()
             trainerG.step(1)
             dl += float(errD.asnumpy())

@@ -84,3 +84,12 @@ def test_ctc_example_learns():
     # CTC cracked the alignment: loss far below the ~10.7 uniform level
     assert loss < 1.5, loss
     assert acc > 0.7, acc
+
+
+def test_matrix_factorization_example():
+    mf = _load("example/recommenders/matrix_fact.py", "matrix_fact")
+    args = mf.parser.parse_args(["--num-epochs", "8",
+                                 "--ratings", "4000"])
+    rmse = mf.main(args)
+    # true noise floor is 0.05; random embeddings start near ~0.5
+    assert rmse < 0.12, rmse
