@@ -493,6 +493,14 @@ class ImageRecordIter(DataIter):
         self._producer_thread = None
         self._stop = threading.Event()
         self._mem = None
+        # batch staging buffers come from the per-context temp-space pool
+        # (resource.cc kTempSpace semantics: one rotating slot per user,
+        # reused across batches instead of a fresh malloc per batch)
+        from . import resource as _resource
+        from . import context as _ctx
+        self._workspace = _resource.ResourceManager.get().request(
+            _ctx.cpu(0),
+            _resource.ResourceRequest(_resource.ResourceRequest.kTempSpace))
         if path_imgidx and os.path.exists(path_imgidx):
             self.rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             self.keys = list(self.rec.keys)
@@ -545,6 +553,8 @@ class ImageRecordIter(DataIter):
         if getattr(self, "_pool", None) is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        # release the temp-space slot with the iterator, not at GC time
+        self._workspace = None
 
     __del__ = close
 
@@ -623,8 +633,17 @@ class ImageRecordIter(DataIter):
         futures = [self._pool.submit(self._decode_one, r) for r in raws]
         results = [f.result() for f in futures]
         c, h, w = self.data_shape
-        data = np.empty((self.batch_size, h, w, c), np.float32)
-        label = np.empty((self.batch_size,), np.float32)
+        # staging scratch from the resource pool: one workspace carved for
+        # HWC staging + CHW output + label (the reference op pattern —
+        # request one space sized for everything); safe to reuse because
+        # nd.array's astype copy (guaranteed, never aliasing) materializes
+        # the batch before the next call overwrites the workspace
+        n_img = self.batch_size * h * w * c
+        ws = self._workspace.get_space(
+            (2 * n_img + self.batch_size,), np.float32)
+        data = ws[:n_img].reshape((self.batch_size, h, w, c))
+        chw = ws[n_img:2 * n_img].reshape((self.batch_size, c, h, w))
+        label = ws[2 * n_img:]
         for i, (d, l) in enumerate(results):
             data[i], label[i] = d, l
         pad = self.batch_size - len(results)
@@ -632,9 +651,10 @@ class ImageRecordIter(DataIter):
             data[len(results):] = data[:1]
             label[len(results):] = label[:1]
         # one vectorized HWC->CHW for the whole batch (cheaper than 128
-        # per-image strided copies, and outside the decode workers)
-        data = np.ascontiguousarray(data.transpose(0, 3, 1, 2))
-        batch = DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+        # per-image strided copies, and outside the decode workers),
+        # written into the pooled CHW carve instead of a fresh allocation
+        np.copyto(chw, data.transpose(0, 3, 1, 2))
+        batch = DataBatch([nd.array(chw)], [nd.array(label)], pad=pad)
         return ([batch, None], True) if pad else ([batch], False)
 
     def _augment(self, img):

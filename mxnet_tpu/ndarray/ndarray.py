@@ -666,6 +666,10 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     src = np.asarray(source)
     if dtype is None:
         dtype = np.float32 if src.dtype == np.float64 else src.dtype
+    # The astype copy is load-bearing even for same-dtype sources:
+    # device_put zero-copy-aliases suitably aligned host arrays on the CPU
+    # backend, and nd.array must never alias caller memory (callers reuse
+    # staging buffers — the universal MXNet pattern).
     return NDArray(_put(src.astype(np.dtype(dtype)), ctx), ctx)
 
 

@@ -1,45 +1,64 @@
-"""Global RNG state preserving the ``mx.random.seed`` UX over threefry keys.
+"""``mx.random.seed`` UX over the per-context resource RNG streams.
 
 Reference analog: per-device RNG resources (``src/common/random_generator.h:
-45-97``, ``src/resource.cc``) seeded by ``mx.random.seed``.  TPU-native: one
-global threefry key; every random op call splits a fresh subkey (functional,
-reproducible, parallel-safe — SURVEY.md §7.3 "RNG parity").
+45-97``, ``src/resource.cc``) seeded by ``mx.random.seed``.  TPU-native:
+the :class:`mxnet_tpu.resource.ResourceManager` owns one threefry key
+stream per context; every random op call draws a fresh subkey from the
+current context's ``kRandom`` resource (functional, reproducible,
+parallel-safe — SURVEY.md §7.3 "RNG parity").
 """
 from __future__ import annotations
 
 import threading
 
-import jax
-
 __all__ = ["seed", "next_key", "current_key"]
 
-_lock = threading.Lock()
-_key = None
+# kRandom resources are long-lived handles on the per-context stream, so we
+# cache one per context and pay a single lock on the hot path (op dispatch
+# draws a key per random op — executor/fused/cached_op/ndarray sites).
+_res_lock = threading.Lock()
+_res_cache = {}
+
+
+def _manager():
+    from . import resource as _resource
+    return _resource.ResourceManager.get()
 
 
 def seed(seed_state: int, ctx=None):
-    """Seed the global generator (parity: mxnet.random.seed)."""
-    global _key
-    with _lock:
-        _key = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
+    """Seed RNG generators (parity: mxnet.random.seed).
+
+    With no ``ctx`` every context's generator is reseeded from the global
+    seed (resource.cc SeedRandom); with ``ctx`` only that device's stream
+    is reseeded (reference per-device seeding).
+    """
+    _manager().seed(int(seed_state), ctx)
+
+
+def _krandom_resource():
+    from . import resource as _resource
+    from . import context as _context
+    ctx = _context.current_context()
+    key = (ctx.device_typeid, ctx.device_id)
+    with _res_lock:
+        res = _res_cache.get(key)
+        if res is None:
+            res = _manager().request(ctx, _resource.ResourceRequest(
+                _resource.ResourceRequest.kRandom))
+            _res_cache[key] = res
+        return res
 
 
 def next_key():
-    """Split and return a fresh subkey for one random-op call."""
-    global _key
-    with _lock:
-        if _key is None:
-            _key = jax.random.PRNGKey(0)
-        _key, sub = jax.random.split(_key)
-        return sub
+    """Split and return a fresh subkey for one random-op call, drawn from
+    the current context's kRandom resource."""
+    return _krandom_resource().get_random()
 
 
 def current_key():
-    global _key
-    with _lock:
-        if _key is None:
-            _key = jax.random.PRNGKey(0)
-        return _key
+    """Peek the current context's stream head without consuming a key
+    (stable: two consecutive peeks return the same key)."""
+    return _krandom_resource().peek_random()
 
 
 # re-exported sampling functions are generated into mxnet_tpu.ndarray.random

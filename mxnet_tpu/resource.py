@@ -1,0 +1,251 @@
+"""Per-context operator resources: RNG streams and temp workspace.
+
+Reference analog: the resource manager (``src/resource.cc``,
+``include/mxnet/resource.h:42-46``) — per-device pools of op-requested
+resources selected by ``ResourceRequest::Type``:
+
+- ``kRandom``: per-device random generator, reseeded by ``mx.random.seed``
+  (reference seeds every device generator from the global seed,
+  ``resource.cc`` ``SeedRandom``).
+- ``kTempSpace``: a dynamic scratch buffer of arbitrary size; the reference
+  keeps ``MXNET_*_TEMP_COPIES`` rotating slots per device, shared between
+  ops because its dependency engine serializes every user of a slot
+  (``resource.h`` ``get_space`` contract).  Here slots are exclusive per
+  granted Resource (host threads have no engine serializer) and reclaimed
+  when the Resource is collected.
+- ``kParallelRandom``: per-thread generator states usable inside kernels
+  (``src/common/random_generator.h:45-97``).
+
+TPU-native design: device-side temp space is owned by XLA's memory planner
+(SURVEY.md §7.1 — PlanMemory is delegated), so ``kTempSpace`` here manages
+*host* staging buffers (IO batch assembly, custom-op scratch) with the
+reference's rotating-slot semantics.  RNG is functional threefry: a
+``kRandom`` resource is a per-context key stream derived from the global
+seed and the device id, and ``kParallelRandom`` returns keys the caller
+``fold_in``s per lane — the functional analog of per-thread generator
+states.  ``mxnet_tpu.random`` (the ``mx.random.seed`` UX) draws from this
+manager's default-context stream, so every random op in the framework rides
+these resources.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from . import context as _context
+
+__all__ = ["ResourceRequest", "Resource", "ResourceManager"]
+
+
+class ResourceRequest:
+    """The resource kinds an operator can request (resource.h:42-46)."""
+
+    kRandom = 0
+    kTempSpace = 1
+    kParallelRandom = 2
+
+    _NAMES = {0: "kRandom", 1: "kTempSpace", 2: "kParallelRandom"}
+
+    def __init__(self, type):  # noqa: A002 - reference field name
+        if type not in self._NAMES:
+            raise ValueError("unknown ResourceRequest type %r" % (type,))
+        self.type = type
+
+    def __repr__(self):
+        return "ResourceRequest(%s)" % self._NAMES[self.type]
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceRequest) and other.type == self.type
+
+    def __hash__(self):
+        return hash(("ResourceRequest", self.type))
+
+
+class _CtxState:
+    """Per-context resource state: one key stream + temp-space slots.
+
+    Temp-space slots are *exclusive* per granted Resource and reclaimed when
+    the Resource is garbage-collected.  (The reference rotates
+    ``MXNET_*_TEMP_COPIES`` shared slots because its dependency engine
+    serializes every user of a slot — ``resource.cc``; host threads here
+    have no such serializer, so sharing a slot between two independent
+    resources would let concurrent producers corrupt each other's staging.)
+    """
+
+    def __init__(self, ctx: _context.Context, base_seed: int):
+        self.ctx = ctx
+        self.lock = threading.Lock()
+        self.reseed(base_seed)
+        # exclusive temp-space slots: slot id -> np buffer
+        self._spaces: Dict[int, np.ndarray] = {}
+        self._next_slot = 0
+        self.space_reuses = 0
+        self.space_allocs = 0
+
+    def reseed(self, base_seed: int):
+        # per-device stream: global seed folded with a stable device tag,
+        # mirroring resource.cc seeding every device generator from the
+        # global seed (distinct devices get distinct, reproducible streams)
+        key = jax.random.PRNGKey(base_seed & 0x7FFFFFFF)
+        folded = jax.random.fold_in(
+            key, (self.ctx.device_typeid << 10) | self.ctx.device_id)
+        with self.lock:
+            self._key = folded
+
+    def next_key(self):
+        with self.lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def peek_key(self):
+        with self.lock:
+            return self._key
+
+    def take_slot(self) -> int:
+        with self.lock:
+            slot = self._next_slot
+            self._next_slot += 1
+            return slot
+
+    def release_slot(self, slot: int):
+        # called from Resource.__del__, which cyclic GC may run on a thread
+        # already inside a `with self.lock` block — dict.pop is GIL-atomic,
+        # so stay lockless here to keep the finalizer deadlock-free
+        self._spaces.pop(slot, None)
+
+    def get_space(self, slot: int, shape, dtype) -> np.ndarray:
+        """Scratch ndarray for one slot; grown monotonically, reused when it
+        fits.  Callers serialize their own use of a slot (reference
+        ``get_space`` contract: shared space, caller serializes)."""
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        with self.lock:
+            buf = self._spaces.get(slot)
+            if buf is None or buf.nbytes < nbytes:
+                buf = np.empty((max(nbytes, 1),), np.uint8)
+                self._spaces[slot] = buf
+                self.space_allocs += 1
+            else:
+                self.space_reuses += 1
+        return buf[:nbytes].view(dtype).reshape(shape)
+
+
+class Resource:
+    """One granted resource (resource.h ``struct Resource``)."""
+
+    def __init__(self, req: ResourceRequest, state: _CtxState, rid: int):
+        self.req = req
+        self.id = rid
+        self._state = state
+
+    def __del__(self):
+        # exclusive temp-space slots are reclaimed with their Resource
+        try:
+            if self.req.type == ResourceRequest.kTempSpace:
+                self._state.release_slot(self.id)
+        except Exception:
+            pass  # interpreter shutdown
+
+    @property
+    def ctx(self):
+        return self._state.ctx
+
+    # ---- kRandom --------------------------------------------------------
+    def get_random(self):
+        """A fresh threefry subkey from this context's seeded stream
+        (reference: ``get_random`` returns the per-device generator)."""
+        if self.req.type != ResourceRequest.kRandom:
+            raise TypeError("resource is %r, not kRandom" % (self.req,))
+        return self._state.next_key()
+
+    def peek_random(self):
+        """The stream head without consuming a key (stable between draws)."""
+        if self.req.type != ResourceRequest.kRandom:
+            raise TypeError("resource is %r, not kRandom" % (self.req,))
+        return self._state.peek_key()
+
+    # ---- kParallelRandom ------------------------------------------------
+    def get_parallel_random(self):
+        """A base key to ``jax.random.fold_in`` per lane/thread — the
+        functional analog of per-thread generator states
+        (random_generator.h:45-97)."""
+        if self.req.type != ResourceRequest.kParallelRandom:
+            raise TypeError("resource is %r, not kParallelRandom" % (self.req,))
+        return self._state.next_key()
+
+    # ---- kTempSpace -----------------------------------------------------
+    def get_space(self, shape, dtype=np.float32) -> np.ndarray:
+        """Host scratch tensor of the requested shape.  The slot's buffer is
+        reused across calls when it fits and grows otherwise; concurrent
+        users of the *same* Resource must serialize (reference contract)."""
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise TypeError("resource is %r, not kTempSpace" % (self.req,))
+        return self._state.get_space(self.id, shape, dtype)
+
+
+class ResourceManager:
+    """Singleton granting per-context resources (``ResourceManager::Get``)."""
+
+    _instance: Optional["ResourceManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: Dict[Tuple[int, int], _CtxState] = {}
+        self._seed = 0
+
+    @classmethod
+    def get(cls) -> "ResourceManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def _state_for(self, ctx: _context.Context) -> _CtxState:
+        key = (ctx.device_typeid, ctx.device_id)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = _CtxState(ctx, self._seed)
+                self._states[key] = st
+            return st
+
+    def request(self, ctx: Optional[_context.Context],
+                req: ResourceRequest) -> Resource:
+        """Grant a resource on ``ctx`` (default: current context)."""
+        if isinstance(req, int):
+            req = ResourceRequest(req)
+        ctx = ctx or _context.current_context()
+        st = self._state_for(ctx)
+        rid = st.take_slot() if req.type == ResourceRequest.kTempSpace else 0
+        return Resource(req, st, rid)
+
+    def seed(self, seed_state: int, ctx: Optional[_context.Context] = None):
+        """Reseed RNG streams from a seed (``mx.random.seed`` semantics).
+
+        ``ctx=None`` reseeds every context from the global seed (resource.cc
+        SeedRandom); a specific ``ctx`` reseeds only that device's stream
+        (reference ``mx.random.seed(s, ctx=...)`` per-device seeding).
+        """
+        s = int(seed_state) & 0x7FFFFFFF
+        if ctx is not None:
+            self._state_for(ctx).reseed(s)
+            return
+        with self._lock:
+            self._seed = s
+            states = list(self._states.values())
+        for st in states:
+            st.reseed(s)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-context temp-space pool counters (debug/observability)."""
+        with self._lock:
+            return {
+                repr(st.ctx): {"space_allocs": st.space_allocs,
+                               "space_reuses": st.space_reuses,
+                               "live_slots": len(st._spaces)}
+                for st in self._states.values()
+            }

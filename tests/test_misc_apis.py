@@ -278,12 +278,25 @@ def test_storage_manager_surface():
         storage.apply_pool_env(env2)
     assert env2["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.33"
 
-    # live-array census sees a new allocation
-    c0, b0 = storage.live_arrays()
-    keep = mx.nd.array(np.ones((64, 64), np.float32))
-    keep.wait_to_read()
-    c1, b1 = storage.live_arrays()
-    assert c1 >= c0 + 1 and b1 >= b0 + 64 * 64 * 4
+    # live-array census sees a new allocation.  The census is a point-in-time
+    # count over every live jax array in the process; unrelated arrays can be
+    # collected between the two samples (prior tests' prefetch threads, RNG
+    # key churn), so retry the delta a few times rather than demand one
+    # window be quiescent.
+    import gc
+    keep = None
+    for attempt in range(3):
+        keep = None        # drop the prior attempt's array before sampling c0
+        gc.collect()
+        c0, b0 = storage.live_arrays()
+        keep = mx.nd.array(np.ones((64, 64), np.float32))
+        keep.wait_to_read()
+        c1, b1 = storage.live_arrays()
+        if c1 >= c0 + 1 and b1 >= b0 + 64 * 64 * 4:
+            break
+    else:
+        raise AssertionError("census never saw the allocation: "
+                             "%d->%d arrays, %d->%d bytes" % (c0, c1, b0, b1))
 
     # memory_info returns (free, total); CPU backends report (0, 0)
     free, total = storage.memory_info()
