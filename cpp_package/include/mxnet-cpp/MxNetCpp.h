@@ -1,0 +1,10 @@
+// Umbrella header of the C++ frontend (reference parity:
+// cpp-package/include/mxnet-cpp/MxNetCpp.h).  Header-only over the C API
+// waist (include/mxnet_tpu/c_api.h, libmxnet_tpu_c.so).
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include "ndarray.hpp"
+#include "operator.hpp"
+
+#endif  // MXNET_CPP_MXNETCPP_H_

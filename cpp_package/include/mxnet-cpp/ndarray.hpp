@@ -1,0 +1,183 @@
+// Header-only C++ frontend: NDArray (reference parity: cpp-package/
+// include/mxnet-cpp/ndarray.h — the RAII array riding the C API waist,
+// SURVEY.md §2.4).
+#ifndef MXNET_CPP_NDARRAY_HPP_
+#define MXNET_CPP_NDARRAY_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    throw std::runtime_error(MXGetLastError());
+  }
+}
+
+struct Context {
+  int dev_type;
+  int dev_id;
+  Context(int type, int id) : dev_type(type), dev_id(id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }
+  static Context tpu(int id = 0) { return Context(4, id); }
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  // Takes ownership of a raw handle (e.g. an op output).
+  explicit NDArray(NDArrayHandle handle)
+      : handle_(handle, &NDArray::Release) {}
+
+  NDArray(const std::vector<mx_uint> &shape, const Context &ctx,
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()), ctx.dev_type,
+                            ctx.dev_id, 0, dtype, &h));
+    handle_.reset(h, &NDArray::Release);
+  }
+
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          const Context &ctx)
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  NDArrayHandle GetHandle() const { return handle_.get(); }
+  bool IsNone() const { return handle_ == nullptr; }
+
+  void SyncCopyFromCPU(const float *data, size_t size) {
+    Check(MXNDArraySyncCopyFromCPU(handle_.get(), data, size));
+  }
+
+  void SyncCopyToCPU(float *data, size_t size) const {
+    Check(MXNDArraySyncCopyToCPU(handle_.get(), data, size));
+  }
+
+  std::vector<float> CopyToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+
+  std::vector<mx_uint> GetShape() const {
+    mx_uint dim = 0;
+    const mx_uint *pdata = nullptr;
+    Check(MXNDArrayGetShape(handle_.get(), &dim, &pdata));
+    return std::vector<mx_uint>(pdata, pdata + dim);
+  }
+
+  int GetDType() const {
+    int dtype = -1;
+    Check(MXNDArrayGetDType(handle_.get(), &dtype));
+    return dtype;
+  }
+
+  Context GetContext() const {
+    int t = 0, id = 0;
+    Check(MXNDArrayGetContext(handle_.get(), &t, &id));
+    return Context(t, id);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : GetShape()) n *= d;
+    return n;
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle_.get())); }
+
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArraySlice(handle_.get(), begin, end, &h));
+    return NDArray(h);
+  }
+
+  NDArray Reshape(const std::vector<int> &dims) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayReshape(handle_.get(),
+                           static_cast<int>(dims.size()),
+                           const_cast<int *>(dims.data()), &h));
+    return NDArray(h);
+  }
+
+  // autograd surface (gluon-style imperative training from C++)
+  void AttachGrad() {
+    NDArrayHandle h = handle_.get();
+    Check(MXAutogradMarkVariables(1, &h));
+  }
+
+  NDArray Grad() const {
+    NDArrayHandle g = nullptr;
+    Check(MXNDArrayGetGrad(handle_.get(), &g));
+    return NDArray(g);
+  }
+
+  void Backward(bool retain_graph = false) const {
+    NDArrayHandle h = handle_.get();
+    Check(MXAutogradBackward(1, &h, retain_graph ? 1 : 0));
+  }
+
+  static void Save(const std::string &fname,
+                   const std::vector<NDArray> &arrays,
+                   const std::vector<std::string> &names) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char *> keys;
+    for (const auto &a : arrays) handles.push_back(a.GetHandle());
+    for (const auto &n : names) keys.push_back(n.c_str());
+    Check(MXNDArraySave(fname.c_str(),
+                        static_cast<mx_uint>(handles.size()), handles.data(),
+                        names.empty() ? nullptr : keys.data()));
+  }
+
+  static void Load(const std::string &fname, std::vector<NDArray> *arrays,
+                   std::vector<std::string> *names) {
+    mx_uint n = 0, nn = 0;
+    NDArrayHandle *harr = nullptr;
+    const char **hnames = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &harr, &nn, &hnames));
+    arrays->clear();
+    for (mx_uint i = 0; i < n; ++i) arrays->emplace_back(harr[i]);
+    if (names != nullptr) {
+      names->assign(hnames, hnames + nn);
+    }
+  }
+
+ private:
+  static void Release(NDArrayHandle h) {
+    if (h != nullptr) MXNDArrayFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+// RAII autograd recording scope (mxnet::cpp analog of autograd.record()).
+class AutogradRecord {
+ public:
+  explicit AutogradRecord(bool train_mode = true) {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    Check(MXAutogradSetIsTraining(train_mode ? 1 : 0, &prev_train_));
+  }
+  ~AutogradRecord() {
+    MXAutogradSetIsRecording(prev_rec_, nullptr);
+    MXAutogradSetIsTraining(prev_train_, nullptr);
+  }
+
+ private:
+  int prev_rec_ = 0;
+  int prev_train_ = 0;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_NDARRAY_HPP_

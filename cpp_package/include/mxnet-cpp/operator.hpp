@@ -1,0 +1,86 @@
+// Header-only C++ frontend: Operator builder (reference parity:
+// cpp-package/include/mxnet-cpp/operator.h — Operator("Conv")
+// .SetParam(...).SetInput(...).Invoke() riding MXImperativeInvoke).
+#ifndef MXNET_CPP_OPERATOR_HPP_
+#define MXNET_CPP_OPERATOR_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxnet {
+namespace cpp {
+
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_name_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+    return *this;
+  }
+
+  Operator &SetInput(const NDArray &array) {
+    inputs_.push_back(array.GetHandle());
+    return *this;
+  }
+
+  Operator &operator()(const NDArray &array) { return SetInput(array); }
+
+  // Write results into an existing array (the ABI's out= contract — how
+  // sgd_update(w, g, out=w) updates a parameter in place).
+  Operator &SetOutput(const NDArray &array) {
+    outputs_.push_back(array.GetHandle());
+    return *this;
+  }
+
+  // Run the op; returns all (allocated) outputs, or the supplied outputs.
+  std::vector<NDArray> InvokeMulti() {
+    std::vector<const char *> k, v;
+    for (auto &s : keys_) k.push_back(s.c_str());
+    for (auto &s : vals_) v.push_back(s.c_str());
+    int num_outputs = static_cast<int>(outputs_.size());
+    NDArrayHandle *outputs = outputs_.empty() ? nullptr : outputs_.data();
+    Check(MXImperativeInvokeByName(
+        op_name_.c_str(), static_cast<int>(inputs_.size()), inputs_.data(),
+        &num_outputs, &outputs, static_cast<int>(k.size()), k.data(),
+        v.data()));
+    std::vector<NDArray> out;
+    if (!outputs_.empty()) return out;  // results landed in SetOutput arrays
+    out.reserve(num_outputs);
+    for (int i = 0; i < num_outputs; ++i) out.emplace_back(outputs[i]);
+    return out;
+  }
+
+  void Invoke(const NDArray &out) {
+    SetOutput(out);
+    InvokeMulti();
+  }
+
+  NDArray Invoke() { return InvokeMulti().at(0); }
+
+  static std::vector<std::string> ListAll() {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    Check(MXListAllOpNames(&n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+ private:
+  std::string op_name_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<NDArrayHandle> inputs_, outputs_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_OPERATOR_HPP_

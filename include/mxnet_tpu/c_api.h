@@ -1,0 +1,110 @@
+/* C API waist of the TPU-native runtime.
+ *
+ * Reference parity: include/mxnet/c_api.h (Parts 0-2: global state, NDArray
+ * CRUD, op listing + imperative invoke + autograd) and c_predict_api.h (the
+ * inference ABI, exported by libmxnet_tpu_predict.so).  Every function
+ * returns 0 on success, -1 on failure with the message readable via
+ * MXGetLastError() (thread-local, per library).
+ *
+ * Implemented by src/c_api.cc -> libmxnet_tpu_c.so.  The library embeds
+ * CPython and drives the XLA runtime through mxnet_tpu._capi_bridge; host
+ * processes must have mxnet_tpu importable (PYTHONPATH or installed).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint32_t mx_uint;
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+#ifndef MXNET_DLL
+#define MXNET_DLL
+#endif
+
+/* ---- Part 0: global state ---------------------------------------------- */
+MXNET_DLL const char *MXGetLastError(void);
+MXNET_DLL int MXGetVersion(int *out);
+MXNET_DLL int MXRandomSeed(int seed);
+MXNET_DLL int MXNDArrayWaitAll(void);
+MXNET_DLL int MXEngineWaitAll(void);
+MXNET_DLL int MXNotifyShutdown(void);
+
+/* ---- Part 1: NDArray ---------------------------------------------------- */
+/* dev_type: 1=cpu 2=gpu 3=cpu_pinned 4=tpu (Context enum).
+ * dtype codes: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64 12=bf16. */
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+/* size is an element count (reference contract). */
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size);
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                             NDArrayHandle *out);
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                               NDArrayHandle *out);
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+/* Returned handle array + name pointers live until the next Load on the
+ * calling thread; handles themselves are caller-owned (free each). */
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* ---- Part 2: ops + imperative invoke + autograd ------------------------- */
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out_array);
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
+/* Output handle array lives until the next invoke on the calling thread;
+ * handles are caller-owned. */
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+/* TPU-native convenience: invoke by op name (the reference reaches the same
+ * path through NNVM Op::Get). */
+MXNET_DLL int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                                       NDArrayHandle *inputs,
+                                       int *num_outputs,
+                                       NDArrayHandle **outputs,
+                                       int num_params, const char **param_keys,
+                                       const char **param_vals);
+
+MXNET_DLL int MXAutogradSetIsRecording(int is_recording, int *prev);
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int *prev);
+/* grad_req is 'write' for every variable (the common case; the reference's
+ * per-variable req array is a documented simplification here). */
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles);
+MXNET_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 int retain_graph);
+MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

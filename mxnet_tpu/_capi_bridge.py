@@ -1,0 +1,205 @@
+"""Python side of the C API waist (SURVEY.md N17).
+
+Reference analog: ``src/c_api/c_api.cc`` + ``c_api_ndarray.cc`` — the
+C ABI's NDArray CRUD, imperative invoke, and op listing (Parts 0-2 of
+``include/mxnet/c_api.h``).  ``src/c_api.cc`` embeds CPython (the same
+pattern as the predict ABI, ``src/predict.cc``) and calls these functions;
+each takes/returns only simple Python types + NDArray objects so the C
+marshalling stays mechanical.
+
+Reference dtype codes (``include/mxnet/tensor_blob.h`` / mshadow type
+flags): 0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64;
+12=bfloat16 is carried as the TPU-native extension.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from . import context as _context
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ops import registry as _registry
+
+_CODE2DT = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+            4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
+_DT2CODE = {v: k for k, v in _CODE2DT.items()}
+
+
+def _ctx(dev_type: int, dev_id: int) -> _context.Context:
+    name = _context.Context.devtype2str.get(int(dev_type))
+    if name is None:
+        raise MXNetError("unknown device type id %d" % dev_type)
+    return _context.Context(name, int(dev_id))
+
+
+def _np_dtype(code: int) -> np.dtype:
+    try:
+        name = _CODE2DT[int(code)]
+    except KeyError:
+        raise MXNetError("unknown dtype code %d" % code)
+    if name == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def create(shape: Sequence[int], dev_type: int, dev_id: int,
+           dtype_code: int = 0, delay_alloc: int = 0) -> NDArray:
+    """MXNDArrayCreate/CreateEx: an initialized (zero) array on a device.
+    XLA has no uninitialized-alloc notion, so delay_alloc is accepted and
+    ignored (allocation is lazy inside jax anyway)."""
+    return nd.zeros(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_np_dtype(dtype_code))
+
+
+def copy_from_ptr(addr: int, size: int, handle: NDArray):
+    """MXNDArraySyncCopyFromCPU: overwrite the handle's contents *in place*
+    from a flat host buffer of ``size`` elements (reference contract:
+    CHECK size == array size; the handle object keeps its identity so
+    autograd marking and aliases survive)."""
+    import ctypes
+    if int(size) != handle.size:
+        raise MXNetError("SyncCopyFromCPU: %d elements given, array has %d"
+                         % (size, handle.size))
+    nbytes = handle.size * np.dtype(handle.dtype).itemsize
+    buf = (ctypes.c_ubyte * nbytes).from_address(int(addr))
+    arr = np.frombuffer(buf, dtype=handle.dtype).reshape(handle.shape)
+    # nd.array's astype copy materializes before the ctypes view dies
+    handle._data = nd.array(arr, ctx=handle.context,
+                            dtype=handle.dtype)._data
+
+
+def copy_to_ptr(addr: int, size: int, handle: NDArray):
+    """MXNDArraySyncCopyToCPU: write the array into a caller buffer of
+    ``size`` elements (reference contract: CHECK size == array size — a
+    short buffer must error, never overrun)."""
+    import ctypes
+    if int(size) != handle.size:
+        raise MXNetError("SyncCopyToCPU: buffer holds %d elements, array "
+                         "has %d" % (size, handle.size))
+    src = np.ascontiguousarray(handle.asnumpy())
+    ctypes.memmove(int(addr), src.ctypes.data, src.nbytes)
+
+
+def shape_of(handle: NDArray) -> Tuple[int, ...]:
+    return tuple(int(s) for s in handle.shape)
+
+
+def dtype_code_of(handle: NDArray) -> int:
+    name = np.dtype(handle.dtype).name   # 'bfloat16' via ml_dtypes
+    code = _DT2CODE.get(name)
+    if code is None:
+        raise MXNetError("dtype %r has no reference code" % (name,))
+    return code
+
+
+def ctx_of(handle: NDArray) -> Tuple[int, int]:
+    c = handle.context
+    return int(c.device_typeid), int(c.device_id)
+
+
+def wait_to_read(handle: NDArray):
+    handle.wait_to_read()
+
+
+def slice_(handle: NDArray, begin: int, end: int) -> NDArray:
+    return handle[int(begin):int(end)]
+
+
+def reshape(handle: NDArray, dims: Sequence[int]) -> NDArray:
+    return handle.reshape(tuple(int(d) for d in dims))
+
+
+def invoke(op_name: str, inputs: Sequence[NDArray],
+           param_keys: Sequence[str], param_vals: Sequence[str],
+           outs: Sequence[NDArray] = ()) -> List[NDArray]:
+    """MXImperativeInvoke: run one registered operator on NDArray inputs
+    with string-typed attrs (the reference passes every attr as a string;
+    param.coerce parses them exactly like dmlc::Parameter).  Pre-supplied
+    ``outs`` receive the results in place (the reference's non-NULL
+    *outputs contract — how ``sgd_update(w, g, out=w)`` works over the
+    ABI)."""
+    from .ndarray.ndarray import invoke as _invoke
+    kwargs: Dict[str, str] = dict(zip(param_keys, param_vals))
+    out_arg = list(outs) if outs else None
+    out = _invoke(op_name, list(inputs), kwargs, out=out_arg)
+    if isinstance(out, NDArray):
+        return [out]
+    return list(out)
+
+
+def list_ops() -> List[str]:
+    """MXListAllOpNames."""
+    return _registry.list_ops()
+
+
+def save(fname: str, handles: Sequence[NDArray],
+         keys: Sequence[str]):
+    """MXNDArraySave (named dict when keys given, list format otherwise)."""
+    if keys:
+        nd.save(fname, dict(zip(keys, handles)))
+    else:
+        nd.save(fname, list(handles))
+
+
+def load(fname: str) -> Tuple[List[NDArray], List[str]]:
+    """MXNDArrayLoad -> (arrays, names); names empty for list format."""
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = sorted(data)
+        return [data[k] for k in names], list(names)
+    return list(data), []
+
+
+def wait_all():
+    """MXNDArrayWaitAll/MXEngineWaitAll."""
+    import jax
+    from . import engine as _engine
+    _engine.get().wait_for_all()
+    jax.effects_barrier()
+
+
+def random_seed(seed: int):
+    """MXRandomSeed."""
+    from . import random as _random
+    _random.seed(int(seed))
+
+
+def version() -> int:
+    """MXGetVersion — reference-era version code (1.2.0 -> 10200)."""
+    return 10200
+
+
+# ---- autograd (c_api.h Part 2: MXAutograd*) -------------------------------
+
+def autograd_set_recording(flag: int) -> int:
+    from . import autograd as _ag
+    return int(_ag.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from . import autograd as _ag
+    return int(_ag.set_training(bool(flag)))
+
+
+def autograd_mark_variables(handles: Sequence[NDArray]):
+    """MXAutogradMarkVariables (grad_req='write'; gradient storage is
+    allocated by attach_grad, read back via get_grad)."""
+    for h in handles:
+        h.attach_grad()
+
+
+def autograd_backward(heads: Sequence[NDArray], retain_graph: int):
+    from . import autograd as _ag
+    _ag.backward(list(heads), retain_graph=bool(retain_graph))
+
+
+def get_grad(handle: NDArray) -> NDArray:
+    """MXNDArrayGetGrad: the gradient buffer attached to a variable."""
+    g = handle.grad
+    if g is None:
+        raise MXNetError("array has no gradient (call mark_variables first)")
+    return g

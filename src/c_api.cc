@@ -1,0 +1,528 @@
+// C API waist — NDArray CRUD + imperative invoke + op listing
+// (reference parity: include/mxnet/c_api.h Parts 0-2 — MXGetLastError,
+// MXNDArrayCreate*/Free/GetShape/GetDType/SyncCopy*/WaitToRead/WaitAll/
+// Slice/Reshape/GetContext/Save/Load, MXListAllOpNames,
+// MXSymbolListAtomicSymbolCreators + MXImperativeInvoke; src/c_api/c_api.cc
+// and c_api_ndarray.cc in the reference tree — SURVEY.md N17).
+//
+// Same architecture as the predict ABI (src/predict.cc): the TPU-native
+// runtime's compute path is the Python-built XLA plan, so this library
+// embeds CPython and marshals through mxnet_tpu._capi_bridge, which takes
+// and returns only simple types.  From the caller's side the contract
+// matches the reference: opaque NDArrayHandle, flat host buffers, string
+// attrs, thread-local error strings, 0/-1 return codes.
+//
+// Build: make libmxnet_tpu_c.so (links libpython).  Host processes must
+// have mxnet_tpu importable (PYTHONPATH or installed).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "py_embed.h"
+
+typedef uint32_t mx_uint;
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+#define MXNET_DLL extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+using py_embed::EnsurePython;
+using py_embed::g_last_error;
+using py_embed::GILGuard;
+using py_embed::SetError;
+using py_embed::SetPyError;
+
+// An NDArrayHandle: owns one bridge NDArray + scratch the shape pointer
+// handed to callers stays valid in (reference MXAPIThreadLocalEntry role,
+// but per-handle so concurrent handles don't stomp each other).
+struct ND {
+  PyObject *obj = nullptr;
+  std::vector<mx_uint> shape_scratch;
+  ~ND() {
+    if (obj != nullptr) {
+      GILGuard gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+// Call mxnet_tpu._capi_bridge.<fn>(*args).  Steals `args` (a tuple).
+// Returns a new reference or nullptr with g_last_error set.
+PyObject *CallBridge(const char *fn, PyObject *args) {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu._capi_bridge");
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    SetPyError("cannot import mxnet_tpu._capi_bridge");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    SetPyError(fn);
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) SetPyError(fn);
+  return out;
+}
+
+// Wrap a bridge NDArray (new reference, stolen) into a fresh handle.
+NDArrayHandle WrapND(PyObject *obj) {
+  ND *h = new ND();
+  h->obj = obj;
+  return static_cast<NDArrayHandle>(h);
+}
+
+PyObject *ObjOf(NDArrayHandle handle) {
+  return static_cast<ND *>(handle)->obj;
+}
+
+PyObject *UIntTuple(const mx_uint *data, mx_uint n) {
+  PyObject *tup = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(data[i]));
+  }
+  return tup;
+}
+
+bool FillShapeScratch(ND *h) {
+  PyObject *shp = CallBridge("shape_of",
+                             Py_BuildValue("(O)", h->obj));
+  if (shp == nullptr) return false;
+  h->shape_scratch.clear();
+  Py_ssize_t n = PyTuple_Size(shp);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape_scratch.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i))));
+  }
+  Py_DECREF(shp);
+  return !PyErr_Occurred();
+}
+
+// Interned op-name table backing AtomicSymbolCreator values.  A failed
+// first load is retried on the next call (transient import errors must not
+// wedge the process), and the failure message is set per failing call so
+// every thread sees it in its MXGetLastError.
+std::vector<std::string> *OpNameTable() {
+  static std::mutex mu;
+  static std::vector<std::string> table;
+  static bool ok = false;
+  std::lock_guard<std::mutex> lock(mu);
+  if (!ok) {
+    GILGuard gil;
+    PyObject *names = CallBridge("list_ops", PyTuple_New(0));
+    if (names == nullptr) return nullptr;   // error set by CallBridge
+    Py_ssize_t n = PyList_Size(names);
+    table.clear();
+    table.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      table.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+    }
+    Py_DECREF(names);
+    ok = true;
+  }
+  return &table;
+}
+
+}  // namespace
+
+// ---- Part 0: global state -------------------------------------------------
+
+MXNET_DLL const char *MXGetLastError() { return g_last_error.c_str(); }
+
+MXNET_DLL int MXGetVersion(int *out) {
+  *out = 10200;  // reference-era version code (1.2.0)
+  return 0;
+}
+
+MXNET_DLL int MXRandomSeed(int seed) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayWaitAll() {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("wait_all", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXEngineWaitAll() { return MXNDArrayWaitAll(); }
+
+MXNET_DLL int MXNotifyShutdown() { return MXNDArrayWaitAll(); }
+
+// ---- Part 1: NDArray ------------------------------------------------------
+
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *shp = UIntTuple(shape, ndim);
+  PyObject *obj = CallBridge("create", Py_BuildValue(
+      "(Niiii)", shp, dev_type, dev_id, dtype, delay_alloc));
+  if (obj == nullptr) return -1;
+  *out = WrapND(obj);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           0 /*float32*/, out);
+}
+
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out) {
+  mx_uint shape[1] = {0};
+  return MXNDArrayCreate(shape, 1, 1 /*cpu*/, 0, 0, out);
+}
+
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle) {
+  delete static_cast<ND *>(handle);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  ND *h = static_cast<ND *>(handle);
+  if (!FillShapeScratch(h)) return -1;
+  *out_dim = static_cast<mx_uint>(h->shape_scratch.size());
+  *out_pdata = h->shape_scratch.data();
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("dtype_code_of",
+                           Py_BuildValue("(O)", ObjOf(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("ctx_of", Py_BuildValue("(O)", ObjOf(handle)));
+  if (r == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+// size is an element count (reference contract, CHECKed equal to the
+// array's size on the bridge side); the bridge reads/writes the caller's
+// buffer directly through the pointer, deriving bytes from the handle's
+// dtype — no itemsize table to keep in sync here.
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("copy_from_ptr", Py_BuildValue(
+      "(KKO)", static_cast<unsigned long long>(
+                   reinterpret_cast<uintptr_t>(data)),
+      static_cast<unsigned long long>(size), ObjOf(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("copy_to_ptr", Py_BuildValue(
+      "(KKO)", static_cast<unsigned long long>(
+                   reinterpret_cast<uintptr_t>(data)),
+      static_cast<unsigned long long>(size), ObjOf(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("wait_to_read",
+                           Py_BuildValue("(O)", ObjOf(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                             NDArrayHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *obj = CallBridge("slice_", Py_BuildValue(
+      "(OII)", ObjOf(handle), begin, end));
+  if (obj == nullptr) return -1;
+  *out = WrapND(obj);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                               NDArrayHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *tup = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(tup, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *obj = CallBridge("reshape", Py_BuildValue(
+      "(ON)", ObjOf(handle), tup));
+  if (obj == nullptr) return -1;
+  *out = WrapND(obj);
+  return 0;
+}
+
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *handles = PyList_New(num_args);
+  PyObject *names = PyList_New(keys ? num_args : 0);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *o = ObjOf(args[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(handles, i, o);
+    if (keys) {
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+    }
+  }
+  PyObject *r = CallBridge("save", Py_BuildValue("(sNN)", fname,
+                                                 handles, names));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("load", Py_BuildValue("(s)", fname));
+  if (r == nullptr) return -1;
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *names = PyTuple_GetItem(r, 1);
+  // thread-local return scratch (reference MXAPIThreadLocalEntry): the
+  // handle array + name pointers stay valid until the next Load on this
+  // thread; the handles themselves are caller-owned (caller frees each).
+  static thread_local std::vector<NDArrayHandle> ret_handles;
+  static thread_local std::vector<std::string> ret_names;
+  static thread_local std::vector<const char *> ret_name_ptrs;
+  ret_handles.clear();
+  ret_names.clear();
+  ret_name_ptrs.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);
+    ret_handles.push_back(WrapND(o));
+  }
+  Py_ssize_t nn = PyList_Size(names);
+  for (Py_ssize_t i = 0; i < nn; ++i) {
+    ret_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+  }
+  for (auto &s : ret_names) ret_name_ptrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(ret_handles.size());
+  *out_arr = ret_handles.data();
+  *out_name_size = static_cast<mx_uint>(ret_name_ptrs.size());
+  *out_names = ret_name_ptrs.data();
+  return 0;
+}
+
+// ---- Part 2: op listing + imperative invoke -------------------------------
+
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  auto *table = OpNameTable();
+  if (table == nullptr) { return -1; }
+  static thread_local std::vector<const char *> ptrs;
+  ptrs.clear();
+  for (auto &s : *table) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out_array) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  auto *table = OpNameTable();
+  if (table == nullptr) { return -1; }
+  static thread_local std::vector<AtomicSymbolCreator> creators;
+  creators.clear();
+  for (auto &s : *table) {
+    creators.push_back(const_cast<std::string *>(&s));
+  }
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name) {
+  *name = static_cast<std::string *>(creator)->c_str();
+  return 0;
+}
+
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  const std::string *op = static_cast<std::string *>(creator);
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = ObjOf(inputs[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  // Reference contract: a non-NULL *outputs is a caller-supplied array of
+  // existing handles the results are written into (out= semantics — how
+  // sgd_update(w, g, out=w) updates in place over the ABI).
+  bool has_outs = (*outputs != nullptr && *num_outputs > 0);
+  PyObject *outs = PyList_New(has_outs ? *num_outputs : 0);
+  if (has_outs) {
+    for (int i = 0; i < *num_outputs; ++i) {
+      PyObject *o = ObjOf((*outputs)[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(outs, i, o);
+    }
+  }
+  PyObject *r = CallBridge("invoke", Py_BuildValue(
+      "(sNNNN)", op->c_str(), ins, keys, vals, outs));
+  if (r == nullptr) return -1;
+  if (has_outs) {
+    Py_DECREF(r);   // results already written into the supplied handles
+    return 0;
+  }
+  static thread_local std::vector<NDArrayHandle> ret;
+  ret.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    ret.push_back(WrapND(o));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(ret.size());
+  *outputs = ret.data();
+  return 0;
+}
+
+// ---- Part 2b: autograd (MXAutograd* in the reference ABI) -----------------
+
+MXNET_DLL int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("autograd_set_recording",
+                           Py_BuildValue("(i)", is_recording));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int *prev) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("autograd_set_training",
+                           Py_BuildValue("(i)", is_training));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *vars = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyObject *o = ObjOf(var_handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(vars, i, o);
+  }
+  PyObject *r = CallBridge("autograd_mark_variables",
+                           Py_BuildValue("(N)", vars));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 int retain_graph) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *heads = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject *o = ObjOf(output_handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(heads, i, o);
+  }
+  PyObject *r = CallBridge("autograd_backward",
+                           Py_BuildValue("(Ni)", heads, retain_graph));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *obj = CallBridge("get_grad", Py_BuildValue("(O)", ObjOf(handle)));
+  if (obj == nullptr) return -1;
+  *out = WrapND(obj);
+  return 0;
+}
+
+// Convenience: invoke by op name directly (TPU-native addition so C callers
+// can skip the creator-table round trip; the reference reaches the same
+// code through NNVM's Op::Get).
+MXNET_DLL int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                                       NDArrayHandle *inputs,
+                                       int *num_outputs,
+                                       NDArrayHandle **outputs,
+                                       int num_params, const char **param_keys,
+                                       const char **param_vals) {
+  std::string name(op_name);
+  return MXImperativeInvoke(&name, num_inputs, inputs, num_outputs, outputs,
+                            num_params, param_keys, param_vals);
+}

@@ -14,6 +14,8 @@
 // must have mxnet_tpu importable (PYTHONPATH or installed).
 #include <Python.h>
 
+#include "py_embed.h"
+
 #include <cstdint>
 #include <cstring>
 #include <map>
@@ -29,58 +31,11 @@ typedef void *NDListHandle;
 
 namespace {
 
-thread_local std::string g_last_error;
-
-void SetError(const std::string &msg) { g_last_error = msg; }
-
-// Capture the pending Python exception into the error string.
-void SetPyError(const char *fallback) {
-  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
-  PyErr_Fetch(&type, &value, &trace);
-  PyErr_NormalizeException(&type, &value, &trace);
-  std::string msg = fallback;
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *utf8 = PyUnicode_AsUTF8(s);
-      if (utf8 != nullptr) msg = utf8;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(trace);
-  SetError(msg);
-}
-
-// One-time interpreter bring-up.  When the host process already runs
-// Python (e.g. tests loading this .so via ctypes) we piggyback on it.
-bool EnsurePython() {
-  static std::once_flag once;
-  static bool ok = false;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      PyConfig config;
-      PyConfig_InitPythonConfig(&config);
-      PyStatus status = Py_InitializeFromConfig(&config);
-      PyConfig_Clear(&config);
-      if (PyStatus_Exception(status)) {
-        return;  // ok stays false; callers surface the error
-      }
-      // Release the GIL acquired by Py_Initialize so PyGILState_Ensure
-      // works from any caller thread.
-      PyEval_SaveThread();
-    }
-    ok = true;
-  });
-  return ok;
-}
-
-struct GILGuard {
-  PyGILState_STATE state;
-  GILGuard() : state(PyGILState_Ensure()) {}
-  ~GILGuard() { PyGILState_Release(state); }
-};
+using py_embed::EnsurePython;
+using py_embed::g_last_error;
+using py_embed::GILGuard;
+using py_embed::SetError;
+using py_embed::SetPyError;
 
 struct Predictor {
   PyObject *obj = nullptr;                       // mxnet_tpu Predictor
@@ -143,14 +98,7 @@ bool ShapeOf(PyObject *obj, std::vector<mx_uint> *shape) {
   return !PyErr_Occurred();
 }
 
-// steal-nothing helper: import module attr, new reference.
-PyObject *GetAttr(const char *module, const char *attr) {
-  PyObject *mod = PyImport_ImportModule(module);
-  if (mod == nullptr) return nullptr;
-  PyObject *a = PyObject_GetAttrString(mod, attr);
-  Py_DECREF(mod);
-  return a;
-}
+using py_embed::GetAttr;
 
 // flat float32 buffer -> numpy array of `shape` (copy).
 PyObject *BufferToNumpy(const float *data, size_t size,

@@ -1,0 +1,80 @@
+// Shared CPython-embedding plumbing for the C ABI libraries
+// (libmxnet_tpu_predict.so, libmxnet_tpu_c.so): thread-local error strings,
+// interpreter bootstrap, GIL guard, import helper.  Each library gets its
+// own copy of the thread-local error state (reference semantics:
+// MXGetLastError is per-library, include/mxnet/c_api.h).
+#ifndef MXNET_TPU_SRC_PY_EMBED_H_
+#define MXNET_TPU_SRC_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace py_embed {
+
+inline thread_local std::string g_last_error;
+
+inline void SetError(const std::string &msg) { g_last_error = msg; }
+
+// Capture the pending Python exception into the error string.
+inline void SetPyError(const char *fallback) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = fallback;
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *utf8 = PyUnicode_AsUTF8(s);
+      if (utf8 != nullptr) msg = utf8;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  SetError(msg);
+}
+
+// One-time interpreter bring-up.  When the host process already runs
+// Python (e.g. tests loading the .so via ctypes) we piggyback on it.
+inline bool EnsurePython() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      PyConfig config;
+      PyConfig_InitPythonConfig(&config);
+      PyStatus status = Py_InitializeFromConfig(&config);
+      PyConfig_Clear(&config);
+      if (PyStatus_Exception(status)) {
+        return;  // ok stays false; callers surface the error
+      }
+      // Release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any caller thread.
+      PyEval_SaveThread();
+    }
+    ok = true;
+  });
+  return ok;
+}
+
+struct GILGuard {
+  PyGILState_STATE state;
+  GILGuard() : state(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state); }
+};
+
+// Import module attr; new reference, nullptr with error set on failure.
+inline PyObject *GetAttr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (mod == nullptr) return nullptr;
+  PyObject *a = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return a;
+}
+
+}  // namespace py_embed
+
+#endif  // MXNET_TPU_SRC_PY_EMBED_H_

@@ -1,0 +1,212 @@
+/* Pure-C consumer of the C API waist (reference parity:
+ * include/mxnet/c_api.h Parts 0-2).  Exercises NDArray CRUD, sync copies,
+ * imperative invoke through the creator table, save/load, op listing, and
+ * the error contract — in a fresh process where the library bootstraps the
+ * embedded interpreter itself. */
+#include <assert.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+typedef uint32_t mx_uint;
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+extern const char *MXGetLastError(void);
+extern int MXGetVersion(int *out);
+extern int MXRandomSeed(int seed);
+extern int MXNDArrayWaitAll(void);
+extern int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                           int dev_id, int delay_alloc, NDArrayHandle *out);
+extern int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                             int dev_id, int delay_alloc, int dtype,
+                             NDArrayHandle *out);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXNDArrayGetShape(NDArrayHandle h, mx_uint *out_dim,
+                             const mx_uint **out_pdata);
+extern int MXNDArrayGetDType(NDArrayHandle h, int *out);
+extern int MXNDArrayGetContext(NDArrayHandle h, int *dev_type, int *dev_id);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                    size_t size);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t size);
+extern int MXNDArrayWaitToRead(NDArrayHandle h);
+extern int MXNDArraySlice(NDArrayHandle h, mx_uint b, mx_uint e,
+                          NDArrayHandle *out);
+extern int MXNDArrayReshape(NDArrayHandle h, int ndim, int *dims,
+                            NDArrayHandle *out);
+extern int MXNDArraySave(const char *fname, mx_uint n, NDArrayHandle *args,
+                         const char **keys);
+extern int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                         NDArrayHandle **out_arr, mx_uint *out_name_size,
+                         const char ***out_names);
+extern int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+extern int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                            AtomicSymbolCreator **out_array);
+extern int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator c,
+                                       const char **name);
+extern int MXImperativeInvoke(AtomicSymbolCreator c, int num_inputs,
+                              NDArrayHandle *inputs, int *num_outputs,
+                              NDArrayHandle **outputs, int num_params,
+                              const char **keys, const char **vals);
+extern int MXImperativeInvokeByName(const char *name, int num_inputs,
+                                    NDArrayHandle *inputs, int *num_outputs,
+                                    NDArrayHandle **outputs, int num_params,
+                                    const char **keys, const char **vals);
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      fprintf(stderr, "FAILED %s:%d: %s (last error: %s)\n", __FILE__,   \
+              __LINE__, #cond, MXGetLastError());                        \
+      return 1;                                                          \
+    }                                                                    \
+  } while (0)
+
+int main(void) {
+  int version = 0;
+  CHECK(MXGetVersion(&version) == 0 && version == 10200);
+
+  /* create + shape + dtype + context */
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a = NULL;
+  CHECK(MXNDArrayCreate(shape, 2, 1 /*cpu*/, 0, 0, &a) == 0);
+  mx_uint dim = 0;
+  const mx_uint *pshape = NULL;
+  CHECK(MXNDArrayGetShape(a, &dim, &pshape) == 0);
+  CHECK(dim == 2 && pshape[0] == 2 && pshape[1] == 3);
+  int dtype = -1;
+  CHECK(MXNDArrayGetDType(a, &dtype) == 0 && dtype == 0);
+  int dev_type = 0, dev_id = -1;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0);
+  CHECK(dev_type == 1 && dev_id == 0);
+
+  /* sync copies round trip */
+  float values[6] = {0.f, 1.f, 2.f, 3.f, 4.f, 5.f};
+  CHECK(MXNDArraySyncCopyFromCPU(a, values, 6) == 0);
+  float back[6] = {0};
+  CHECK(MXNDArrayWaitToRead(a) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(a, back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == values[i]);
+
+  /* int32 array via CreateEx (int64 degrades to int32 without JAX x64 —
+   * the framework-wide dtype policy) */
+  NDArrayHandle ai = NULL;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 4 /*int32*/, &ai) == 0);
+  CHECK(MXNDArrayGetDType(ai, &dtype) == 0 && dtype == 4);
+  MXNDArrayFree(ai);
+
+  /* invoke by name: a + 1.5 */
+  const char *keys1[] = {"scalar"};
+  const char *vals1[] = {"1.5"};
+  int nout = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK(MXImperativeInvokeByName("_plus_scalar", 1, &a, &nout, &outs, 1,
+                                 keys1, vals1) == 0);
+  CHECK(nout == 1);
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == values[i] + 1.5f);
+  NDArrayHandle plus = outs[0];
+
+  /* creator table: find 'dot', multiply (2,3)x(3,2) */
+  mx_uint n_creators = 0;
+  AtomicSymbolCreator *creators = NULL;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators) == 0);
+  CHECK(n_creators > 100);
+  AtomicSymbolCreator dot = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *nm = NULL;
+    CHECK(MXSymbolGetAtomicSymbolName(creators[i], &nm) == 0);
+    if (strcmp(nm, "dot") == 0) dot = creators[i];
+  }
+  CHECK(dot != NULL);
+  mx_uint shape_b[2] = {3, 2};
+  NDArrayHandle b = NULL;
+  CHECK(MXNDArrayCreate(shape_b, 2, 1, 0, 0, &b) == 0);
+  float ones[6] = {1, 1, 1, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(b, ones, 6) == 0);
+  NDArrayHandle dot_in[2];
+  dot_in[0] = a;
+  dot_in[1] = b;
+  nout = 0;
+  outs = NULL;  /* NULL *outputs = allocate (non-NULL would mean out=) */
+  CHECK(MXImperativeInvoke(dot, 2, dot_in, &nout, &outs, 0, NULL, NULL) == 0);
+  CHECK(nout == 1);
+  CHECK(MXNDArrayGetShape(outs[0], &dim, &pshape) == 0);
+  CHECK(dim == 2 && pshape[0] == 2 && pshape[1] == 2);
+  float dots[4] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], dots, 4) == 0);
+  CHECK(dots[0] == 3.f && dots[3] == 12.f);   /* row sums of a */
+  MXNDArrayFree(outs[0]);
+
+  /* slice + reshape */
+  NDArrayHandle sl = NULL;
+  CHECK(MXNDArraySlice(a, 1, 2, &sl) == 0);
+  CHECK(MXNDArrayGetShape(sl, &dim, &pshape) == 0);
+  CHECK(dim == 2 && pshape[0] == 1 && pshape[1] == 3);
+  MXNDArrayFree(sl);
+  int dims[2] = {3, 2};
+  NDArrayHandle rs = NULL;
+  CHECK(MXNDArrayReshape(a, 2, dims, &rs) == 0);
+  CHECK(MXNDArrayGetShape(rs, &dim, &pshape) == 0);
+  CHECK(pshape[0] == 3 && pshape[1] == 2);
+  MXNDArrayFree(rs);
+
+  /* save / load named dict */
+  const char *names[] = {"weight", "bias"};
+  NDArrayHandle pair[2];
+  pair[0] = a;
+  pair[1] = plus;
+  CHECK(MXNDArraySave("/tmp/c_api_test.params", 2, pair, names) == 0);
+  mx_uint n_loaded = 0, n_names = 0;
+  NDArrayHandle *loaded = NULL;
+  const char **loaded_names = NULL;
+  CHECK(MXNDArrayLoad("/tmp/c_api_test.params", &n_loaded, &loaded, &n_names,
+                      &loaded_names) == 0);
+  CHECK(n_loaded == 2 && n_names == 2);
+  CHECK(strcmp(loaded_names[0], "bias") == 0);   /* sorted names */
+  CHECK(strcmp(loaded_names[1], "weight") == 0);
+  CHECK(MXNDArraySyncCopyToCPU(loaded[1], back, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == values[i]);
+  MXNDArrayFree(loaded[0]);
+  MXNDArrayFree(loaded[1]);
+
+  /* op listing */
+  mx_uint n_ops = 0;
+  const char **op_names = NULL;
+  CHECK(MXListAllOpNames(&n_ops, &op_names) == 0);
+  CHECK(n_ops == n_creators);
+
+  /* out= contract: supply the output handle, result lands in place */
+  {
+    NDArrayHandle target = NULL;
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &target) == 0);
+    const char *sk[] = {"scalar"};
+    const char *sv[] = {"2.0"};
+    int n_sup = 1;
+    NDArrayHandle sup[1];
+    sup[0] = target;
+    NDArrayHandle *psup = sup;
+    CHECK(MXImperativeInvokeByName("_mul_scalar", 1, &a, &n_sup, &psup, 1,
+                                   sk, sv) == 0);
+    CHECK(MXNDArraySyncCopyToCPU(target, back, 6) == 0);
+    for (int i = 0; i < 6; ++i) CHECK(back[i] == values[i] * 2.0f);
+    MXNDArrayFree(target);
+  }
+
+  /* error contract: bad op param surfaces -1 + message, then recovery */
+  const char *bad_keys[] = {"no_such_param"};
+  const char *bad_vals[] = {"1"};
+  nout = 0;
+  outs = NULL;
+  CHECK(MXImperativeInvokeByName("FullyConnected", 1, &a, &nout, &outs, 1,
+                                 bad_keys, bad_vals) != 0);
+  CHECK(strlen(MXGetLastError()) > 0);
+  CHECK(MXRandomSeed(7) == 0);
+  CHECK(MXNDArrayWaitAll() == 0);
+
+  MXNDArrayFree(plus);
+  MXNDArrayFree(b);
+  MXNDArrayFree(a);
+  printf("C API TEST OK (%u ops)\n", n_ops);
+  return 0;
+}

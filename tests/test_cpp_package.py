@@ -1,0 +1,31 @@
+"""cpp-package: the header-only C++ frontend over the C API waist.
+
+Parity model: reference cpp-package/ (§2.4) — NDArray + Operator builder
+classes and a trainable MLP example (cpp-package/example/mlp.cpp), here
+riding the imperative+autograd C ABI instead of Symbol/Executor.
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXDIR = os.path.join(REPO, "cpp_package", "example")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("python3-config") is None,
+    reason="no C++ toolchain")
+
+
+def test_cpp_mlp_trains():
+    r = subprocess.run(["make", "-C", EXDIR], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("cpp example build failed: %s" % r.stderr[-500:])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([os.path.join(EXDIR, "mlp")], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MLP TRAIN OK" in r.stdout
