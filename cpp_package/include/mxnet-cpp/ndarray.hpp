@@ -164,8 +164,16 @@ class NDArray {
 class AutogradRecord {
  public:
   explicit AutogradRecord(bool train_mode = true) {
-    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    // recording is switched on LAST: if either call throws mid-construction
+    // the destructor never runs, and a process stuck in recording mode
+    // would silently tape every subsequent op
     Check(MXAutogradSetIsTraining(train_mode ? 1 : 0, &prev_train_));
+    try {
+      Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    } catch (...) {
+      MXAutogradSetIsTraining(prev_train_, nullptr);
+      throw;
+    }
   }
   ~AutogradRecord() {
     MXAutogradSetIsRecording(prev_rec_, nullptr);

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples.
+
+Reference analog: ``example/adversary/adversary_generation.ipynb`` — train
+a classifier, then perturb inputs along the sign of the input gradient and
+watch accuracy collapse.  The TPU-relevant pattern demonstrated: taking
+gradients *with respect to inputs* (``attach_grad`` on data, not just
+parameters) through a hybridized network.
+
+Runs on a synthetic two-moons-style problem so it needs no dataset
+download.
+
+Run:  python example/adversary/fgsm.py --epsilon 0.3
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="FGSM adversarial attack demo",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=12)
+parser.add_argument("--samples", type=int, default=1024)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--lr", type=float, default=0.1)
+parser.add_argument("--epsilon", type=float, default=0.3,
+                    help="L-inf perturbation budget")
+
+
+def make_data(n, seed=0):
+    """Two interleaved half-circles ('moons'), 8-dim lifted."""
+    rng = np.random.RandomState(seed)
+    t = rng.uniform(0, np.pi, n)
+    cls = rng.randint(0, 2, n)
+    x = np.stack([np.cos(t) + cls * 1.0 - 0.5,
+                  np.sin(t) * (1 - 2 * cls) + cls * 0.25], 1)
+    x += rng.normal(0, 0.08, x.shape)
+    # lift to 8 dims with a fixed random projection (keeps the demo's
+    # gradient non-trivial in every input coordinate)
+    proj = np.random.RandomState(42).randn(2, 8) * 0.7
+    return (x @ proj).astype(np.float32), cls.astype(np.float32)
+
+
+def accuracy(net, x, y):
+    pred = net(mx.nd.array(x)).asnumpy().argmax(1)
+    return float((pred == y).mean())
+
+
+def main(args):
+    x, y = make_data(args.samples)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True)
+    for epoch in range(args.num_epochs):
+        it.reset()
+        total = 0.0
+        for batch in it:
+            with autograd.record():
+                out = net(batch.data[0])
+                L = loss_fn(out, batch.label[0])
+            L.backward()
+            trainer.step(args.batch_size)
+            total += float(L.mean().asnumpy())
+    clean_acc = accuracy(net, x, y)
+
+    # FGSM: one gradient step on the *input*, sign-quantized
+    data = mx.nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = net(data)
+        L = loss_fn(out, mx.nd.array(y))
+    L.backward()
+    x_adv = (data + args.epsilon * mx.nd.sign(data.grad)).asnumpy()
+    adv_acc = accuracy(net, x_adv, y)
+    print("clean accuracy %.3f -> adversarial accuracy %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.epsilon))
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""TextCNN sentence classification (Kim 2014 architecture).
+
+Reference analog: ``example/cnn_text_classification/text_cnn.py`` —
+parallel 1-D convolutions of several kernel widths over embedded token
+sequences, max-over-time pooled, concatenated into a classifier.  The
+TPU-relevant pattern demonstrated: multi-branch convolution graphs fuse
+into one XLA program; all branches static-shaped.
+
+Synthetic task: sequences contain a class-specific trigram motif at a
+random position — exactly what width-3 filters should detect.
+
+Run:  python example/cnn_text_classification/text_cnn.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="TextCNN on synthetic motif sequences",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=12)
+parser.add_argument("--samples", type=int, default=1536)
+parser.add_argument("--seq-len", type=int, default=24)
+parser.add_argument("--vocab", type=int, default=50)
+parser.add_argument("--classes", type=int, default=3)
+parser.add_argument("--embed", type=int, default=16)
+parser.add_argument("--filters", type=int, default=32)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--lr", type=float, default=0.01)
+
+
+class TextCNN(gluon.HybridBlock):
+    def __init__(self, vocab, embed, filters, classes, widths=(2, 3, 4),
+                 **kw):
+        super().__init__(**kw)
+        self.emb = nn.Embedding(vocab, embed)
+        self.convs = nn.HybridSequential()
+        for w in widths:
+            self.convs.add(nn.Conv1D(filters, w, activation="relu"))
+        self.pool = nn.GlobalMaxPool1D()
+        self.drop = nn.Dropout(0.3)
+        self.out = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        e = self.emb(x).transpose((0, 2, 1))    # (N, C=embed, T)
+        feats = [self.pool(c(e)).flatten() for c in self.convs]
+        h = F.concat(*feats, dim=1)
+        return self.out(self.drop(h))
+
+
+def make_data(n, seq_len, vocab, classes, seed=0):
+    """Each class plants its own trigram motif at a random position."""
+    rng = np.random.RandomState(seed)
+    motifs = rng.randint(vocab // 2, vocab, (classes, 3))
+    x = rng.randint(0, vocab // 2, (n, seq_len))
+    y = rng.randint(0, classes, n)
+    pos = rng.randint(0, seq_len - 3, n)
+    for i in range(n):
+        x[i, pos[i]:pos[i] + 3] = motifs[y[i]]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main(args):
+    x, y = make_data(args.samples, args.seq_len, args.vocab, args.classes)
+    net = TextCNN(args.vocab, args.embed, args.filters, args.classes)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True)
+    for epoch in range(args.num_epochs):
+        it.reset()
+        total, nb = 0.0, 0
+        for batch in it:
+            with autograd.record():
+                L = ce(net(batch.data[0]), batch.label[0])
+            L.backward()
+            trainer.step(args.batch_size)
+            total += float(L.mean().asnumpy())
+            nb += 1
+        if epoch % 4 == 0:
+            print("epoch %d loss %.4f" % (epoch, total / nb))
+    pred = net(mx.nd.array(x)).asnumpy().argmax(1)
+    acc = float((pred == y).mean())
+    print("motif classification accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

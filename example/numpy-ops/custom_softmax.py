@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Train with a softmax loss implemented as a numpy Custom op.
+
+Reference analog: ``example/numpy-ops/custom_softmax.py`` — the canonical
+custom-op-bridge demo: forward and backward written in numpy, registered
+with ``mx.operator.register``, dropped into a Module symbol as the loss
+layer.  The TPU-relevant machinery exercised: host callbacks crossing the
+XLA boundary on the framework's dedicated custom-op worker (the reference
+runs them on a worker thread so the engine never blocks —
+src/operator/custom/custom-inl.h).
+
+Run:  python example/numpy-ops/custom_softmax.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="custom numpy softmax loss",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=10)
+parser.add_argument("--samples", type=int, default=640)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--lr", type=float, default=0.1)
+
+
+class Softmax(mx.operator.CustomOp):
+    """Numpy forward/backward (reference custom_softmax.py:31-52)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int32)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("demo_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def make_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, :4].sum(1) > 0).astype(np.float32) + \
+        2 * (x[:, 4:8].sum(1) > 0).astype(np.float32)
+    return x, y
+
+
+def main(args):
+    x, y = make_data(args.samples)
+    S = mx.symbol
+    data = S.var("data")
+    label = S.var("softmax_label")
+    fc1 = S.FullyConnected(data, num_hidden=64, name="fc1")
+    act = S.Activation(fc1, act_type="relu")
+    fc2 = S.FullyConnected(act, num_hidden=4, name="fc2")
+    net = S.Custom(fc2, label, op_type="demo_softmax", name="softmax")
+
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    score = mod.score(it, "acc")
+    acc = dict(score)["accuracy"]
+    print("custom-softmax Module accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

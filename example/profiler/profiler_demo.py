@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Profiler usage: trace a training loop to a Chrome-trace JSON.
+
+Reference analog: ``example/profiler/profiler_ndarray.py`` /
+``profiler_matmul.py`` — configure, run ops, dump, inspect.  The
+TPU-relevant pattern demonstrated: the same ``mx.profiler`` API records
+host-side op-dispatch spans plus user Task/Frame markers; the dump is a
+``chrome://tracing`` JSON (reference Profiler::DumpProfile semantics).
+
+Run:  python example/profiler/profiler_demo.py --out /tmp/trace.json
+"""
+import argparse
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, profiler
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="profiler demo",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--out", default="/tmp/mxnet_tpu_trace.json")
+parser.add_argument("--steps", type=int, default=8)
+parser.add_argument("--batch-size", type=int, default=32)
+
+
+def main(args):
+    profiler.set_config(filename=args.out, profile_all=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rs = np.random.RandomState(0)
+    x = rs.randn(args.batch_size, 32).astype(np.float32)
+    y = rs.randint(0, 10, args.batch_size).astype(np.float32)
+
+    profiler.set_state("run")
+    domain = profiler.Domain("example")
+    train_task = profiler.Task(domain, "train_steps")
+    train_task.start()
+    for step in range(args.steps):
+        with autograd.record():
+            L = ce(net(mx.nd.array(x)), mx.nd.array(y))
+        L.backward()
+        trainer.step(args.batch_size)
+    mx.nd.waitall()
+    train_task.stop()
+    profiler.set_state("stop")
+    profiler.dump()
+
+    with open(args.out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    op_names = {e.get("name") for e in events if e.get("ph") == "X"}
+    print("trace: %d events, ops seen include %s"
+          % (len(events), sorted(n for n in op_names
+                                 if n and "FullyConnected" in n)[:2]))
+    return args.out, len(events), op_names
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""SVM output layer: max-margin training through the Module API.
+
+Reference analog: ``example/svm_mnist/svm_mnist.py`` — swap SoftmaxOutput
+for ``SVMOutput`` (L1/L2 hinge loss, src/operator/svm_output.cc) on an
+MLP and train with the same fit loop.
+
+Run:  python example/svm_mnist/svm_demo.py --l2
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="SVMOutput max-margin training",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=10)
+parser.add_argument("--samples", type=int, default=1024)
+parser.add_argument("--classes", type=int, default=4)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--lr", type=float, default=0.1)
+parser.add_argument("--margin", type=float, default=1.0)
+parser.add_argument("--l2", action="store_true",
+                    help="squared hinge instead of L1 hinge")
+
+
+def make_data(n, classes, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, 24) * 2.0
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, 24) * 0.6
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main(args):
+    x, y = make_data(args.samples, args.classes)
+    S = mx.symbol
+    data = S.var("data")
+    label = S.var("svm_label")
+    fc1 = S.FullyConnected(data, num_hidden=48, name="fc1")
+    act = S.Activation(fc1, act_type="relu")
+    fc2 = S.FullyConnected(act, num_hidden=args.classes, name="fc2")
+    net = S.SVMOutput(fc2, label, margin=args.margin,
+                      use_linear=not args.l2, name="svm")
+
+    mod = mx.mod.Module(net, data_names=["data"], label_names=["svm_label"])
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                           label_name="svm_label")
+    mod.fit(it, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric="acc")
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    print("SVM (%s hinge) accuracy: %.3f"
+          % ("L2" if args.l2 else "L1", acc))
+    return acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Variational autoencoder with the reparameterization trick.
+
+Reference analog: ``example/vae/VAE.py`` / ``mxnet_adversarial_vae`` —
+encoder emits (mu, logvar), a sampled latent feeds the decoder, and the
+loss is reconstruction + KL.  The TPU-relevant pattern demonstrated:
+random sampling *inside* the recorded graph (``mx.nd.random.normal``
+under ``autograd.record`` — the functional threefry key threading makes
+this reproducible), with gradients flowing through the reparameterized
+sample.
+
+Run:  python example/vae/vae.py --num-epochs 25
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="dense VAE on synthetic low-rank data",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--samples", type=int, default=1024)
+parser.add_argument("--dim", type=int, default=32)
+parser.add_argument("--latent", type=int, default=4)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--kl-weight", type=float, default=0.1)
+
+
+class VAE(gluon.HybridBlock):
+    def __init__(self, dim, latent, **kw):
+        super().__init__(**kw)
+        self.latent = latent
+        self.enc = nn.HybridSequential()
+        self.enc.add(nn.Dense(48, activation="relu"),
+                     nn.Dense(2 * latent))      # mu ++ logvar
+        self.dec = nn.HybridSequential()
+        self.dec.add(nn.Dense(48, activation="relu"),
+                     nn.Dense(dim))
+
+    def encode(self, x):
+        h = self.enc(x)
+        return h[:, :self.latent], h[:, self.latent:]
+
+    def hybrid_forward(self, F, x):
+        mu, logvar = self.encode(x)
+        eps = mx.nd.random.normal(shape=mu.shape)
+        z = mu + eps * (0.5 * logvar).exp()     # reparameterization
+        return self.dec(z), mu, logvar
+
+
+def elbo_loss(rec, x, mu, logvar, kl_weight):
+    rec_loss = ((rec - x) ** 2).sum(axis=1)
+    kl = -0.5 * (1 + logvar - mu ** 2 - logvar.exp()).sum(axis=1)
+    return (rec_loss + kl_weight * kl).mean()
+
+
+def make_data(n, dim, seed=0):
+    rng = np.random.RandomState(seed)
+    basis = rng.randn(3, dim).astype(np.float32)
+    return np.tanh(rng.randn(n, 3).astype(np.float32) @ basis)
+
+
+def main(args):
+    x = make_data(args.samples, args.dim)
+    net = VAE(args.dim, args.latent)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    n = x.shape[0]
+    # untrained -ELBO on the full set: the baseline the training beats
+    data_all = mx.nd.array(x)
+    rec, mu, logvar = net(data_all)
+    init_elbo = float(elbo_loss(rec, data_all, mu, logvar,
+                                args.kl_weight).asnumpy())
+    first = last = None
+    for epoch in range(args.num_epochs):
+        idx = np.random.RandomState(epoch).permutation(n)
+        total, nb = 0.0, 0
+        for i in range(0, n - args.batch_size + 1, args.batch_size):
+            data = mx.nd.array(x[idx[i:i + args.batch_size]])
+            with autograd.record():
+                rec, mu, logvar = net(data)
+                L = elbo_loss(rec, data, mu, logvar, args.kl_weight)
+            L.backward()
+            trainer.step(args.batch_size)
+            total += float(L.asnumpy())
+            nb += 1
+        avg = total / nb
+        if first is None:
+            first = avg
+        last = avg
+        if epoch % 5 == 0:
+            print("epoch %d -ELBO %.4f" % (epoch, avg))
+    # draw fresh samples from the prior through the decoder
+    z = mx.nd.random.normal(shape=(8, args.latent))
+    samples = net.dec(z).asnumpy()
+    print("-ELBO untrained %.4f -> %.4f; sample std %.3f"
+          % (init_elbo, last, samples.std()))
+    return init_elbo, last
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -168,11 +168,6 @@ def random_seed(seed: int):
     _random.seed(int(seed))
 
 
-def version() -> int:
-    """MXGetVersion — reference-era version code (1.2.0 -> 10200)."""
-    return 10200
-
-
 # ---- autograd (c_api.h Part 2: MXAutograd*) -------------------------------
 
 def autograd_set_recording(flag: int) -> int:

@@ -110,11 +110,19 @@ class Module(BaseModule):
             self._exec_group.set_params(self._arg_params, self._aux_params,
                                         allow_extra=True)
 
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
+    def init_params(self, initializer="__default__", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before init_params"
+        if initializer == "__default__":
+            # reference default (base_module.py:629): Uniform(0.01) — a bare
+            # init_params() must NOT leave weights at zero (relu nets would
+            # never break symmetry); name-based dispatch in Initializer
+            # still zeroes biases and sets moving stats correctly
+            from .. import initializer as init_mod
+            initializer = init_mod.Uniform(0.01)
         ex = self._exec_group.execs[0]
         self._arg_params = {n: ex.arg_dict[n].copyto(cpu())
                             for n in self._param_names}

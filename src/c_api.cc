@@ -117,9 +117,12 @@ std::vector<std::string> *OpNameTable() {
   static std::mutex mu;
   static std::vector<std::string> table;
   static bool ok = false;
+  // GIL strictly before mu: a caller already holding the GIL must not be
+  // able to block on mu while another thread holds mu and waits for the
+  // GIL (classic lock-order inversion)
+  GILGuard gil;
   std::lock_guard<std::mutex> lock(mu);
   if (!ok) {
-    GILGuard gil;
     PyObject *names = CallBridge("list_ops", PyTuple_New(0));
     if (names == nullptr) return nullptr;   // error set by CallBridge
     Py_ssize_t n = PyList_Size(names);

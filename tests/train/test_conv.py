@@ -45,7 +45,7 @@ def test_conv_module_fit_converges():
     train = mx.io.NDArrayIter(Xtr, ytr, batch_size=100, shuffle=True)
     val = mx.io.NDArrayIter(Xte, yte, batch_size=100)
     mod = mx.mod.Module(_lenet_symbol())
-    mod.fit(train, num_epoch=10,
+    mod.fit(train, num_epoch=14,
             optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
             initializer=mx.init.Xavier())
     acc = dict(mod.score(val, "acc"))["accuracy"]
@@ -102,7 +102,7 @@ def test_conv_fused_trainer_converges():
                          {"learning_rate": 0.2, "momentum": 0.9})
     B = 100
     first = last = None
-    for _ in range(10):
+    for _ in range(14):
         for i in range(0, 1500, B):
             loss = ft.step(mx.nd.array(Xtr[i:i + B]),
                            mx.nd.array(ytr[i:i + B]))
