@@ -10,16 +10,21 @@ TPU-native design (SURVEY.md §5.8): single-process multi-device stores
 multi-device sum (the ICI all-reduce path once arrays live on a Mesh);
 ``dist_sync`` rides the multi-host JAX runtime (jax.distributed +
 ``parallel/``'s psum train steps) instead of a parameter server — rank/size
-come from the JAX process group.  ``dist_async`` has no XLA analog
-(documented: falls back to synchronous semantics).  The Python API
+come from the JAX process group.  ``dist_async`` IS a parameter server
+(``kvstore_server.py``: host-resident TCP, immediate per-push apply,
+server-side pickled optimizer) because barrier-free staleness-tolerant
+updates have no XLA-collective analog.  The Python API
 (init/push/pull/row_sparse_pull/set_optimizer/compression) is preserved.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import threading
+import time as _time
 from typing import Any, Dict, List, Optional, Union
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt
@@ -181,6 +186,18 @@ class KVStore:
         return [_key(key)], [value]
 
 
+def _local_sum(v):
+    """Sum a per-device value list into one array (the intra-worker
+    reduce every dist push does before going on the wire)."""
+    vlist = v if isinstance(v, (list, tuple)) else [v]
+    agg = vlist[0]
+    if len(vlist) > 1:
+        agg = vlist[0].copy()
+        for x in vlist[1:]:
+            agg += x.as_in_context(agg.context)
+    return agg
+
+
 class DistKVStore(KVStore):
     """Multi-host store over the JAX distributed runtime (DCN).
 
@@ -188,8 +205,8 @@ class DistKVStore(KVStore):
     TPU-native: every host holds a replica; push performs a cross-process
     all-reduce via ``parallel.comm`` collectives (jax.distributed must be
     initialized — ``parallel.init_distributed()``); there are no separate
-    server processes.  ``dist_async`` semantics (lock-free immediate apply)
-    are approximated by synchronous all-reduce (documented deviation).
+    server processes.  ``dist_async`` is handled by
+    :class:`DistAsyncKVStore` (true parameter server) instead.
     """
 
     def __init__(self, kind="dist_sync"):
@@ -221,12 +238,7 @@ class DistKVStore(KVStore):
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
-            vlist = v if isinstance(v, (list, tuple)) else [v]
-            agg = vlist[0]
-            if len(vlist) > 1:
-                agg = vlist[0].copy()
-                for x in vlist[1:]:
-                    agg += x.as_in_context(agg.context)
+            agg = _local_sum(v)
             if self._compression:
                 # each worker ships its quantized gradient (2-bit + error
                 # feedback, N13); summing dequantized streams across ranks
@@ -245,12 +257,133 @@ class DistKVStore(KVStore):
         self._pg.barrier()
 
 
+class DistAsyncKVStore(KVStore):
+    """``dist_async``: the true parameter-server path (kvstore_server.py).
+
+    Reference semantics (kvstore_dist_server.h async mode): every worker
+    pushes gradients to the server, which applies its optimizer
+    IMMEDIATELY — no per-batch barrier, workers run at their own pace on
+    possibly-stale weights; pull fetches whatever the weights currently
+    are.  ``set_optimizer`` ships the pickled optimizer to the server
+    (reference kvstore_server.py:55), after which ``update_on_kvstore``
+    holds: push(grad) triggers the server-side update and the worker-side
+    updater stays unused.
+    """
+
+    def __init__(self, kind="dist_async"):
+        super().__init__(kind)
+        import socket as _socket
+        from . import kvstore_server as _ps
+        host, port = _ps.ps_address()
+        self._ps = _ps
+        self._sock = None
+        # the server process may come up after the workers: retry connect
+        deadline = _time.time() + float(
+            get_env("MXNET_PS_CONNECT_TIMEOUT_SEC", 60))
+        last_err = None
+        while _time.time() < deadline:
+            try:
+                self._sock = _socket.create_connection((host, port),
+                                                       timeout=60)
+                # blocking thereafter: barrier() legitimately waits for
+                # the slowest worker, which can exceed any fixed timeout
+                self._sock.settimeout(None)
+                break
+            except OSError as e:
+                last_err = e
+                _time.sleep(0.2)
+        if self._sock is None:
+            raise MXNetError("cannot reach parameter server %s:%d: %s"
+                             % (host, port, last_err))
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._lock = threading.Lock()
+
+    def _rpc(self, *msg):
+        with self._lock:
+            self._ps.send_msg(self._sock, msg)
+            reply = self._ps.recv_msg(self._sock)
+        if reply is None:
+            raise MXNetError("parameter server closed the connection")
+        if reply[0] != "ok":
+            raise MXNetError("parameter server: %s" % reply[1])
+        return reply[1] if len(reply) > 1 else None
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._rpc("init", k, v0.asnumpy())
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            agg = _local_sum(v)
+            if self._compression:
+                # quantized-with-error-feedback gradient on the wire
+                # (reference compresses dist pushes, N13)
+                agg = NDArray(self._compression.compress(k, agg._data),
+                              agg.context)
+            self._rpc("push", k, agg.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, dst in zip(keys, outs):
+            arr = self._rpc("pull", k)
+            dsts = dst if isinstance(dst, (list, tuple)) else [dst]
+            for d in dsts:
+                from .ndarray.ndarray import array as _array
+                _array(arr, ctx=d.context, dtype=d.dtype).copyto(d)
+
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the server (update_on_kvstore;
+        the server keeps the first one it receives)."""
+        self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def send_command_to_servers(self, head, body):
+        """kStopServer analog: head 0 stops the server (reference
+        KVStore::SendCommandToServers)."""
+        if int(head) == 0:
+            self._rpc("stop")
+
+
 def create(name="local") -> KVStore:
-    """Factory (reference kvstore.cc:40-77 name dispatch)."""
+    """Factory (reference kvstore.cc:40-77 name dispatch).
+
+    A process launched with ``DMLC_ROLE=server`` enters the parameter
+    server loop here and exits when stopped (reference behavior: the same
+    training script doubles as the server binary, kvstore_server.py:73).
+    """
     name = name.lower()
+    if name.startswith("dist") and os.environ.get("DMLC_ROLE") == "server":
+        # server role precedes name dispatch: a server process must never
+        # fall through into the worker rendezvous as a bogus participant
+        from . import kvstore_server as _ps
+        _ps.run_server()
+        raise SystemExit(0)
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl"):
         return KVStore(name)
+    if name == "dist_async":
+        return DistAsyncKVStore(name)
     if name.startswith("dist"):
         return DistKVStore(name)
     raise MXNetError("unknown kvstore type %r" % name)

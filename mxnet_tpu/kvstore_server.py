@@ -1,0 +1,230 @@
+"""Parameter server for ``dist_async`` (reference parity:
+``src/kvstore/kvstore_dist_server.h`` + ``python/mxnet/kvstore_server.py``).
+
+The reference's async mode (``kvstore_dist_server.h:262`` DataHandle with
+``sync_mode_ == false``) applies every worker push to the stored weight
+IMMEDIATELY — no aggregation window, no barrier — and answers pulls with
+whatever the weight currently is; the update rule is a **pickled Python
+optimizer** shipped from worker 0 (``kvstore_server.py:55``).  ``dist_sync``
+on this framework rides XLA collectives over DCN instead (SURVEY.md §5.8),
+so this server exists exactly for the async-SGD semantics XLA cannot
+express: lock-free-style staleness-tolerant updates.
+
+TPU-native design: host-resident parameters (numpy) behind a threaded TCP
+server — the transport role ps-lite's ZMQ plays in the reference.  Device
+compute stays on the workers; the server only runs the (tiny) optimizer
+update per key, under a per-key lock.  Wire format: length-prefixed
+pickles (a trusted-cluster protocol, like ps-lite's).
+
+Role dispatch mirrors the reference launcher contract: a process started
+with ``DMLC_ROLE=server`` calls :func:`run_server` (via
+``kvstore.create('dist_async')``), serves until every worker disconnects
+and a stop command arrives, then exits.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "run_server", "ps_address"]
+
+
+def ps_address():
+    """(host, port) of the parameter server from the launcher env."""
+    host = os.environ.get("MXNET_PS_URI",
+                          os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"))
+    port = os.environ.get("MXNET_PS_PORT")
+    if port is None:
+        raise MXNetError(
+            "dist_async needs a parameter server address: set MXNET_PS_PORT"
+            " (tools/launch.py -s 1 does this)")
+    return host, int(port)
+
+
+def send_msg(sock: socket.socket, obj: Any):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<Q", header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class KVStoreServer:
+    """The async parameter server.
+
+    Commands (reference CommandType analogs, kvstore_dist_server.h:44-73):
+    ``init`` (first writer wins — worker 0 initializes, later inits are
+    ignored like the reference's repeated InitImpl), ``push`` (apply
+    optimizer immediately; plain assignment when no optimizer is set),
+    ``pull`` (current value), ``set_optimizer`` (pickled optimizer ->
+    server-side Updater; kController), ``barrier`` (rendezvous of
+    num_workers), ``stop`` (kStopServer).
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1):
+        self._store: Dict[str, np.ndarray] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._meta_lock = threading.Lock()
+        self._updater = None
+        self._num_workers = num_workers
+        self._barrier_cond = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self.push_count = 0
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = recv_msg(self.request)
+                    if msg is None:
+                        return
+                    reply = outer._dispatch(msg)
+                    send_msg(self.request, reply)
+                    if msg[0] == "stop":
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- command handlers ----------------------------------------------
+    def _lock_for(self, key):
+        with self._meta_lock:
+            lk = self._locks.get(key)
+            if lk is None:
+                lk = self._locks[key] = threading.Lock()
+            return lk
+
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        try:
+            if cmd == "init":
+                _, key, arr = msg
+                with self._lock_for(key):
+                    # first writer wins (worker 0 initializes the PS)
+                    if key not in self._store:
+                        self._store[key] = np.array(arr, copy=True)
+                return ("ok",)
+            if cmd == "push":
+                _, key, grad = msg
+                with self._lock_for(key):
+                    if key not in self._store:
+                        raise MXNetError("push before init: %r" % key)
+                    if self._updater is None:
+                        # reference default: aggregate==assign in async
+                        # mode each push replaces the value
+                        self._store[key] = np.array(grad, copy=True)
+                    else:
+                        self._apply(key, np.asarray(grad))
+                with self._meta_lock:   # per-key locks don't cover this
+                    self.push_count += 1
+                return ("ok",)
+            if cmd == "pull":
+                _, key = msg
+                with self._lock_for(key):
+                    if key not in self._store:
+                        raise MXNetError("pull before init: %r" % key)
+                    return ("ok", self._store[key].copy())
+            if cmd == "set_optimizer":
+                _, payload = msg
+                from . import optimizer as opt
+                with self._meta_lock:
+                    # first optimizer wins: every rank's Module calls
+                    # set_optimizer (module.py init_optimizer), and a
+                    # straggler's arrival must not rebuild the Updater —
+                    # that would wipe accumulated momentum mid-training
+                    if self._updater is None:
+                        self._updater = opt.get_updater(
+                            pickle.loads(payload))
+                return ("ok",)
+            if cmd == "barrier":
+                self._wait_barrier()
+                return ("ok",)
+            if cmd == "stop":
+                self._stop.set()
+                threading.Thread(target=self._server.shutdown,
+                                 daemon=True).start()
+                return ("ok",)
+            return ("err", "unknown command %r" % (cmd,))
+        except Exception as e:  # surface to the worker (reference: the
+            return ("err", str(e))  # error string crosses the wire)
+
+    def _apply(self, key, grad):
+        """Server-side optimizer step on the stored weight (immediate
+        apply — the async semantics XLA collectives can't express)."""
+        from . import ndarray as nd
+        w = nd.array(self._store[key])
+        self._updater(key, nd.array(grad), w)
+        self._store[key] = w.asnumpy()
+
+    def _wait_barrier(self):
+        with self._barrier_cond:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self._num_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cond.notify_all()
+            else:
+                while self._barrier_gen == gen and not self._stop.is_set():
+                    self._barrier_cond.wait(timeout=1.0)
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self):
+        """Serve on a background thread (in-process embedding and tests)."""
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def run_server():
+    """Entry for a ``DMLC_ROLE=server`` process (reference
+    ``KVStoreServer.run`` loop, kvstore_server.py:73): bind the launcher
+    address, serve until a worker sends ``stop``."""
+    host, port = ps_address()
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    server = KVStoreServer(host="", port=port, num_workers=num_workers)
+    server.serve_forever()

@@ -1,0 +1,60 @@
+"""dist_async worker: async-SGD least squares through the parameter server.
+
+Launched by tests/test_dist_async_kvstore.py via tools/launch.py -s 1.
+Each worker trains on its own shard with server-side SGD (set_optimizer ->
+update_on_kvstore): push(grad) applies immediately on the server, pull
+fetches possibly-staler-than-sync weights — the async semantics under
+test.  Rank 0 verifies convergence and stops the server.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    # create() first: in a DMLC_ROLE=server process this enters the server
+    # loop and never returns (reference kvstore_server.py behavior)
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+
+    rng = np.random.RandomState(100 + rank)
+    w_true = np.array([[1.0], [-2.0], [3.0]], np.float32)
+    X = rng.randn(256, 3).astype(np.float32)
+    y = X @ w_true
+
+    kv.init("w", nd.zeros((3, 1)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    kv.barrier()                       # both workers see the optimizer
+
+    w = nd.zeros((3, 1))
+    for step in range(150):
+        kv.pull("w", out=w)
+        i = (step * 32) % 224
+        xb, yb = nd.array(X[i:i + 32]), nd.array(y[i:i + 32])
+        grad = nd.dot(xb.T, nd.dot(xb, w) - yb) / 32
+        kv.push("w", grad)             # server applies immediately
+
+    kv.barrier()
+    kv.pull("w", out=w)
+    err = float(np.abs(w.asnumpy() - w_true).max())
+    print("rank %d final err %.4f" % (rank, err))
+    assert err < 0.05, "async training did not converge: %.4f" % err
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()

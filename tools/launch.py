@@ -2,16 +2,19 @@
 """Distributed job launcher (parity: tools/launch.py + dmlc_tracker local).
 
 Reference analog: ``tools/launch.py:29-50`` — starts a scheduler, S servers
-and W workers via dmlc_tracker (ssh/mpi/local).  TPU-native: there is no
-parameter server; this launcher starts W worker processes wired to one JAX
-distributed coordinator (rank 0).  The reference's env contract is kept so
-``launch.py -n 4 python train.py --kv-store dist_sync`` works unchanged:
+and W workers via dmlc_tracker (ssh/mpi/local).  TPU-native: this launcher
+starts W worker processes wired to one JAX distributed coordinator
+(rank 0), plus — with ``-s`` — one parameter-server process for
+``dist_async`` (mxnet_tpu.kvstore_server).  The reference's env contract
+is kept so ``launch.py -n 4 python train.py --kv-store dist_sync`` works
+unchanged:
 
-  DMLC_ROLE=worker  DMLC_NUM_WORKER=W  DMLC_WORKER_ID=rank
+  DMLC_ROLE=worker|server  DMLC_NUM_WORKER=W  DMLC_WORKER_ID=rank
   DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> the JAX coordinator address
+  MXNET_PS_URI / MXNET_PS_PORT         -> the dist_async parameter server
 
-``-s`` (server count) is accepted and ignored with a note: dist_sync rides
-XLA collectives over DCN, not ps-lite (SURVEY.md §5.8).
+``dist_sync`` rides XLA collectives over DCN, not ps-lite (SURVEY.md
+§5.8); the server role exists for the async-SGD semantics only.
 """
 from __future__ import annotations
 
@@ -30,29 +33,52 @@ def _free_port() -> int:
     return port
 
 
-def launch_local(num_workers: int, command, env_extra=None) -> int:
+def launch_local(num_workers: int, command, env_extra=None,
+                 num_servers: int = 0) -> int:
     """Fork ``num_workers`` local processes (the dmlc_tracker 'local'
-    backend pattern of tests/nightly/test_all.sh:55)."""
+    backend pattern of tests/nightly/test_all.sh:55).  With
+    ``num_servers`` > 0 one extra process runs the same command with
+    ``DMLC_ROLE=server`` — it enters the parameter-server loop inside
+    ``kvstore.create('dist_async')`` (reference behavior: the training
+    script doubles as the server binary)."""
     port = _free_port()
+    ps_port = _free_port() if num_servers else None
     procs = []
+    base = {
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+    }
+    if ps_port is not None:
+        base["MXNET_PS_URI"] = "127.0.0.1"
+        base["MXNET_PS_PORT"] = str(ps_port)
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update(base)
+        env["DMLC_ROLE"] = "server"
+        procs.append(subprocess.Popen(command, env=env))
     for rank in range(num_workers):
         env = dict(os.environ)
         env.update(env_extra or {})
+        env.update(base)
         env.update({
             "DMLC_ROLE": "worker",
-            "DMLC_NUM_WORKER": str(num_workers),
             "DMLC_WORKER_ID": str(rank),
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
         })
         procs.append(subprocess.Popen(command, env=env))
     # poll rather than wait serially: when one rank dies the others may be
     # blocked in the coordinator rendezvous forever — kill them fast
     import time
     rc = 0
-    alive = list(procs)
+    server = procs[0] if ps_port is not None else None
+    workers = procs[1:] if ps_port is not None else procs
+    alive = list(workers)
     while alive:
         time.sleep(0.2)
+        if server is not None and server.poll() not in (None, 0) and rc == 0:
+            rc = server.poll()          # server crashed: tear down the job
+            for q in alive:
+                q.terminate()
         for p in list(alive):
             code = p.poll()
             if code is None:
@@ -62,6 +88,13 @@ def launch_local(num_workers: int, command, env_extra=None) -> int:
                 rc = code
                 for q in alive:
                     q.terminate()
+    if server is not None:
+        # workers are done; the server idles until stopped (reference:
+        # rank 0 sends kStopServer) — reap it either way
+        time.sleep(0.2)
+        if server.poll() is None:
+            server.terminate()
+        server.wait()
     return rc
 
 
@@ -71,8 +104,9 @@ def main(argv=None):
     ap.add_argument("-n", "--num-workers", type=int, required=True,
                     help="number of worker processes")
     ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference compatibility; ignored "
-                         "(no parameter server on the TPU backend)")
+                    help="start a parameter server for dist_async (>0 "
+                         "starts one; dist_sync needs none — it rides XLA "
+                         "collectives)")
     ap.add_argument("--launcher", choices=["local"], default="local",
                     help="only the local (single-host fork) tracker is "
                          "built in; multi-host uses the cluster scheduler's "
@@ -82,10 +116,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
-    if args.num_servers:
-        print("note: -s/--num-servers ignored — dist kvstore uses XLA "
-              "collectives, not parameter servers", file=sys.stderr)
-    return launch_local(args.num_workers, args.command)
+    if args.num_servers > 1:
+        print("note: one parameter server is started (the single-server "
+              "case of the reference's -s)", file=sys.stderr)
+    return launch_local(args.num_workers, args.command,
+                        num_servers=args.num_servers)
 
 
 if __name__ == "__main__":
