@@ -131,6 +131,10 @@ class NDArray {
   static void Save(const std::string &fname,
                    const std::vector<NDArray> &arrays,
                    const std::vector<std::string> &names) {
+    if (!names.empty() && names.size() != arrays.size()) {
+      throw std::invalid_argument(
+          "NDArray::Save: names.size() must equal arrays.size()");
+    }
     std::vector<NDArrayHandle> handles;
     std::vector<const char *> keys;
     for (const auto &a : arrays) handles.push_back(a.GetHandle());

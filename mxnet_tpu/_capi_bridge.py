@@ -149,8 +149,11 @@ def load(fname: str) -> Tuple[List[NDArray], List[str]]:
     """MXNDArrayLoad -> (arrays, names); names empty for list format."""
     data = nd.load(fname)
     if isinstance(data, dict):
-        names = sorted(data)
-        return [data[k] for k in names], list(names)
+        # insertion order == save order (nd.load preserves it); the
+        # reference MXNDArrayLoad keeps positional order for named saves,
+        # so C consumers may rely on it (advisor r04)
+        names = list(data)
+        return [data[k] for k in names], names
     return list(data), []
 
 

@@ -498,7 +498,7 @@ class ImageRecordIter(DataIter):
         # reused across batches instead of a fresh malloc per batch)
         from . import resource as _resource
         from . import context as _ctx
-        self._workspace = _resource.ResourceManager.get().request(
+        self._workspace_res = _resource.ResourceManager.get().request(
             _ctx.cpu(0),
             _resource.ResourceRequest(_resource.ResourceRequest.kTempSpace))
         if path_imgidx and os.path.exists(path_imgidx):
@@ -554,9 +554,22 @@ class ImageRecordIter(DataIter):
             self._pool.shutdown(wait=False)
             self._pool = None
         # release the temp-space slot with the iterator, not at GC time
-        self._workspace = None
+        self._workspace_res = None
 
     __del__ = close
+
+    @property
+    def _workspace(self):
+        # reset() after close() restarts the producer, so re-acquire the
+        # temp-space slot lazily instead of crashing on the released one
+        # (advisor r04: close-then-reuse must keep working)
+        if getattr(self, "_workspace_res", None) is None:
+            from . import resource as _resource
+            from . import context as _ctx
+            self._workspace_res = _resource.ResourceManager.get().request(
+                _ctx.cpu(0), _resource.ResourceRequest(
+                    _resource.ResourceRequest.kTempSpace))
+        return self._workspace_res
 
     def _read_raw(self):
         """Sequential record read (reader stage of the pipeline)."""

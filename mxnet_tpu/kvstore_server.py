@@ -226,5 +226,12 @@ def run_server():
     address, serve until a worker sends ``stop``."""
     host, port = ps_address()
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    server = KVStoreServer(host="", port=port, num_workers=num_workers)
+    # Bind narrowly by default (advisor r04: the wire protocol is a
+    # trusted-cluster one, so don't expose all interfaces gratuitously).
+    # The ADVERTISED address (DMLC_PS_ROOT_URI — what workers dial) may
+    # not be assignable on this host under NAT/port-mapping, so the bind
+    # host is a separate knob; set MXNET_PS_BIND_HOST="" to bind-all.
+    bind_host = os.environ.get("MXNET_PS_BIND_HOST", host)
+    server = KVStoreServer(host=bind_host, port=port,
+                           num_workers=num_workers)
     server.serve_forever()

@@ -138,9 +138,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     def _pvary(*xs):
         # carries become device-varying after the first ppermute, so the
         # initial values must be marked varying over the ring axis too
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(xs, (axis,), to="varying")
-        return jax.lax.pvary(xs, (axis,))
+        return jax.lax.pcast(xs, (axis,), to="varying")
 
     def per_shard_scan(qs, ks, vs):
         idx = jax.lax.axis_index(axis)
@@ -247,7 +245,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         return jax.lax.platform_dependent(
             qs, ks, vs, tpu=_ring_flash, default=per_shard_scan)
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     spec = P(None, None, axis, None)
     kw = {}
     if use_flash:
@@ -272,7 +270,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                       causal: bool = False, scale: Optional[float] = None):
     """Ulysses/DeepSpeed-style: all-to-all so each chip gets ALL sequence for
     a subset of heads, runs full attention locally, then all-to-alls back."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n = mesh.shape[axis]
 

@@ -106,7 +106,14 @@ bool FillShapeScratch(ND *h) {
         PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i))));
   }
   Py_DECREF(shp);
-  return !PyErr_Occurred();
+  if (PyErr_Occurred()) {
+    // fetch+clear the pending exception into MXGetLastError — leaving it
+    // set would poison the next CPython call (SystemError) and report a
+    // stale message here (advisor r04)
+    SetPyError("shape_of");
+    return false;
+  }
+  return true;
 }
 
 // Interned op-name table backing AtomicSymbolCreator values.  A failed

@@ -163,9 +163,9 @@ int main(void) {
   CHECK(MXNDArrayLoad("/tmp/c_api_test.params", &n_loaded, &loaded, &n_names,
                       &loaded_names) == 0);
   CHECK(n_loaded == 2 && n_names == 2);
-  CHECK(strcmp(loaded_names[0], "bias") == 0);   /* sorted names */
-  CHECK(strcmp(loaded_names[1], "weight") == 0);
-  CHECK(MXNDArraySyncCopyToCPU(loaded[1], back, 6) == 0);
+  CHECK(strcmp(loaded_names[0], "weight") == 0); /* save order kept */
+  CHECK(strcmp(loaded_names[1], "bias") == 0);
+  CHECK(MXNDArraySyncCopyToCPU(loaded[0], back, 6) == 0);
   for (int i = 0; i < 6; ++i) CHECK(back[i] == values[i]);
   MXNDArrayFree(loaded[0]);
   MXNDArrayFree(loaded[1]);

@@ -24,7 +24,7 @@ def bench_device_allreduce(size_mb: float, iters: int) -> float:
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     devs = jax.local_devices()
     n = len(devs)
