@@ -8,10 +8,18 @@ math — online-softmax with running max/sum — as ONE Pallas kernel per
 scores/accumulators never touch HBM.  Numerics match the scan
 formulation (f32 accumulation, running-max rescaling).
 
-Backward: a ``jax.custom_vjp`` whose reverse pass differentiates the XLA
-blockwise formulation (identical function values), so training code can
-call this transparently; the forward — the long-context memory
-bottleneck — runs the Pallas kernel.
+Backward (round 5): hand-written Pallas dq and dk/dv kernels — the
+standard two-pass flash backward.  The forward saves the per-row
+logsumexp ``lse = m + log(l)``; the backward recomputes probabilities
+on-core as ``p = exp(s - lse)`` (no score materialization in HBM, same
+as forward), computes ``delta = rowsum(dO * O)`` once in XLA, then:
+  dv_j = sum_i p_ij dO_i          (dk/dv kernel: grid over KV blocks,
+  dk_j = sum_i ds_ij q_i           loop over Q blocks)
+  dq_i = sum_j ds_ij k_j          (dq kernel: grid over Q blocks,
+                                   loop over KV blocks)
+with ``ds = p * (dp - delta) * scale``, ``dp = dO v^T``.  Both
+directions now run fused kernels — the reference's cuDNN precedent is
+fused-both-directions (/root/reference/src/operator/cudnn_rnn-inl.h:1).
 
 Used by ``parallel/ring_attention.blockwise_attention`` on TPU when
 ``MXNET_TPU_PALLAS_ATTN`` != "0" and K/V fit VMEM; larger shapes fall
@@ -28,7 +36,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "flash_attention_available",
-           "flash_attention_stats"]
+           "flash_attention_stats", "flash_attention_bwd"]
 
 INTERPRET = False
 
@@ -212,32 +220,224 @@ def flash_attention_stats(q, k, v, causal, scale, block_q=512,
             l[..., 0].reshape(B, H, Tq))
 
 
-def _xla_blockwise(q, k, v, causal, scale):
-    # import here to avoid a parallel<->ops import cycle at module load
-    from ..parallel.ring_attention import blockwise_attention
-    return blockwise_attention(q, k, v, causal=causal, scale=scale,
-                               use_pallas=False)
+def lse_of(m, l):
+    """logsumexp from online-softmax stats; +inf for fully-masked rows so
+    the backward's ``p = exp(s - lse)`` is exactly 0 there."""
+    return jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), jnp.inf)
+
+
+def pack_stats(lse, delta):
+    """(…,T) lse/delta -> one (…,T,128) f32 array for kernel input: lane 0
+    is lse, lane 1 is delta.  Mosaic wants the last two block dims
+    (8k, 128k)-aligned, so per-row scalars ride a 128-lane vector; packing
+    both into one array halves the HBM traffic vs two broadcasts."""
+    st = jnp.stack([lse, delta], axis=-1).astype(jnp.float32)
+    return jnp.pad(st, [(0, 0)] * (st.ndim - 1) + [(0, 126)])
+
+
+def _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k):
+    """Forward emitting (out, lse) — the residual-producing pass for the
+    custom VJP.  Same online-softmax loop; lse = m + log(l)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    TQ, BK = _pick_blocks(Tq, Tk, block_q, block_k)
+
+    def kern(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        m, l, acc = _online_softmax_loop(q_ref, k_ref, v_ref, TQ=TQ,
+                                         BK=BK, Tk=Tk, causal=causal,
+                                         scale=scale)
+        o_ref[0] = (acc / jnp.maximum(l, 1e-37)[:, None]).astype(
+            o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(lse_of(m, l)[:, None], (TQ, 128))
+
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(BH, Tq // TQ),
+        in_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, TQ, 128), lambda b, t: (b, t, 0)),
+        ],
+        out_shape=[
+            _out_sds((BH, Tq, D), q.dtype, q),
+            _out_sds((BH, Tq, 128), jnp.float32, q),
+        ],
+        interpret=INTERPRET,
+    )(q.reshape(BH, Tq, D), k.reshape(BH, Tk, D), v.reshape(BH, Tk, D))
+    return (out.reshape(B, H, Tq, D),
+            lse[..., 0].reshape(B, H, Tq))
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, st_ref, dq_ref, *, TQ, BK,
+               Tk, causal, scale):
+    """dq for one Q block: loop over KV blocks, recompute p from lse,
+    accumulate ds @ K in f32.  Causal: the loop stops at the last block
+    that intersects the diagonal (traced upper bound)."""
+    qi = pl.program_id(1)
+    qb = q_ref[0]                                    # (TQ, D)
+    dob = do_ref[0]
+    D = qb.shape[-1]
+    lse = st_ref[0, :, 0:1]                          # (TQ, 1)
+    delta = st_ref[0, :, 1:2]
+    q_pos = qi * TQ + jax.lax.broadcasted_iota(jnp.int32, (TQ, BK), 0)
+
+    def body(i, dq):
+        kblk = k_ref[0, pl.ds(i * BK, BK), :]
+        vblk = v_ref[0, pl.ds(i * BK, BK), :]
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (TQ, BK)
+        p = jnp.exp(s - lse)
+        if causal:
+            k_pos = i * BK + jax.lax.broadcasted_iota(
+                jnp.int32, (TQ, BK), 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            dob, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (TQ, BK)
+        ds = (p * (dp - delta) * scale).astype(kblk.dtype)
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    n_blocks = Tk // BK
+    if causal:
+        n_blocks = jnp.minimum(n_blocks,
+                               (qi * TQ + TQ + BK - 1) // BK)
+    dq_ref[0] = jax.lax.fori_loop(
+        0, n_blocks, body, jnp.zeros((TQ, D), jnp.float32))
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, st_ref, dk_ref, dv_ref, *,
+                TQ, BK, Tq, causal, scale):
+    """dk/dv for one KV block: loop over Q blocks.  Causal: start at the
+    first Q block that can see this KV block (traced lower bound)."""
+    ki = pl.program_id(1)
+    kb = k_ref[0]                                    # (BK, D)
+    vb = v_ref[0]
+    D = kb.shape[-1]
+    k_pos = ki * BK + jax.lax.broadcasted_iota(jnp.int32, (TQ, BK), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * TQ, TQ), :]
+        dob = do_ref[0, pl.ds(i * TQ, TQ), :]
+        lse = st_ref[0, pl.ds(i * TQ, TQ), 0:1]
+        delta = st_ref[0, pl.ds(i * TQ, TQ), 1:2]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (TQ, BK)
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = i * TQ + jax.lax.broadcasted_iota(
+                jnp.int32, (TQ, BK), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BK, D)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (TQ, BK)
+        ds = (p * (dp - delta) * scale).astype(qb.dtype)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BK, D)
+        return dk, dv
+
+    lo = (ki * BK) // TQ if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lo, Tq // TQ, body,
+        (jnp.zeros((BK, D), jnp.float32), jnp.zeros((BK, D), jnp.float32)))
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+
+def flash_attention_bwd(q, k, v, do, lse, delta, causal, scale,
+                        block_q=512, block_k=512):
+    """Pallas flash backward: (dq, dk, dv) in f32 (callers accumulating
+    across ring steps keep full precision; standalone callers cast).
+
+    q/k/v/do: [B,H,T,D]; lse/delta: [B,H,Tq] f32 (global logsumexp and
+    rowsum(dO*O) — for ring attention these are the FULL-sequence stats,
+    making each per-shard call an exact partial contribution)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    TQ, BK = _pick_blocks(Tq, Tk, block_q, block_k)
+    st = pack_stats(lse, delta).reshape(BH, Tq, 128)
+    q3 = q.reshape(BH, Tq, D)
+    k3 = k.reshape(BH, Tk, D)
+    v3 = v.reshape(BH, Tk, D)
+    do3 = do.reshape(BH, Tq, D)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, TQ=TQ, BK=BK, Tk=Tk, causal=causal,
+                          scale=scale),
+        grid=(BH, Tq // TQ),
+        in_specs=[
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, TQ, 128), lambda b, t: (b, t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TQ, D), lambda b, t: (b, t, 0)),
+        out_shape=_out_sds((BH, Tq, D), jnp.float32, q),
+        interpret=INTERPRET,
+    )(q3, k3, v3, do3, st)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, TQ=TQ, BK=BK, Tq=Tq, causal=causal,
+                          scale=scale),
+        grid=(BH, Tk // BK),
+        in_specs=[
+            pl.BlockSpec((1, Tq, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, Tq, D), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, 128), lambda b, t: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, t: (b, t, 0)),
+        ],
+        out_shape=[
+            _out_sds((BH, Tk, D), jnp.float32, q),
+            _out_sds((BH, Tk, D), jnp.float32, q),
+        ],
+        interpret=INTERPRET,
+    )(q3, k3, v3, do3, st)
+    shp = (B, H, Tq, D)
+    return (dq.reshape(shp), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
                     block_k=512):
-    """[B,H,T,D] attention; Pallas forward, XLA-recompute backward."""
+    """[B,H,T,D] attention; Pallas kernels both directions."""
     sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, causal, sc, block_q, block_k)
 
 
 def _fa_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    return (flash_attention(q, k, v, causal, scale, block_q, block_k),
-            (q, k, v))
+    sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_fwd_lse(q, k, v, causal, sc, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    _, vjp = jax.vjp(lambda a, b, c: _xla_blockwise(a, b, c, causal, sc),
-                     q, k, v)
-    return vjp(g)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq, dk, dv = flash_attention_bwd(q, k, v, g, lse, delta, causal, sc,
+                                     block_q, block_k)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 flash_attention.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)

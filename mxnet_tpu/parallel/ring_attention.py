@@ -128,8 +128,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     and fully-masked shards skip the kernel entirely (the classic ring
     load-saving).  The ring decomposition is also what makes the kernel
     APPLICABLE at long T: the VMEM gate sees the per-shard K/V (T/n),
-    not the full sequence.  Backward recomputes through the scan
-    formulation (same rematerialization policy as flash_attention).
+    not the full sequence.  Backward (round 5) runs the Pallas dq/dk/dv
+    kernels per shard against the forward's combined full-sequence
+    (out, lse): dq accumulates locally while dk/dv accumulators ride the
+    ring with their K/V shard — fused kernels in BOTH directions, like
+    the reference's cuDNN ops (src/operator/cudnn_rnn-inl.h:1).
     """
     n = mesh.shape[axis]
     D = q.shape[-1]
@@ -218,19 +221,76 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         (m, l, acc, _, _), _ = jax.lax.scan(body, (m0, l0, a0, ks, vs),
                                             jnp.arange(n))
         out = acc / jnp.maximum(l[..., None], 1e-37)
-        return out.astype(qs.dtype)
+        from ..ops import pallas_attention as pa
+        return out.astype(qs.dtype), pa.lse_of(m, l)
+
+    def per_shard_flash_bwd(qs, ks, vs, out, lse, g):
+        """Ring backward with the Pallas dq/dk/dv kernels (round 5).
+
+        The forward's combined (full-sequence) lse and out make each
+        per-shard ``flash_attention_bwd`` call an exact partial: summing
+        dq locally and carrying dk/dv accumulators around the ring WITH
+        their K/V shard yields the exact gradients after n steps (each
+        accumulator visits every Q shard once, then arrives home).
+        """
+        from ..ops import pallas_attention as pa
+        idx = jax.lax.axis_index(axis)
+        T_loc = qs.shape[2]
+        B, H, D = qs.shape[0], qs.shape[1], qs.shape[-1]
+        bs = block_size
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+
+        def bwd_full(kc, vc):
+            return pa.flash_attention_bwd(qs, kc, vc, g, lse, delta,
+                                          False, sc, bs, bs)
+
+        def bwd_diag(kc, vc):
+            return pa.flash_attention_bwd(qs, kc, vc, g, lse, delta,
+                                          True, sc, bs, bs)
+
+        def bwd_skip(kc, vc):
+            z = jnp.zeros((B, H, T_loc, D), jnp.float32)
+            return z, z, z
+
+        def body(carry, step):
+            dq, kcur, vcur, dka, dva = carry
+            if causal:
+                src = (idx - step) % n
+                mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+                dqi, dki, dvi = jax.lax.switch(
+                    mode, [bwd_full, bwd_diag, bwd_skip], kcur, vcur)
+            else:
+                dqi, dki, dvi = bwd_full(kcur, vcur)
+            dq = dq + dqi
+            dka = dka + dki
+            dva = dva + dvi
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            knext = jax.lax.ppermute(kcur, axis, perm)
+            vnext = jax.lax.ppermute(vcur, axis, perm)
+            dka = jax.lax.ppermute(dka, axis, perm)
+            dva = jax.lax.ppermute(dva, axis, perm)
+            return (dq, knext, vnext, dka, dva), None
+
+        z = jnp.zeros((B, H, T_loc, D), jnp.float32)
+        dq0, dka0, dva0 = _pvary(z, z, z)
+        (dq, _, _, dka, dva), _ = jax.lax.scan(
+            body, (dq0, ks, vs, dka0, dva0), jnp.arange(n))
+        return (dq.astype(qs.dtype), dka.astype(ks.dtype),
+                dva.astype(vs.dtype))
 
     @jax.custom_vjp
     def _ring_flash(qs, ks, vs):
-        return per_shard_flash(qs, ks, vs)
+        out, _ = per_shard_flash(qs, ks, vs)
+        return out
 
     def _rf_fwd(qs, ks, vs):
-        return _ring_flash(qs, ks, vs), (qs, ks, vs)
+        out, lse = per_shard_flash(qs, ks, vs)
+        return out, (qs, ks, vs, out, lse)
 
     def _rf_bwd(res, g):
-        qs, ks, vs = res
-        _, vjp = jax.vjp(per_shard_scan, qs, ks, vs)
-        return vjp(g)
+        qs, ks, vs, out, lse = res
+        return per_shard_flash_bwd(qs, ks, vs, out, lse, g)
 
     _ring_flash.defvjp(_rf_fwd, _rf_bwd)
 

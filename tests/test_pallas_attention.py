@@ -1,7 +1,10 @@
-"""Pallas flash-attention kernel vs the scan blockwise reference.
+"""Pallas flash-attention kernels vs the scan blockwise reference.
 
-Interpret mode on CPU (same jaxpr the TPU compiles); gradient path goes
-through the XLA-recompute VJP and must match differentiating the scan.
+Interpret mode on CPU (same jaxpr the TPU compiles).  Round 5: both
+directions are hand-written kernels — the backward runs the Pallas
+dq/dk/dv pair (p recomputed from saved lse, delta term, causal loop
+bounds) and must match differentiating the scan formulation and a dense
+XLA softmax reference.
 """
 import jax
 import jax.numpy as jnp
@@ -121,9 +124,11 @@ def test_ring_flash_matches_scan_and_reference(causal):
     np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
 
 
-def test_ring_flash_gradient_matches_scan():
-    """Backward recomputes through the scan formulation (custom VJP);
-    gradients must match differentiating the scan ring directly."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradient_matches_scan(causal):
+    """Round-5: the ring backward runs the Pallas dq/dk/dv kernels per
+    shard (dk/dv accumulators ride the ring with their K/V shard);
+    gradients must match differentiating the scan ring to 1e-5."""
     from mxnet_tpu.parallel import make_mesh
     from mxnet_tpu.parallel.ring_attention import ring_attention
     r = np.random.default_rng(1)
@@ -131,6 +136,56 @@ def test_ring_flash_gradient_matches_scan():
     q, k, v = (jnp.asarray(r.standard_normal((B, H, T, D)) * 0.5,
                            jnp.float32) for _ in range(3))
     mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+
+    def loss(use_pallas):
+        def f(q, k, v):
+            out = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                                 block_size=128, use_pallas=use_pallas)
+            return jnp.sum(out ** 2)
+        return f
+
+    gp = jax.grad(loss(True), (0, 1, 2))(q, k, v)
+    gs = jax.grad(loss(False), (0, 1, 2))(q, k, v)
+    for a, b, nme in zip(gp, gs, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5, err_msg=nme)
+
+
+def test_flash_bwd_kernel_exact_vs_dense():
+    """flash_attention grads vs a dense softmax reference differentiated
+    by XLA — pins the dq/dk/dv kernel math (p from lse, delta term,
+    causal bounds) independently of the scan formulation."""
+    q, k, v = _case(B=1, H=2, T=128, D=16, seed=7)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+        mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    co = jnp.asarray(np.random.default_rng(9).standard_normal(
+        q.shape), jnp.float32)
+    gp = jax.grad(lambda *a: jnp.vdot(
+        pa.flash_attention(*a, True, None, 32, 32), co), (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.vdot(dense(*a), co), (0, 1, 2))(q, k, v)
+    for a, b, nme in zip(gp, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5, err_msg=nme)
+
+
+def test_ring_flash_bwd_8way_mesh():
+    """The done-criterion shape: 8-way virtual mesh, grads vs the scan
+    ring to <=1e-5 rel (VERDICT r4 item 1)."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    r = np.random.default_rng(2)
+    B, H, T, D = 2, 2, 1024, 16
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, D)) * 0.5,
+                           jnp.float32) for _ in range(3))
+    mesh = make_mesh({"sp": 8})
 
     def loss(use_pallas):
         def f(q, k, v):
@@ -143,4 +198,4 @@ def test_ring_flash_gradient_matches_scan():
     gs = jax.grad(loss(False), (0, 1, 2))(q, k, v)
     for a, b, nme in zip(gp, gs, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-4, atol=1e-4, err_msg=nme)
+                                   rtol=1e-5, atol=1e-5, err_msg=nme)
