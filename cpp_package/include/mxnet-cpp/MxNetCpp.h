@@ -6,5 +6,8 @@
 
 #include "ndarray.hpp"
 #include "operator.hpp"
+#include "symbol.hpp"
+#include "executor.hpp"
+#include "op.h"
 
 #endif  // MXNET_CPP_MXNETCPP_H_

@@ -1,0 +1,114 @@
+// Header-only C++ frontend: Executor (reference parity: cpp-package/
+// include/mxnet-cpp/executor.h — bound computation over the C waist's
+// MXExecutor* section).  Forward/Backward with gradients written into the
+// bound grad arrays in place (GraphExecutor contract).
+#ifndef MXNET_CPP_EXECUTOR_HPP_
+#define MXNET_CPP_EXECUTOR_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+#include "symbol.hpp"
+
+namespace mxnet {
+namespace cpp {
+
+class Executor {
+ public:
+  Executor(const Symbol &symbol, const Context &ctx,
+           const std::vector<NDArray> &arg_arrays,
+           const std::vector<NDArray> &grad_arrays,
+           const std::vector<mx_uint> &grad_reqs,
+           const std::vector<NDArray> &aux_arrays)
+      : arg_arrays(arg_arrays), grad_arrays(grad_arrays),
+        aux_arrays(aux_arrays) {
+    std::vector<NDArrayHandle> args, grads, auxs;
+    for (const auto &a : arg_arrays) args.push_back(a.GetHandle());
+    for (const auto &g : grad_arrays) {
+      grads.push_back(g.IsNone() ? nullptr : g.GetHandle());
+    }
+    for (const auto &a : aux_arrays) auxs.push_back(a.GetHandle());
+    std::vector<mx_uint> reqs = grad_reqs;
+    reqs.resize(args.size(), 0);
+    if (grads.size() < args.size()) grads.resize(args.size(), nullptr);
+    Check(MXExecutorBind(symbol.GetHandle(), ctx.dev_type, ctx.dev_id,
+                         static_cast<mx_uint>(args.size()), args.data(),
+                         grads.data(), reqs.data(),
+                         static_cast<mx_uint>(auxs.size()), auxs.data(),
+                         &handle_));
+  }
+
+  ~Executor() {
+    if (handle_ != nullptr) MXExecutorFree(handle_);
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+    RefreshOutputs();
+  }
+
+  // head_grads empty: ones-like head gradients (loss heads).
+  void Backward(const std::vector<NDArray> &head_grads =
+                    std::vector<NDArray>()) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &h : head_grads) hs.push_back(h.GetHandle());
+    Check(MXExecutorBackward(handle_, static_cast<mx_uint>(hs.size()),
+                             hs.data()));
+  }
+
+  // Outputs of the last Forward (refreshed per call).
+  std::vector<NDArray> outputs;
+  std::vector<NDArray> arg_arrays;
+  std::vector<NDArray> grad_arrays;
+  std::vector<NDArray> aux_arrays;
+
+ private:
+  void RefreshOutputs() {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(handle_, &n, &outs));
+    outputs.clear();
+    for (mx_uint i = 0; i < n; ++i) outputs.emplace_back(outs[i]);
+  }
+  ExecutorHandle handle_ = nullptr;
+};
+
+inline Executor *Symbol::Bind(const Context &ctx,
+                              const std::vector<NDArray> &arg_arrays,
+                              const std::vector<NDArray> &grad_arrays,
+                              const std::vector<mx_uint> &grad_reqs,
+                              const std::vector<NDArray> &aux_arrays) const {
+  return new Executor(*this, ctx, arg_arrays, grad_arrays, grad_reqs,
+                      aux_arrays);
+}
+
+inline Executor *Symbol::SimpleBind(
+    const Context &ctx,
+    const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+    mx_uint grad_req) const {
+  std::vector<std::vector<mx_uint>> in_sh, out_sh, aux_sh;
+  InferShape(input_shapes, &in_sh, &out_sh, &aux_sh);
+  std::vector<std::string> arg_names = ListArguments();
+  std::vector<NDArray> args, grads, auxs;
+  std::vector<mx_uint> reqs;
+  for (size_t i = 0; i < in_sh.size(); ++i) {
+    args.emplace_back(in_sh[i], ctx);
+    // inputs the caller feeds per batch get no gradient storage
+    bool is_input = input_shapes.count(arg_names[i]) != 0;
+    grads.emplace_back(is_input ? NDArray() : NDArray(in_sh[i], ctx));
+    reqs.push_back(is_input ? 0 : grad_req);
+  }
+  for (const auto &s : aux_sh) auxs.emplace_back(s, ctx);
+  return new Executor(*this, ctx, args, grads, reqs, auxs);
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_EXECUTOR_HPP_

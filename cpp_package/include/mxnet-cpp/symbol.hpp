@@ -1,0 +1,214 @@
+// Header-only C++ frontend: Symbol (reference parity: cpp-package/
+// include/mxnet-cpp/symbol.h — declarative graph construction over the C
+// waist's MXSymbol* section, SURVEY.md §2.4).  Build graphs with
+// Symbol::Variable + Operator-style composition (or the generated op.h
+// wrappers), inspect them, round-trip JSON, infer shapes, and Bind into
+// an Executor for training.
+#ifndef MXNET_CPP_SYMBOL_HPP_
+#define MXNET_CPP_SYMBOL_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxnet {
+namespace cpp {
+
+class Executor;  // executor.hpp
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle handle) : handle_(handle, &Symbol::Release) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  SymbolHandle GetHandle() const { return handle_.get(); }
+  bool IsNone() const { return handle_ == nullptr; }
+
+  std::string GetName() const {
+    const char *out = nullptr;
+    int ok = 0;
+    Check(MXSymbolGetName(handle_.get(), &out, &ok));
+    return ok ? std::string(out) : std::string();
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return List(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return List(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return List(&MXSymbolListAuxiliaryStates);
+  }
+
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    Check(MXSymbolSaveToJSON(handle_.get(), &js));
+    return std::string(js);
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  Symbol Copy() const {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCopy(handle_.get(), &h));
+    return Symbol(h);
+  }
+
+  // Shape inference from named input shapes; fills the three sections in
+  // ListArguments / ListOutputs / ListAuxiliaryStates order.
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &arg_shapes,
+      std::vector<std::vector<mx_uint>> *in_shape,
+      std::vector<std::vector<mx_uint>> *out_shape,
+      std::vector<std::vector<mx_uint>> *aux_shape) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind_ptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : arg_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      ind_ptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_sz = 0, out_sz = 0, aux_sz = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_sh = nullptr, **out_sh = nullptr, **aux_sh = nullptr;
+    int complete = 0;
+    Check(MXSymbolInferShape(handle_.get(),
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             ind_ptr.data(), data.data(), &in_sz, &in_nd,
+                             &in_sh, &out_sz, &out_nd, &out_sh, &aux_sz,
+                             &aux_nd, &aux_sh, &complete));
+    auto fill = [](std::vector<std::vector<mx_uint>> *dst, mx_uint n,
+                   const mx_uint *nd, const mx_uint **sh) {
+      if (dst == nullptr) return;
+      dst->clear();
+      for (mx_uint i = 0; i < n; ++i) {
+        dst->emplace_back(sh[i], sh[i] + nd[i]);
+      }
+    };
+    fill(in_shape, in_sz, in_nd, in_sh);
+    fill(out_shape, out_sz, out_nd, out_sh);
+    fill(aux_shape, aux_sz, aux_nd, aux_sh);
+  }
+
+  // Bind with positional arrays (ListArguments order).  Gradients land in
+  // grad_arrays in place after Executor::Backward.  Defined in
+  // executor.hpp (needs the full Executor type).
+  inline Executor *Bind(const Context &ctx,
+                        const std::vector<NDArray> &arg_arrays,
+                        const std::vector<NDArray> &grad_arrays,
+                        const std::vector<mx_uint> &grad_reqs,
+                        const std::vector<NDArray> &aux_arrays =
+                            std::vector<NDArray>()) const;
+
+  // SimpleBind: infer every shape from the given inputs, allocate args /
+  // grads / aux, bind.  Defined in executor.hpp.
+  inline Executor *SimpleBind(
+      const Context &ctx,
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+      mx_uint grad_req = 1) const;
+
+ private:
+  using ListFn = int (*)(SymbolHandle, mx_uint *, const char ***);
+  std::vector<std::string> List(ListFn fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(handle_.get(), &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  static void Release(SymbolHandle h) {
+    if (h != nullptr) MXSymbolFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+// Builder for symbolic op nodes (the cpp-package Operator::CreateSymbol
+// role): Op("Convolution").SetParam("kernel", ...).SetInput("data", x)
+// .CreateSymbol("conv1").  The generated op.h wrappers ride this.
+class SymbolBuilder {
+ public:
+  explicit SymbolBuilder(const std::string &op_name) : op_name_(op_name) {}
+
+  template <typename T>
+  SymbolBuilder &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    param_keys_.push_back(key);
+    param_vals_.push_back(os.str());
+    return *this;
+  }
+
+  SymbolBuilder &SetInput(const std::string &arg_name, const Symbol &s) {
+    if (!s.IsNone()) {
+      input_keys_.push_back(arg_name);
+      inputs_.push_back(s.GetHandle());
+    }
+    return *this;
+  }
+
+  SymbolBuilder &AddInput(const Symbol &s) {   // positional (variadic ops)
+    inputs_.push_back(s.GetHandle());
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name = "") {
+    // creator lookup by name (the table is interned in the library)
+    mx_uint n = 0;
+    AtomicSymbolCreator *cs = nullptr;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &cs));
+    AtomicSymbolCreator creator = nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *nm = nullptr;
+      MXSymbolGetAtomicSymbolName(cs[i], &nm);
+      if (nm != nullptr && op_name_ == nm) {
+        creator = cs[i];
+        break;
+      }
+    }
+    if (creator == nullptr) {
+      throw std::runtime_error("unknown operator " + op_name_);
+    }
+    std::vector<const char *> pk, pv;
+    for (auto &s : param_keys_) pk.push_back(s.c_str());
+    for (auto &s : param_vals_) pv.push_back(s.c_str());
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(
+        creator, static_cast<mx_uint>(pk.size()), pk.data(), pv.data(), &h));
+    Symbol sym(h);
+    std::vector<const char *> ik;
+    for (auto &s : input_keys_) ik.push_back(s.c_str());
+    bool keyword = input_keys_.size() == inputs_.size() &&
+                   !input_keys_.empty();
+    Check(MXSymbolCompose(h, name.empty() ? nullptr : name.c_str(),
+                          static_cast<mx_uint>(inputs_.size()),
+                          keyword ? ik.data() : nullptr, inputs_.data()));
+    return sym;
+  }
+
+ private:
+  std::string op_name_;
+  std::vector<std::string> param_keys_, param_vals_, input_keys_;
+  std::vector<SymbolHandle> inputs_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_SYMBOL_HPP_

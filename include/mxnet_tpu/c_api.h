@@ -23,6 +23,8 @@ extern "C" {
 typedef uint32_t mx_uint;
 typedef void *NDArrayHandle;
 typedef void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
 
 #ifndef MXNET_DLL
 #define MXNET_DLL
@@ -102,6 +104,76 @@ MXNET_DLL int MXAutogradBackward(mx_uint num_output,
                                  NDArrayHandle *output_handles,
                                  int retain_graph);
 MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ---- Part 3: symbol (reference c_api.h:1028) ---------------------------- */
+/* Create an op node with string attrs; inputs arrive via MXSymbolCompose. */
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* Fill a symbol's inputs (positional when keys==NULL, by arg name
+ * otherwise).  Mutates `sym` in place, like the reference. */
+MXNET_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXNET_DLL int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+MXNET_DLL int MXSymbolFree(SymbolHandle sym);
+MXNET_DLL int MXSymbolGetName(SymbolHandle sym, const char **out,
+                              int *success);
+/* Returned string arrays live until the next MXSymbolList* on the handle. */
+MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                                    const char ***out_array);
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                                  const char ***out_array);
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                          const char ***out_array);
+/* JSON lives until the next SaveToJSON on the handle. */
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+/* Op introspection (feeds cpp-package wrapper generation): arg_names carries
+ * tensor inputs (type "NDArray-or-Symbol") then params (type string with
+ * ", required"/", optional" suffix, dmlc::Parameter style).  key_var_num_args
+ * is "num_args" for variadic ops, "" otherwise. */
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                          const char **name,
+                                          const char **description,
+                                          mx_uint *num_args,
+                                          const char ***arg_names,
+                                          const char ***arg_type_infos,
+                                          const char ***arg_descriptions,
+                                          const char **key_var_num_args);
+/* Shape inference.  Input shapes arrive CSR-style: keys[i] names an
+ * argument, its shape is arg_shape_data[arg_ind_ptr[i] .. arg_ind_ptr[i+1]).
+ * Returned arrays (ndim + per-shape data pointers, for args/outputs/aux in
+ * list_arguments/list_outputs/list_auxiliary_states order) live until the
+ * next InferShape on the handle.  complete=1 when every shape is known. */
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data,
+    mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+    const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+
+/* ---- Part 4: executor (reference c_api.h:1483) -------------------------- */
+/* grad_req_type per arg: 0=null 1=write 2=inplace(=write) 3=add.  Gradients
+ * are written INTO arg_grad_store's arrays in place after Backward; entries
+ * may be NULL when the matching req is 0. */
+MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+/* Output handle array lives until the next Outputs call on the handle;
+ * handles are caller-owned (free each). */
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -201,3 +201,190 @@ def get_grad(handle: NDArray) -> NDArray:
     if g is None:
         raise MXNetError("array has no gradient (call mark_variables first)")
     return g
+
+
+# ---- symbol (c_api.h Part 3: MXSymbol*, reference c_api.h:1028) -----------
+
+class _AtomicSymbol:
+    """An op + attrs awaiting composition — the reference's
+    MXSymbolCreateAtomicSymbol result before MXSymbolCompose fills the
+    inputs (nnvm Symbol::CreateFunctor analog)."""
+
+    __slots__ = ("op_name", "attrs")
+
+    def __init__(self, op_name: str, attrs: Dict[str, str]):
+        if op_name not in _registry.OPS:
+            raise MXNetError("unknown operator %r" % op_name)
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def symbol_create_atomic(op_name: str, keys: Sequence[str],
+                         vals: Sequence[str]):
+    """MXSymbolCreateAtomicSymbol: op + string attrs, inputs come later
+    via compose."""
+    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+
+
+def symbol_create_variable(name: str):
+    """MXSymbolCreateVariable."""
+    from . import symbol as sym_mod
+    return sym_mod.var(name)
+
+
+def symbol_compose(handle, name: str, keys: Sequence[str], args):
+    """MXSymbolCompose: fill an atomic symbol's inputs (positional when
+    ``keys`` is empty, by arg name otherwise).  Returns the composed
+    Symbol — the C side swaps it into the same handle (the reference
+    mutates the nnvm symbol in place)."""
+    from . import symbol as sym_mod
+    if isinstance(handle, _AtomicSymbol):
+        op = _registry.OPS[handle.op_name]
+        fn = getattr(sym_mod, handle.op_name)
+        kwargs = dict(handle.attrs)
+        if name:
+            kwargs["name"] = name
+        if keys:
+            known = set(op.arg_names or [])
+            for k in keys:
+                # reference contract: keyword args must name declared
+                # inputs ("Keyword argument name not found")
+                if known and k not in known:
+                    raise MXNetError(
+                        "compose %s: keyword argument %r is not an input "
+                        "(have %s)" % (handle.op_name, k, sorted(known)))
+            kwargs.update(zip(keys, args))
+            return fn(**kwargs)
+        return fn(*args, **kwargs)
+    # composing a full symbol substitutes its free variables
+    if keys:
+        handle(**dict(zip(keys, args)))
+    else:
+        handle(*args)
+    return handle
+
+
+def symbol_copy(handle):
+    """MXSymbolCopy (deep copy via the JSON round-trip — node names are
+    preserved, so bindings stay compatible)."""
+    from . import symbol as sym_mod
+    return sym_mod.load_json(handle.tojson())
+
+
+def symbol_list_arguments(handle) -> List[str]:
+    if isinstance(handle, _AtomicSymbol):
+        return []
+    return list(handle.list_arguments())
+
+
+def symbol_list_outputs(handle) -> List[str]:
+    if isinstance(handle, _AtomicSymbol):
+        return []
+    return list(handle.list_outputs())
+
+
+def symbol_list_aux(handle) -> List[str]:
+    if isinstance(handle, _AtomicSymbol):
+        return []
+    return list(handle.list_auxiliary_states())
+
+
+def symbol_get_name(handle) -> str:
+    if isinstance(handle, _AtomicSymbol):
+        return ""
+    return handle.name or ""
+
+
+def symbol_tojson(handle) -> str:
+    return handle.tojson()
+
+
+def symbol_from_json(js: str):
+    from . import symbol as sym_mod
+    return sym_mod.load_json(js)
+
+
+def symbol_infer_shape(handle, keys: Sequence[str], shapes,
+                       partial: int = 0):
+    """MXSymbolInferShape(Partial) -> (arg_shapes, out_shapes, aux_shapes)
+    as lists of int tuples, ordered like list_arguments/outputs/aux."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    if partial:
+        a, o, x = handle.infer_shape_partial(**kwargs)
+    else:
+        a, o, x = handle.infer_shape(**kwargs)
+    conv = lambda ss: [tuple(int(d) for d in (s or ())) for s in ss]
+    return conv(a), conv(o), conv(x)
+
+
+def op_info(op_name: str):
+    """MXSymbolGetAtomicSymbolInfo: (description, input arg names,
+    param names, param type strings, required flags) — feeds both the C
+    introspection call and the cpp-package wrapper generator."""
+    op = _registry.OPS[op_name]
+    arg_names = list(op.arg_names or [])
+    if not arg_names and op.nin not in (None, -1):
+        arg_names = ["data%d" % i for i in range(op.nin)] \
+            if op.nin > 1 else ["data"]
+    pnames, ptypes, preq = [], [], []
+    for k, spec in op.params.items():
+        if k.startswith("__"):
+            continue
+        pnames.append(k)
+        t = spec.ptype
+        if isinstance(t, (list, tuple)):        # enum of string choices
+            ptypes.append("{%s}" % ",".join("'%s'" % c for c in t))
+        else:
+            ptypes.append(t if isinstance(t, str) else t.__name__)
+        preq.append(1 if spec.required else 0)
+    # key_var_num_args marks ops taking a homogeneous variadic input list:
+    # either declared via a literal num_args param (Concat style) or
+    # nin==-1 with no named args (add_n/khatri_rao style) — NOT merely
+    # optional trailing inputs like FullyConnected's bias (which has
+    # arg_names and therefore a fixed wrapper signature)
+    variadic = "num_args" in op.params or (op.nin == -1 and not arg_names)
+    return (op.doc or "", arg_names, pnames, ptypes, preq,
+            1 if variadic else 0)
+
+
+# ---- executor (c_api.h Part 4: MXExecutor*, reference c_api.h:1483) -------
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}  # OpReqType
+
+
+def executor_bind(handle, dev_type: int, dev_id: int,
+                  arg_handles, grad_handles, grad_req_codes,
+                  aux_handles):
+    """MXExecutorBind: positional arrays ordered like list_arguments /
+    list_auxiliary_states; grad storage handles may contain None (grad_req
+    null).  Gradients are written INTO the supplied grad arrays in place,
+    so the caller's handles observe them (reference GraphExecutor
+    contract)."""
+    arg_names = handle.list_arguments()
+    aux_names = handle.list_auxiliary_states()
+    if len(arg_handles) != len(arg_names):
+        raise MXNetError("bind: %d args given, symbol has %d (%s)"
+                         % (len(arg_handles), len(arg_names), arg_names))
+    if len(aux_handles) != len(aux_names):
+        raise MXNetError("bind: %d aux given, symbol has %d"
+                         % (len(aux_handles), len(aux_names)))
+    args = dict(zip(arg_names, arg_handles))
+    req = {n: _GRAD_REQ.get(int(c), "null")
+           for n, c in zip(arg_names, grad_req_codes)}
+    grads = {n: g for n, g in zip(arg_names, grad_handles)
+             if g is not None and req.get(n) != "null"}
+    auxs = dict(zip(aux_names, aux_handles))
+    return handle.bind(_ctx(dev_type, dev_id), args=args, args_grad=grads,
+                       grad_req=req, aux_states=auxs)
+
+
+def executor_forward(ex, is_train: int):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_outputs(ex) -> List[NDArray]:
+    return list(ex.outputs)
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(out_grads=list(head_grads) if head_grads else None)

@@ -28,6 +28,8 @@
 typedef uint32_t mx_uint;
 typedef void *NDArrayHandle;
 typedef void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
 
 #define MXNET_DLL extern "C" __attribute__((visibility("default")))
 
@@ -520,6 +522,425 @@ MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
   PyObject *obj = CallBridge("get_grad", Py_BuildValue("(O)", ObjOf(handle)));
   if (obj == nullptr) return -1;
   *out = WrapND(obj);
+  return 0;
+}
+
+// ---- Part 3: symbol (reference c_api.h:1028, src/c_api/c_api_symbolic.cc) --
+//
+// A SymbolHandle owns one bridge Symbol (or pending _AtomicSymbol) plus the
+// per-handle return scratch for string lists / JSON / inferred shapes, so
+// concurrent handles never stomp each other (MXAPIThreadLocalEntry role).
+
+namespace {
+
+struct Sym {
+  PyObject *obj = nullptr;
+  std::vector<std::string> strs;
+  std::vector<const char *> ptrs;
+  std::string json;
+  std::string name;   // GetName scratch — must not clobber the JSON one
+  // InferShape scratch: flat dims + ndim + per-shape pointers, 3 sections
+  std::vector<mx_uint> shape_dims[3];
+  std::vector<mx_uint> shape_ndim[3];
+  std::vector<const mx_uint *> shape_ptr[3];
+  ~Sym() {
+    if (obj != nullptr) {
+      GILGuard gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+PyObject *SymObj(SymbolHandle h) { return static_cast<Sym *>(h)->obj; }
+
+// Fill a handle's (strs, ptrs) scratch from a PyList[str]; returns false
+// with the error set on a non-list / non-str payload.
+bool FillStrList(Sym *h, PyObject *list) {
+  h->strs.clear();
+  h->ptrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (s == nullptr) { SetPyError("string list"); return false; }
+    h->strs.emplace_back(s);
+  }
+  for (auto &s : h->strs) h->ptrs.push_back(s.c_str());
+  return true;
+}
+
+int SymbolListCommon(const char *bridge_fn, SymbolHandle sym,
+                     mx_uint *out_size, const char ***out_array) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Sym *h = static_cast<Sym *>(sym);
+  PyObject *r = CallBridge(bridge_fn, Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  bool ok = FillStrList(h, r);
+  Py_DECREF(r);
+  if (!ok) return -1;
+  *out_size = static_cast<mx_uint>(h->ptrs.size());
+  *out_array = h->ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals,
+                                         SymbolHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  const std::string *op = static_cast<std::string *>(creator);
+  PyObject *pk = PyList_New(num_param);
+  PyObject *pv = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(pk, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pv, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *obj = CallBridge("symbol_create_atomic", Py_BuildValue(
+      "(sNN)", op->c_str(), pk, pv));
+  if (obj == nullptr) return -1;
+  Sym *h = new Sym();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *obj = CallBridge("symbol_create_variable",
+                             Py_BuildValue("(s)", name));
+  if (obj == nullptr) return -1;
+  Sym *h = new Sym();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Sym *h = static_cast<Sym *>(sym);
+  PyObject *pk = PyList_New(keys ? num_args : 0);
+  PyObject *pa = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    if (keys) PyList_SET_ITEM(pk, i, PyUnicode_FromString(keys[i]));
+    PyObject *o = SymObj(args[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(pa, i, o);
+  }
+  PyObject *composed = CallBridge("symbol_compose", Py_BuildValue(
+      "(OsNN)", h->obj, name ? name : "", pk, pa));
+  if (composed == nullptr) return -1;
+  // reference semantics: the same handle becomes the composed symbol
+  Py_DECREF(h->obj);
+  h->obj = composed;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *obj = CallBridge("symbol_copy", Py_BuildValue("(O)", SymObj(sym)));
+  if (obj == nullptr) return -1;
+  Sym *h = new Sym();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolFree(SymbolHandle sym) {
+  delete static_cast<Sym *>(sym);
+  return 0;
+}
+
+MXNET_DLL int MXSymbolGetName(SymbolHandle sym, const char **out,
+                              int *success) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Sym *h = static_cast<Sym *>(sym);
+  PyObject *r = CallBridge("symbol_get_name", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  const char *s = PyUnicode_AsUTF8(r);
+  h->name.assign(s ? s : "");
+  Py_DECREF(r);
+  *out = h->name.c_str();
+  if (success) *success = h->name.empty() ? 0 : 1;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                                    const char ***out_array) {
+  return SymbolListCommon("symbol_list_arguments", sym, out_size, out_array);
+}
+
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                                  const char ***out_array) {
+  return SymbolListCommon("symbol_list_outputs", sym, out_size, out_array);
+}
+
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                          const char ***out_array) {
+  return SymbolListCommon("symbol_list_aux", sym, out_size, out_array);
+}
+
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Sym *h = static_cast<Sym *>(sym);
+  PyObject *r = CallBridge("symbol_tojson", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  const char *s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) { Py_DECREF(r); SetPyError("tojson"); return -1; }
+  h->json.assign(s);
+  Py_DECREF(r);
+  *out_json = h->json.c_str();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *obj = CallBridge("symbol_from_json", Py_BuildValue("(s)", json));
+  if (obj == nullptr) return -1;
+  Sym *h = new Sym();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                          const char **name,
+                                          const char **description,
+                                          mx_uint *num_args,
+                                          const char ***arg_names,
+                                          const char ***arg_type_infos,
+                                          const char ***arg_descriptions,
+                                          const char **key_var_num_args) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  const std::string *op = static_cast<std::string *>(creator);
+  PyObject *r = CallBridge("op_info", Py_BuildValue("(s)", op->c_str()));
+  if (r == nullptr) return -1;
+  // scratch lives until the next GetAtomicSymbolInfo on this thread
+  struct InfoScratch {
+    std::string doc, kv;
+    std::vector<std::string> names, types;
+    std::vector<const char *> name_ptrs, type_ptrs, desc_ptrs;
+  };
+  static thread_local InfoScratch sc;
+  sc.names.clear(); sc.types.clear();
+  sc.name_ptrs.clear(); sc.type_ptrs.clear(); sc.desc_ptrs.clear();
+  const char *doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  sc.doc.assign(doc ? doc : "");
+  PyObject *tensor_args = PyTuple_GetItem(r, 1);
+  PyObject *pnames = PyTuple_GetItem(r, 2);
+  PyObject *ptypes = PyTuple_GetItem(r, 3);
+  PyObject *preq = PyTuple_GetItem(r, 4);
+  long variadic = PyLong_AsLong(PyTuple_GetItem(r, 5));
+  for (Py_ssize_t i = 0; i < PyList_Size(tensor_args); ++i) {
+    sc.names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(tensor_args, i)));
+    sc.types.emplace_back("NDArray-or-Symbol");
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(pnames); ++i) {
+    sc.names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(pnames, i)));
+    std::string t = PyUnicode_AsUTF8(PyList_GetItem(ptypes, i));
+    t += PyLong_AsLong(PyList_GetItem(preq, i)) ? ", required"
+                                                : ", optional";
+    sc.types.emplace_back(t);
+  }
+  Py_DECREF(r);
+  for (size_t i = 0; i < sc.names.size(); ++i) {
+    sc.name_ptrs.push_back(sc.names[i].c_str());
+    sc.type_ptrs.push_back(sc.types[i].c_str());
+    sc.desc_ptrs.push_back("");
+  }
+  sc.kv = variadic ? "num_args" : "";
+  *name = op->c_str();
+  *description = sc.doc.c_str();
+  *num_args = static_cast<mx_uint>(sc.names.size());
+  *arg_names = sc.name_ptrs.data();
+  *arg_type_infos = sc.type_ptrs.data();
+  *arg_descriptions = sc.desc_ptrs.data();
+  *key_var_num_args = sc.kv.c_str();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data,
+    mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+    const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Sym *h = static_cast<Sym *>(sym);
+  PyObject *pk = PyList_New(num_args);
+  PyObject *ps = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pk, i, PyUnicode_FromString(keys[i]));
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(ps, i, shp);
+  }
+  PyObject *r = CallBridge("symbol_infer_shape", Py_BuildValue(
+      "(ONNi)", h->obj, pk, ps, 0));
+  if (r == nullptr) return -1;
+  bool all_known = true;
+  for (int sec = 0; sec < 3; ++sec) {
+    PyObject *shapes = PyTuple_GetItem(r, sec);
+    auto &dims = h->shape_dims[sec];
+    auto &ndim = h->shape_ndim[sec];
+    auto &ptr = h->shape_ptr[sec];
+    dims.clear(); ndim.clear(); ptr.clear();
+    Py_ssize_t n = PyList_Size(shapes);
+    std::vector<size_t> offs;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PyList_GetItem(shapes, i);
+      Py_ssize_t nd = PyTuple_Size(shp);
+      if (nd == 0) all_known = false;
+      ndim.push_back(static_cast<mx_uint>(nd));
+      offs.push_back(dims.size());
+      for (Py_ssize_t j = 0; j < nd; ++j) {
+        dims.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+      }
+    }
+    for (size_t i = 0; i < offs.size(); ++i) {
+      ptr.push_back(dims.data() + offs[i]);   // stable: dims is final
+    }
+  }
+  Py_DECREF(r);
+  *in_shape_size = static_cast<mx_uint>(h->shape_ndim[0].size());
+  *in_shape_ndim = h->shape_ndim[0].data();
+  *in_shape_data = h->shape_ptr[0].data();
+  *out_shape_size = static_cast<mx_uint>(h->shape_ndim[1].size());
+  *out_shape_ndim = h->shape_ndim[1].data();
+  *out_shape_data = h->shape_ptr[1].data();
+  *aux_shape_size = static_cast<mx_uint>(h->shape_ndim[2].size());
+  *aux_shape_ndim = h->shape_ndim[2].data();
+  *aux_shape_data = h->shape_ptr[2].data();
+  if (complete) *complete = all_known ? 1 : 0;
+  return 0;
+}
+
+// ---- Part 4: executor (reference c_api.h:1483, c_api_executor.cc) ---------
+
+namespace {
+
+struct Exec {
+  PyObject *obj = nullptr;
+  std::vector<NDArrayHandle> out_handles;
+  ~Exec() {
+    if (obj != nullptr) {
+      GILGuard gil;
+      Py_DECREF(obj);
+    }
+  }
+};
+
+}  // namespace
+
+MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *pargs = PyList_New(len);
+  PyObject *pgrads = PyList_New(len);
+  PyObject *preq = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *a = ObjOf(in_args[i]);
+    Py_INCREF(a);
+    PyList_SET_ITEM(pargs, i, a);
+    PyObject *g = Py_None;
+    if (arg_grad_store != nullptr && arg_grad_store[i] != nullptr) {
+      g = ObjOf(arg_grad_store[i]);
+    }
+    Py_INCREF(g);
+    PyList_SET_ITEM(pgrads, i, g);
+    PyList_SET_ITEM(preq, i, PyLong_FromUnsignedLong(
+        grad_req_type ? grad_req_type[i] : 0));
+  }
+  PyObject *paux = PyList_New(aux_states_len);
+  for (mx_uint i = 0; i < aux_states_len; ++i) {
+    PyObject *a = ObjOf(aux_states[i]);
+    Py_INCREF(a);
+    PyList_SET_ITEM(paux, i, a);
+  }
+  PyObject *obj = CallBridge("executor_bind", Py_BuildValue(
+      "(OiiNNNN)", SymObj(sym), dev_type, dev_id, pargs, pgrads, preq,
+      paux));
+  if (obj == nullptr) return -1;
+  Exec *h = new Exec();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *r = CallBridge("executor_forward", Py_BuildValue(
+      "(Oi)", static_cast<Exec *>(handle)->obj, is_train));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  PyObject *heads = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *o = ObjOf(head_grads[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(heads, i, o);
+  }
+  PyObject *r = CallBridge("executor_backward", Py_BuildValue(
+      "(ON)", static_cast<Exec *>(handle)->obj, heads));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out) {
+  if (!EnsurePython()) { SetError("python init failed"); return -1; }
+  GILGuard gil;
+  Exec *h = static_cast<Exec *>(handle);
+  PyObject *r = CallBridge("executor_outputs",
+                           Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  h->out_handles.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    h->out_handles.push_back(WrapND(o));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(h->out_handles.size());
+  *out = h->out_handles.data();
+  return 0;
+}
+
+MXNET_DLL int MXExecutorFree(ExecutorHandle handle) {
+  delete static_cast<Exec *>(handle);
   return 0;
 }
 

@@ -1,0 +1,219 @@
+/* Pure-C consumer of the symbolic half of the C API waist (reference
+ * parity: include/mxnet/c_api.h Part 3 MXSymbol* + Part 4 MXExecutor*).
+ * Builds a 2-layer MLP symbolically, round-trips it through JSON, infers
+ * shapes, binds an executor, and trains linear-regression style until the
+ * loss drops — proving create/compose/list/infer/bind/forward/backward
+ * end-to-end from C. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+static int failures = 0;
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      ++failures;                                                          \
+      fprintf(stderr, "FAILED %s:%d: %s (last error: %s)\n", __FILE__,     \
+              __LINE__, #cond, MXGetLastError());                          \
+    }                                                                      \
+  } while (0)
+
+static AtomicSymbolCreator find_creator(const char *name) {
+  mx_uint n = 0;
+  AtomicSymbolCreator *cs = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &cs) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *nm = NULL;
+    MXSymbolGetAtomicSymbolName(cs[i], &nm);
+    if (nm && strcmp(nm, name) == 0) return cs[i];
+  }
+  return NULL;
+}
+
+/* FullyConnected(data, num_hidden=h) with auto-created weight/bias */
+static SymbolHandle fc_layer(SymbolHandle data, const char *name, int hid) {
+  AtomicSymbolCreator c = find_creator("FullyConnected");
+  CHECK(c != NULL);
+  char hidbuf[16];
+  snprintf(hidbuf, sizeof(hidbuf), "%d", hid);
+  const char *pk[] = {"num_hidden"};
+  const char *pv[] = {hidbuf};
+  SymbolHandle fc = NULL;
+  CHECK(MXSymbolCreateAtomicSymbol(c, 1, pk, pv, &fc) == 0);
+  const char *ak[] = {"data"};
+  SymbolHandle args[] = {data};
+  CHECK(MXSymbolCompose(fc, name, 1, ak, args) == 0);
+  return fc;
+}
+
+int main(void) {
+  /* ---- build: data -> fc1(16) -> Activation(relu) -> fc2(1) ---- */
+  SymbolHandle data = NULL;
+  CHECK(MXSymbolCreateVariable("data", &data) == 0);
+  SymbolHandle fc1 = fc_layer(data, "fc1", 16);
+
+  AtomicSymbolCreator act_c = find_creator("Activation");
+  CHECK(act_c != NULL);
+  const char *apk[] = {"act_type"};
+  const char *apv[] = {"relu"};
+  SymbolHandle act = NULL;
+  CHECK(MXSymbolCreateAtomicSymbol(act_c, 1, apk, apv, &act) == 0);
+  SymbolHandle act_args[] = {fc1};
+  CHECK(MXSymbolCompose(act, "relu1", 1, NULL, act_args) == 0);
+
+  SymbolHandle net = fc_layer(act, "fc2", 1);
+
+  /* ---- introspection ---- */
+  mx_uint n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXSymbolListArguments(net, &n_args, &arg_names) == 0);
+  CHECK(n_args == 5);  /* data, fc1_weight, fc1_bias, fc2_weight, fc2_bias */
+  CHECK(strcmp(arg_names[0], "data") == 0);
+  CHECK(strcmp(arg_names[1], "fc1_weight") == 0);
+
+  mx_uint n_outs = 0;
+  const char **out_names = NULL;
+  CHECK(MXSymbolListOutputs(net, &n_outs, &out_names) == 0);
+  CHECK(n_outs == 1 && strstr(out_names[0], "fc2") != NULL);
+
+  const char *sname = NULL;
+  int ok = 0;
+  CHECK(MXSymbolGetName(net, &sname, &ok) == 0);
+  CHECK(ok == 1 && strcmp(sname, "fc2") == 0);
+
+  /* op info for the wrapper-generator contract */
+  AtomicSymbolCreator fc_c = find_creator("FullyConnected");
+  const char *iname = NULL, *idesc = NULL, *kv = NULL;
+  mx_uint in_args = 0;
+  const char **inames = NULL, **itypes = NULL, **idescs = NULL;
+  CHECK(MXSymbolGetAtomicSymbolInfo(fc_c, &iname, &idesc, &in_args, &inames,
+                                    &itypes, &idescs, &kv) == 0);
+  CHECK(strcmp(iname, "FullyConnected") == 0);
+  CHECK(in_args >= 4);  /* data, weight, bias + num_hidden... */
+  CHECK(strcmp(itypes[0], "NDArray-or-Symbol") == 0);
+  CHECK(strstr(itypes[in_args - 1], "optional") != NULL ||
+        strstr(itypes[in_args - 1], "required") != NULL);
+  CHECK(strcmp(kv, "") == 0);
+
+  /* ---- JSON round trip ---- */
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(net, &json) == 0);
+  CHECK(json != NULL && strstr(json, "fc1_weight") != NULL);
+  SymbolHandle net2 = NULL;
+  CHECK(MXSymbolCreateFromJSON(json, &net2) == 0);
+  mx_uint n_args2 = 0;
+  const char **arg_names2 = NULL;
+  CHECK(MXSymbolListArguments(net2, &n_args2, &arg_names2) == 0);
+  CHECK(n_args2 == n_args);
+
+  /* ---- shape inference ---- */
+  const char *ikeys[] = {"data"};
+  mx_uint ind_ptr[] = {0, 2};
+  mx_uint shape_data[] = {8, 4};   /* batch 8, 4 features */
+  mx_uint in_sz = 0, out_sz = 0, aux_sz = 0;
+  const mx_uint *in_nd = NULL, *out_nd = NULL, *aux_nd = NULL;
+  const mx_uint **in_sh = NULL, **out_sh = NULL, **aux_sh = NULL;
+  int complete = 0;
+  CHECK(MXSymbolInferShape(net, 1, ikeys, ind_ptr, shape_data, &in_sz,
+                           &in_nd, &in_sh, &out_sz, &out_nd, &out_sh,
+                           &aux_sz, &aux_nd, &aux_sh, &complete) == 0);
+  CHECK(complete == 1 && in_sz == 5 && out_sz == 1);
+  CHECK(in_nd[1] == 2 && in_sh[1][0] == 16 && in_sh[1][1] == 4);
+  CHECK(out_nd[0] == 2 && out_sh[0][0] == 8 && out_sh[0][1] == 1);
+
+  /* ---- bind + train: y = x @ w_true, loss must drop ---- */
+  NDArrayHandle args[5], grads[5];
+  mx_uint req[5];
+  for (mx_uint i = 0; i < in_sz; ++i) {
+    CHECK(MXNDArrayCreate(in_sh[i], in_nd[i], 1, 0, 0, &args[i]) == 0);
+    CHECK(MXNDArrayCreate(in_sh[i], in_nd[i], 1, 0, 0, &grads[i]) == 0);
+    req[i] = (i == 0) ? 0 : 1;   /* no grad for data */
+  }
+  /* init weights small-deterministic, data + targets fixed */
+  float buf[16 * 4];
+  for (int i = 0; i < 16 * 4; ++i) buf[i] = 0.01f * (float)((i % 7) - 3);
+  CHECK(MXNDArraySyncCopyFromCPU(args[1], buf, 16 * 4) == 0);
+  for (int i = 0; i < 16; ++i) buf[i] = 0.02f * (float)((i % 5) - 2);
+  CHECK(MXNDArraySyncCopyFromCPU(args[3], buf, 16) == 0);
+  float x[8 * 4], y[8];
+  for (int i = 0; i < 8 * 4; ++i) x[i] = 0.25f * (float)((i % 9) - 4);
+  for (int i = 0; i < 8; ++i) {
+    y[i] = 0.0f;
+    for (int j = 0; j < 4; ++j) y[i] += x[i * 4 + j] * (0.5f + 0.25f * j);
+  }
+  CHECK(MXNDArraySyncCopyFromCPU(args[0], x, 8 * 4) == 0);
+
+  ExecutorHandle ex = NULL;
+  CHECK(MXExecutorBind(net, 1, 0, 5, args, grads, req, 0, NULL, &ex) == 0);
+
+  float first_loss = -1.0f, last_loss = -1.0f;
+  const char *lr_k[] = {"lr"};
+  const char *lr_v[] = {"0.2"};
+  for (int it = 0; it < 120; ++it) {
+    CHECK(MXExecutorForward(ex, 1) == 0);
+    mx_uint nout = 0;
+    NDArrayHandle *outs = NULL;
+    CHECK(MXExecutorOutputs(ex, &nout, &outs) == 0);
+    CHECK(nout == 1);
+    float pred[8];
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], pred, 8) == 0);
+    float loss = 0.0f, head[8];
+    for (int i = 0; i < 8; ++i) {
+      float d = pred[i] - y[i];
+      loss += d * d / 8.0f;
+      head[i] = 2.0f * d / 8.0f;   /* dL/dpred for MSE */
+    }
+    if (it == 0) first_loss = loss;
+    last_loss = loss;
+    NDArrayHandle hg = NULL;
+    mx_uint hshape[] = {8, 1};
+    CHECK(MXNDArrayCreate(hshape, 2, 1, 0, 0, &hg) == 0);
+    CHECK(MXNDArraySyncCopyFromCPU(hg, head, 8) == 0);
+    CHECK(MXExecutorBackward(ex, 1, &hg) == 0);
+    MXNDArrayFree(hg);
+    /* SGD: w -= lr * grad via the imperative waist, out= in place */
+    for (int i = 1; i < 5; ++i) {
+      NDArrayHandle io[2] = {args[i], grads[i]};
+      int no = 1;
+      NDArrayHandle *op = &args[i];
+      CHECK(MXImperativeInvokeByName("sgd_update", 2, io, &no, &op, 1,
+                                     lr_k, lr_v) == 0);
+    }
+    for (mx_uint i = 0; i < nout; ++i) MXNDArrayFree(outs[i]);
+  }
+  CHECK(first_loss > 0.0f);
+  CHECK(last_loss < 0.1f * first_loss);
+
+  /* error contract: composing with a bogus arg name must fail cleanly */
+  SymbolHandle bad = NULL;
+  const char *bk[] = {"num_hidden"};
+  const char *bv[] = {"3"};
+  CHECK(MXSymbolCreateAtomicSymbol(fc_c, 1, bk, bv, &bad) == 0);
+  SymbolHandle bargs[] = {data};
+  const char *bkeys[] = {"not_an_arg"};
+  CHECK(MXSymbolCompose(bad, "bad", 1, bkeys, bargs) != 0);
+  CHECK(strlen(MXGetLastError()) > 0);
+
+  MXExecutorFree(ex);
+  for (int i = 0; i < 5; ++i) {
+    MXNDArrayFree(args[i]);
+    MXNDArrayFree(grads[i]);
+  }
+  MXSymbolFree(net);
+  MXSymbolFree(net2);
+  MXSymbolFree(fc1);
+  MXSymbolFree(act);
+  MXSymbolFree(data);
+  MXSymbolFree(bad);
+
+  if (failures == 0) {
+    printf("c_api_sym_test: all checks passed (final loss %.5f from %.5f)\n",
+           last_loss, first_loss);
+    return 0;
+  }
+  fprintf(stderr, "c_api_sym_test: %d failures\n", failures);
+  return 1;
+}

@@ -45,6 +45,20 @@ def test_c_binary_full_surface():
     assert "C API TEST OK" in r.stdout
 
 
+def test_c_binary_symbolic_surface():
+    """The symbolic C consumer: MXSymbol create/compose/list/JSON/infer +
+    MXExecutor bind/forward/backward training an MLP to convergence
+    (round-5 addition — reference c_api.h Parts 3-4)."""
+    _make("./c_api_sym_test")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([os.path.join(SRC, "c_api_sym_test")], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all checks passed" in r.stdout
+
+
 class TestInProcess:
     """ctypes consumer sharing this interpreter (the predict-ABI pattern)."""
 
