@@ -34,6 +34,14 @@ def main():
     y = X @ w_true
 
     kv.init("w", nd.zeros((3, 1)))
+    # round 5: a row-sparse embedding rides the PS too (reference
+    # kvstore_dist.h row-sparse push/pull) — each worker pulls/pushes only
+    # the rows its batch touches
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+    E_ROWS, E_DIM = 16, 4
+    t_emb = (np.arange(E_ROWS * E_DIM, dtype=np.float32)
+             .reshape(E_ROWS, E_DIM) / 10.0)
+    kv.init("emb", nd.zeros((E_ROWS, E_DIM)))
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
     kv.barrier()                       # both workers see the optimizer
 
@@ -45,11 +53,44 @@ def main():
         grad = nd.dot(xb.T, nd.dot(xb, w) - yb) / 32
         kv.push("w", grad)             # server applies immediately
 
+        # sparse task: pull the touched rows, step them toward t_emb
+        ids = np.unique(rng.randint(0, E_ROWS, size=6)).astype("int64")
+        rows_out = nd.zeros((E_ROWS, E_DIM))
+        kv.row_sparse_pull("emb", out=rows_out, row_ids=nd.array(ids))
+        cur = rows_out.asnumpy()[ids]
+        g_rows = cur - t_emb[ids]      # d/dE of 0.5||E - T||^2 on rows
+        kv.push("emb", row_sparse_array((nd.array(g_rows), ids),
+                                        shape=(E_ROWS, E_DIM)))
+
     kv.barrier()
     kv.pull("w", out=w)
     err = float(np.abs(w.asnumpy() - w_true).max())
-    print("rank %d final err %.4f" % (rank, err))
+    emb_out = nd.zeros((E_ROWS, E_DIM))
+    kv.pull("emb", out=emb_out)
+    emb_err = float(np.abs(emb_out.asnumpy() - t_emb).max())
+    print("rank %d final err %.4f emb_err %.4f" % (rank, err, emb_err))
     assert err < 0.05, "async training did not converge: %.4f" % err
+    assert emb_err < 0.1, "sparse async did not converge: %.4f" % emb_err
+
+    # round 5 phase 2: a 2-bit-compressed dense param over the PS wire
+    # (reference kvstore_dist.h:336-359) — error feedback makes the
+    # quantized stream unbiased, so async LS still converges, to the
+    # coarser threshold-scale tolerance
+    kv.init("wc", nd.zeros((3, 1)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.barrier()
+    wc = nd.zeros((3, 1))
+    for step in range(250):
+        kv.pull("wc", out=wc)
+        i = (step * 32) % 224
+        xb, yb = nd.array(X[i:i + 32]), nd.array(y[i:i + 32])
+        grad = nd.dot(xb.T, nd.dot(xb, wc) - yb) / 32
+        kv.push("wc", grad)            # packed 2-bit on the wire
+    kv.barrier()
+    kv.pull("wc", out=wc)
+    cerr = float(np.abs(wc.asnumpy() - w_true).max())
+    print("rank %d compressed err %.4f" % (rank, cerr))
+    assert cerr < 0.2, "compressed async did not converge: %.4f" % cerr
     kv.barrier()
     if rank == 0:
         kv.send_command_to_servers(0, "")   # kStopServer

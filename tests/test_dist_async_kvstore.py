@@ -95,6 +95,83 @@ class TestInProcess:
         np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
         kv.close()
 
+    def test_row_sparse_push_pull(self, server):
+        """push_rsp / pull_rows: only touched rows cross the wire
+        (reference kvstore_dist.h:228-291)."""
+        from mxnet_tpu.ndarray.sparse import row_sparse_array
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", nd.zeros((6, 3)))
+        ids = np.array([1, 4], np.int64)
+        rows = np.arange(6, dtype=np.float32).reshape(2, 3)
+        # no optimizer: rsp push assigns the touched rows
+        kv.push("emb", row_sparse_array((nd.array(rows), ids),
+                                        shape=(6, 3)))
+        dense = nd.zeros((6, 3))
+        kv.pull("emb", out=dense)
+        want = np.zeros((6, 3), np.float32)
+        want[ids] = rows
+        np.testing.assert_array_equal(dense.asnumpy(), want)
+        # row_sparse_pull into a RowSparseNDArray gets exactly those rows
+        out = row_sparse_array((nd.zeros((1, 3)), np.array([0])),
+                               shape=(6, 3))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array(ids))
+        np.testing.assert_array_equal(out.indices.asnumpy(), ids)
+        np.testing.assert_array_equal(out.data.asnumpy(), rows)
+        kv.close()
+
+    def test_row_sparse_server_optimizer(self, server):
+        """Server-side lazy update: an rsp push steps ONLY the touched
+        rows (kvstore_dist_server.h ApplyUpdates on row-sparse)."""
+        from mxnet_tpu.ndarray.sparse import row_sparse_array
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", nd.ones((4, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        ids = np.array([2], np.int64)
+        kv.push("emb", row_sparse_array(
+            (nd.ones((1, 2)), ids), shape=(4, 2)))
+        out = nd.zeros((4, 2))
+        kv.pull("emb", out=out)
+        want = np.ones((4, 2), np.float32)
+        want[2] = 0.5                  # only row 2 stepped
+        np.testing.assert_allclose(out.asnumpy(), want)
+        kv.close()
+
+    def test_compressed_wire_is_packed(self, server):
+        """The 2-bit push sends the PACKED word form: wire bytes for the
+        gradient must be ~1/16 of f32, not a dequantized full array."""
+        from mxnet_tpu import kvstore_server as ps
+        kv = mx.kv.create("dist_async")
+        n = 4096
+        kv.init("big", nd.zeros((n,)))
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        sent = []
+        orig = ps.send_msg
+
+        def spy(sock, obj):
+            sent.append(obj)
+            return orig(sock, obj)
+
+        ps.send_msg = spy
+        try:
+            kv.push("big", nd.ones((n,)))
+        finally:
+            ps.send_msg = orig
+        msg = [m for m in sent if m[0] == "push_2bit"][-1]
+        words = np.asarray(msg[2])
+        assert words.dtype == np.uint32 and words.size == n // 16
+        out = nd.zeros((n,))
+        kv.pull("big", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.5)
+        kv.close()
+
+    def test_wire_rejects_oversized_blob_header(self, server):
+        """decode validates blob size against the declared shape (the
+        non-pickle codec's safety check)."""
+        from mxnet_tpu.kvstore_server import _decode
+        with pytest.raises(mx.MXNetError, match="size mismatch"):
+            _decode({"__nd__": 0, "dtype": "<f4", "shape": [100]},
+                    [b"\x00" * 8])
+
     def test_errors_cross_the_wire(self, server):
         kv = mx.kv.create("dist_async")
         with pytest.raises(mx.MXNetError, match="before init"):
