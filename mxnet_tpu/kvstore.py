@@ -111,7 +111,7 @@ class KVStore:
         for k, o in zip(keys, outs):
             src = self._store[k]
             olist = o if isinstance(o, (list, tuple)) else [o]
-            rlist = rids if len(rids) == len(olist) else rids * len(olist)
+            rlist = _broadcast_row_ids(rids, olist)
             for dst, rid in zip(olist, rlist):
                 if isinstance(dst, RowSparseNDArray):
                     if isinstance(src, RowSparseNDArray):
@@ -136,10 +136,17 @@ class KVStore:
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression (reference N13).  On TPU intra-host
         reduction is exact; accepted for API parity, applied on the dist
-        path (DCN) where bandwidth matters."""
+        path (DCN) where bandwidth matters.  ``None`` (or type 'none')
+        turns compression off."""
         from .kvstore_compression import GradientCompression
+        if compression_params is None:
+            self._compression = None
+            return
         params = dict(compression_params)
         ctype = params.pop("type", "2bit")
+        if ctype in ("none", None):
+            self._compression = None
+            return
         threshold = float(params.pop("threshold", 0.5))
         if params:
             raise MXNetError("unknown compression params %s" % list(params))
@@ -186,6 +193,18 @@ class KVStore:
         if isinstance(key, (list, tuple)):
             return [_key(k) for k in key], list(value)
         return [_key(key)], [value]
+
+
+def _broadcast_row_ids(rids, olist):
+    """row_ids -> one-per-output: a single id array broadcasts; otherwise
+    the counts must match exactly (a silent zip-truncate pairs outputs
+    with the wrong rows — reference errors here too)."""
+    if len(rids) == len(olist):
+        return rids
+    if len(rids) == 1:
+        return rids * len(olist)
+    raise MXNetError("row_sparse_pull: %d row_ids for %d outputs"
+                     % (len(rids), len(olist)))
 
 
 def _local_sum(v):
@@ -331,6 +350,12 @@ class DistAsyncKVStore(KVStore):
         for k, v in zip(keys, values):
             agg = _local_sum(v)
             if isinstance(agg, RowSparseNDArray):
+                if self._compression:
+                    # reference contract: sparse + compression is an
+                    # error, not a silent full-f32 fallback
+                    raise MXNetError(
+                        "gradient compression does not support "
+                        "row_sparse push (key %r)" % k)
                 # only touched rows cross the wire (reference
                 # kvstore_dist.h:228-291 row-sparse push)
                 self._rpc("push_rsp", k,
@@ -365,7 +390,7 @@ class DistAsyncKVStore(KVStore):
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for k, o in zip(keys, outs):
             olist = o if isinstance(o, (list, tuple)) else [o]
-            rlist = rids if len(rids) == len(olist) else rids * len(olist)
+            rlist = _broadcast_row_ids(rids, olist)
             for dst, rid in zip(olist, rlist):
                 ids = np.unique(rid.asnumpy().astype("int64"))
                 rows = self._rpc("pull_rows", k, ids)

@@ -86,10 +86,16 @@ def _encode(obj, blobs):
     raise MXNetError("kvstore wire: cannot encode %r" % type(obj))
 
 
+def _blob_at(blobs, idx):
+    if not isinstance(idx, int) or not 0 <= idx < len(blobs):
+        raise MXNetError("kvstore wire: bad blob index %r" % (idx,))
+    return blobs[idx]
+
+
 def _decode(node, blobs):
     if isinstance(node, dict):
         if "__nd__" in node:
-            raw = blobs[node["__nd__"]]
+            raw = _blob_at(blobs, node["__nd__"])
             dt = np.dtype(str(node["dtype"]))
             arr = np.frombuffer(raw, dtype=dt)
             shape = tuple(int(d) for d in node["shape"])
@@ -97,7 +103,7 @@ def _decode(node, blobs):
                 raise MXNetError("kvstore wire: blob size mismatch")
             return arr.reshape(shape)
         if "__bytes__" in node:
-            return blobs[node["__bytes__"]]
+            return _blob_at(blobs, node["__bytes__"])
         raise MXNetError("kvstore wire: unknown header node")
     if isinstance(node, list):
         return [_decode(x, blobs) for x in node]
@@ -178,7 +184,19 @@ class KVStoreServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 while True:
-                    msg = recv_msg(self.request)
+                    try:
+                        msg = recv_msg(self.request)
+                    except Exception as e:
+                        # a malformed frame (old wire format, framing bug,
+                        # bad blob index) answers with a diagnostic instead
+                        # of silently killing the connection; the stream
+                        # may be desynced after this, so close it
+                        try:
+                            send_msg(self.request,
+                                     ("err", "bad frame: %s" % e))
+                        except Exception:
+                            pass
+                        return
                     if msg is None:
                         return
                     reply = outer._dispatch(msg)
@@ -258,7 +276,8 @@ class KVStoreServer:
                 with self._lock_for(key):
                     if key not in self._store:
                         raise MXNetError("pull before init: %r" % key)
-                    return ("ok", self._store[key][ids].copy())
+                    # advanced indexing already copies
+                    return ("ok", self._store[key][ids])
             if cmd == "push_2bit":
                 # packed 2-bit gradient (16 codes/uint32 word); the server
                 # dequantizes then applies (reference kvstore_dist.h:336)

@@ -116,7 +116,7 @@ def bench_ps(iters: int):
             dt = time.perf_counter() - t0
             row["push_2bit_logical_gbps"] = round(
                 iters * n * 4 / dt / 1e9, 3)
-            kv._compression = None                     # reset for next size
+            kv.set_gradient_compression(None)          # off for next size
             rows.append(row)
         kv.close()
     finally:
