@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Named-entity recognition: BiLSTM sequence labeling.
+
+Reference analog: ``example/named_entity_recognition/src/ner.py`` — the
+sequence-LABELING recipe (one tag per token, not one class per
+sentence): embedding -> bidirectional LSTM -> per-token projection ->
+per-token softmax CE, evaluated with entity-class accuracy (the
+reference uses a custom composite metric over non-O tags).
+
+Synthetic corpus with a context-sensitive rule an order-0 model cannot
+learn: "trigger" tokens (ids 1-4) tag the NEXT token as an entity of the
+trigger's type; every other token is O.  A per-token classifier without
+sequence context tops out near the O-rate; the BiLSTM must carry the
+trigger across a timestep.
+
+Run:  python example/named_entity_recognition/ner.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+parser = argparse.ArgumentParser(
+    description="BiLSTM NER on a synthetic trigger-tagged corpus",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=120)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--seq-len", type=int, default=20)
+parser.add_argument("--vocab", type=int, default=50)
+parser.add_argument("--n-types", type=int, default=4)
+parser.add_argument("--hidden", type=int, default=32)
+parser.add_argument("--lr", type=float, default=0.01)
+
+
+def make_batch(rng, bs, T, vocab, n_types):
+    """Tokens uniform; ids 1..n_types are triggers tagging the NEXT
+    token as entity type 1..n_types; tag 0 is O."""
+    x = rng.randint(n_types + 1, vocab, size=(bs, T))
+    trig_pos = rng.randint(0, T - 1, size=(bs, 3))
+    tags = np.zeros((bs, T), np.int64)
+    for i in range(bs):
+        for p in trig_pos[i]:
+            t = rng.randint(1, n_types + 1)
+            x[i, p] = t
+            tags[i, p + 1] = t
+    return (nd.array(x.astype(np.float32)),
+            nd.array(tags.astype(np.float32)))
+
+
+class BiLSTMTagger(gluon.Block):
+    def __init__(self, vocab, n_tags, hidden, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab, hidden)
+            self.lstm = rnn.LSTM(hidden, num_layers=1, bidirectional=True,
+                                 layout="NTC")
+            self.proj = nn.Dense(n_tags, flatten=False)
+
+    def forward(self, x):
+        e = self.embed(x)                  # (B, T, H)
+        h = self.lstm(e)                   # (B, T, 2H)
+        return self.proj(h)                # (B, T, n_tags)
+
+
+def main(args):
+    rng = np.random.RandomState(0)
+    n_tags = args.n_types + 1
+    net = BiLSTMTagger(args.vocab, n_tags, args.hidden)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    ent_accs = []
+    for it in range(args.iters):
+        x, y = make_batch(rng, args.batch_size, args.seq_len, args.vocab,
+                          args.n_types)
+        with autograd.record():
+            logits = net(x)
+            loss = loss_fn(logits.reshape((-1, n_tags)), y.reshape((-1,)))
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it >= args.iters - 15:
+            pred = logits.asnumpy().argmax(-1)
+            lab = y.asnumpy()
+            ent = lab > 0                   # score ENTITY tokens only
+            ent_accs.append(float((pred[ent] == lab[ent]).mean()))
+    acc = float(np.mean(ent_accs))
+    print("NER entity-token accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    a = parser.parse_args()
+    acc = main(a)
+    raise SystemExit(0 if acc > 0.9 else 1)

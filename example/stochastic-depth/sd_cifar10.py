@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Stochastic depth: residual blocks dropped with a per-layer schedule.
+
+Reference analog: ``example/stochastic-depth/sd_cifar10.py`` (Huang et
+al. 2016) — during training, residual block l is SKIPPED entirely with
+probability 1 - p_l, where p_l decays linearly with depth
+(p_l = 1 - l/L * (1 - p_L)); at test time every block runs, its residual
+branch scaled by p_l.  A per-LAYER drop schedule, not per-activation
+dropout — a genuinely different regularizer and train/test asymmetry.
+
+TPU-native: the Bernoulli gate is one scalar per block per batch drawn
+OUTSIDE the compute, multiplied into the residual branch — no
+data-dependent control flow enters the XLA program (gate*branch lets the
+compiler keep one static graph; a dropped block is a multiply by zero).
+
+Run:  python example/stochastic-depth/sd_cifar10.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="Stochastic-depth ResNet on synthetic CIFAR-like data",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=150)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--n-blocks", type=int, default=6)
+parser.add_argument("--death-rate", type=float, default=0.5,
+                    help="1 - p_L: drop prob of the DEEPEST block")
+parser.add_argument("--px", type=int, default=16)
+parser.add_argument("--lr", type=float, default=0.05)
+
+
+class SDResBlock(gluon.Block):
+    """Residual block with a survival gate: out = x + gate * branch(x).
+
+    gate is p_l-scaled at test time and Bernoulli(p_l)/1 at train time
+    (the inverted-dropout-style formulation keeps E[out] equal)."""
+
+    def __init__(self, channels, survive_p, **kw):
+        super().__init__(**kw)
+        self.survive_p = survive_p
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(channels, 3, padding=1)
+            self.bn1 = nn.BatchNorm()
+            self.conv2 = nn.Conv2D(channels, 3, padding=1)
+            self.bn2 = nn.BatchNorm()
+
+    def forward(self, x):
+        branch = nd.relu(self.bn1(self.conv1(x)))
+        branch = self.bn2(self.conv2(branch))
+        if autograd.is_training():
+            # one coin per block per batch (the reference's schedule);
+            # dropped -> the whole branch multiplies to zero and the
+            # block is an identity this step
+            gate = 1.0 if self.rng.uniform() < self.survive_p else 0.0
+            branch = branch * gate
+        else:
+            branch = branch * self.survive_p
+        return nd.relu(x + branch)
+
+
+class SDResNet(gluon.Block):
+    def __init__(self, n_blocks, death_rate, n_class=10, **kw):
+        super().__init__(**kw)
+        self.blocks = []
+        with self.name_scope():
+            self.stem = nn.Conv2D(32, 3, padding=1)
+            for l in range(n_blocks):
+                # linear decay: p_l = 1 - (l+1)/L * death_rate
+                p = 1.0 - (l + 1) / n_blocks * death_rate
+                blk = SDResBlock(32, p)
+                self.register_child(blk)
+                self.blocks.append(blk)
+            self.head = nn.Dense(n_class)
+
+    def set_rng(self, rng):
+        for blk in self.blocks:
+            blk.rng = rng
+
+    def forward(self, x):
+        h = nd.relu(self.stem(x))
+        for blk in self.blocks:
+            h = blk(h)
+        h = nd.mean(h, axis=(2, 3))        # global average pool
+        return self.head(h)
+
+
+def make_batch(rng, bs, px, n_class=10):
+    xs = np.zeros((bs, 1, px, px), np.float32)
+    ys = np.zeros((bs,), np.float32)
+    for i in range(bs):
+        c = int(rng.randint(n_class))
+        ys[i] = c
+        r0, c0 = (c // 5) * (px // 2), (c % 5) * 3
+        xs[i, 0, r0:r0 + 4, c0:c0 + 4] = 1.0
+    xs += rng.randn(bs, 1, px, px).astype(np.float32) * 0.2
+    return nd.array(xs), nd.array(ys)
+
+
+def main(args):
+    rng = np.random.RandomState(0)
+    net = SDResNet(args.n_blocks, args.death_rate)
+    net.set_rng(rng)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+
+    for it in range(args.iters):
+        x, y = make_batch(rng, args.batch_size, args.px)
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(args.batch_size)
+
+    # eval: full depth, branches scaled by p_l
+    hits = total = 0
+    for _ in range(8):
+        x, y = make_batch(rng, args.batch_size, args.px)
+        pred = net(x).asnumpy().argmax(1)
+        hits += (pred == y.asnumpy()).sum()
+        total += len(pred)
+    acc = hits / total
+    print("stochastic-depth eval accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    a = parser.parse_args()
+    acc = main(a)
+    raise SystemExit(0 if acc > 0.85 else 1)

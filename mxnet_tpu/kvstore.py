@@ -145,6 +145,9 @@ class KVStore:
         params = dict(compression_params)
         ctype = params.pop("type", "2bit")
         if ctype in ("none", None):
+            if params:      # typo'd keys must not pass silently here either
+                raise MXNetError("unknown compression params %s"
+                                 % list(params))
             self._compression = None
             return
         threshold = float(params.pop("threshold", 0.5))
@@ -255,12 +258,20 @@ class DistKVStore(KVStore):
         return self._pg.size
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             agg = _local_sum(v)
             if self._compression:
+                if isinstance(agg, RowSparseNDArray):
+                    # reference contract: sparse + compression errors —
+                    # a silent densify-then-quantize would threshold-zero
+                    # every untouched row
+                    raise MXNetError(
+                        "gradient compression does not support "
+                        "row_sparse push (key %r)" % k)
                 # each worker ships its quantized gradient (2-bit + error
                 # feedback, N13); summing dequantized streams across ranks
                 # == the reference PS aggregating decompressed pushes

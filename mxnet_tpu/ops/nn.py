@@ -159,6 +159,69 @@ def _shifted_gemm_conv(data, weight):
                          (0, 3, 1, 2)).astype(data.dtype)
 
 
+def _gemm_wgrad_eligible(attrs, data, nd):
+    """3x3 / stride 1 / SAME / ungrouped convs at SMALL spatial dims get
+    a hand 9-GEMM weight-gradient formulation: tools/probe_wgrad.py
+    (round 5, v5e) measured XLA's chosen wgrad lowering at 90 TF (14px)
+    and 61 TF (7px) while the per-tap GEMM form hits 178/128 TF — ~2x —
+    with XLA winning at 56/28px (259/307 TF), hence the H<=16 gate.
+    Forward and dgrad stay on lax.conv; only the VJP's dw changes.
+    Off by default until the e2e bench confirms the in-graph win
+    (round-4 lesson: isolated chain wins can die in whole-graph
+    scheduling): enable with MXNET_TPU_GEMM_WGRAD=1."""
+    import os
+    if os.environ.get("MXNET_TPU_GEMM_WGRAD", "0") != "1":
+        return False
+    k = attrs["kernel"]
+    return (nd == 2 and tuple(k) == (3, 3)
+            and tuple(attrs["stride"] or (1, 1)) == (1, 1)
+            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
+            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
+            and attrs["num_group"] == 1 and data.ndim == 4
+            and data.shape[2] <= 16 and data.shape[3] <= 16)
+
+
+@jax.custom_vjp
+def _conv3x3_same_gemm_wgrad(data, weight):
+    """3x3 SAME conv whose VJP computes dw as 9 per-tap GEMMs (dgrad
+    stays the standard transposed conv)."""
+    return jax.lax.conv_general_dilated(
+        data, weight, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=_conv_dnums(2))
+
+
+def _c3g_fwd(data, weight):
+    return _conv3x3_same_gemm_wgrad(data, weight), (data, weight)
+
+
+def _c3g_bwd(res, g):
+    data, weight = res
+    N, C, H, W = data.shape
+    O = weight.shape[0]
+    # dgrad: conv of g with the spatially-flipped, io-swapped kernel
+    wT = jnp.flip(weight.transpose(1, 0, 2, 3), axis=(2, 3))
+    dx = jax.lax.conv_general_dilated(
+        g, wT.astype(g.dtype), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=_conv_dnums(2)).astype(data.dtype)
+    # wgrad: dw[o,c,dy,dx] = sum_nhw x_pad[n,c,h+dy,w+dx] g[n,o,h,w] —
+    # one (NHW,C)x(NHW,O) GEMM per tap, f32 accumulation
+    xh = jnp.transpose(data, (0, 2, 3, 1))               # NHWC
+    xp = jnp.pad(xh, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    g2 = jnp.transpose(g, (0, 2, 3, 1)).reshape(N * H * W, O)
+    taps = []
+    for dy in range(3):
+        for dx_ in range(3):
+            tap = xp[:, dy:dy + H, dx_:dx_ + W, :].reshape(N * H * W, C)
+            taps.append(jax.lax.dot_general(
+                tap, g2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))     # (C, O)
+    dw = jnp.stack(taps).reshape(3, 3, C, O).transpose(3, 2, 0, 1)
+    return dx, dw.astype(weight.dtype)
+
+
+_conv3x3_same_gemm_wgrad.defvjp(_c3g_fwd, _c3g_bwd)
+
+
 @register("Convolution", nin=-1, aliases=("convolution", "Convolution_v1"),
           params=dict(_CONV_PARAMS))
 def _convolution(attrs, data, weight, *maybe_bias):
@@ -172,6 +235,8 @@ def _convolution(attrs, data, weight, *maybe_bias):
         out = _stem_s2d_conv(attrs, data, weight)
     elif _shifted_gemm_eligible(attrs, data, nd):
         out = _shifted_gemm_conv(data, weight)
+    elif _gemm_wgrad_eligible(attrs, data, nd):
+        out = _conv3x3_same_gemm_wgrad(data, weight)
     else:
         out = jax.lax.conv_general_dilated(
             data, weight,

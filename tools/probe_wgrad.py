@@ -149,9 +149,10 @@ def isolated_wgrad():
                                             ("NCHW", "OIHW", "NCHW"))
 
         def conv_w(w):
+            # bf16 out so the transpose takes the bf16 dy cotangent
+            # (MXU still accumulates f32 internally)
             return jax.lax.conv_general_dilated(
-                x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
-                preferred_element_type=jnp.float32)
+                x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
 
         wt = jax.linear_transpose(
             conv_w, jax.ShapeDtypeStruct((K, C, 3, 3), jnp.bfloat16))
