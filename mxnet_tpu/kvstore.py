@@ -212,13 +212,22 @@ def _broadcast_row_ids(rids, olist):
 
 def _local_sum(v):
     """Sum a per-device value list into one array (the intra-worker
-    reduce every dist push does before going on the wire)."""
+    reduce every dist push does before going on the wire).  Row-sparse
+    lists reduce sparse-aware (union of rows), like the base store's
+    push — an in-place dense += on RowSparseNDArray raises."""
+    from .ndarray.sparse import RowSparseNDArray, add as _sparse_add
     vlist = v if isinstance(v, (list, tuple)) else [v]
     agg = vlist[0]
     if len(vlist) > 1:
-        agg = vlist[0].copy()
-        for x in vlist[1:]:
-            agg += x.as_in_context(agg.context)
+        if all(isinstance(x, RowSparseNDArray) for x in vlist):
+            for x in vlist[1:]:
+                agg = _sparse_add(agg, x)
+        else:
+            agg = vlist[0].tostype("default") \
+                if isinstance(vlist[0], RowSparseNDArray) \
+                else vlist[0].copy()
+            for x in vlist[1:]:
+                agg += x.as_in_context(agg.context)
     return agg
 
 

@@ -113,6 +113,29 @@ def _stem_s2d_conv(attrs, data, weight):
         dimension_numbers=_conv_dnums(2))
 
 
+def _is_3x3_same_unit(attrs, data, nd):
+    """Shared shape predicate: 2-D / 3x3 kernel / stride 1 / dilate 1 /
+    SAME pad / ungrouped — the class both GEMM formulations cover."""
+    k = attrs["kernel"]
+    return (nd == 2 and tuple(k) == (3, 3)
+            and tuple(attrs["stride"] or (1, 1)) == (1, 1)
+            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
+            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
+            and attrs["num_group"] == 1 and data.ndim == 4)
+
+
+def _nhwc_taps(data):
+    """Yield the nine SAME-padded NHWC tap views flattened to
+    (N*H*W, C) — the shared building block of both 9-GEMM forms."""
+    N, C, H, W = data.shape
+    xh = jnp.transpose(data, (0, 2, 3, 1))               # NHWC
+    xp = jnp.pad(xh, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    for dy in range(3):
+        for dx in range(3):
+            yield dy, dx, xp[:, dy:dy + H, dx:dx + W, :].reshape(
+                N * H * W, C)
+
+
 def _shifted_gemm_eligible(attrs, data, nd):
     """3x3 / stride 1 / dilate 1 / SAME / ungrouped 2-D convs can run as
     9 shifted GEMMs — measured STABLE at 175-191 TF on v5e in chained
@@ -129,32 +152,23 @@ def _shifted_gemm_eligible(attrs, data, nd):
     import os
     if os.environ.get("MXNET_TPU_CONV_SHIFTED_GEMM", "0") != "1":
         return False
-    k = attrs["kernel"]
-    return (nd == 2 and tuple(k) == (3, 3)
-            and tuple(attrs["stride"] or (1, 1)) == (1, 1)
-            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
-            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
-            and attrs["num_group"] == 1 and data.ndim == 4)
+    return _is_3x3_same_unit(attrs, data, nd)
 
 
 def _shifted_gemm_conv(data, weight):
     """NCHW 3x3 SAME conv as 9 shifted (NHW, C)x(C, O) GEMMs."""
     N, C, H, W = data.shape
     O = weight.shape[0]
-    xh = jnp.transpose(data, (0, 2, 3, 1))               # NHWC
-    xp = jnp.pad(xh, ((0, 0), (1, 1), (1, 1), (0, 0)))
     acc = None
-    for dy in range(3):
-        for dx in range(3):
-            tap = xp[:, dy:dy + H, dx:dx + W, :].reshape(N * H * W, C)
-            wk = weight[:, :, dy, dx].T                  # (C, O)
-            # f32 accumulation across the 9 taps (matches lax.conv's
-            # single f32 accumulate and the probe formulation — bf16
-            # partial rounding would change the numerics being compared)
-            part = jax.lax.dot_general(
-                tap, wk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            acc = part if acc is None else acc + part
+    for dy, dx, tap in _nhwc_taps(data):
+        wk = weight[:, :, dy, dx].T                      # (C, O)
+        # f32 accumulation across the 9 taps (matches lax.conv's
+        # single f32 accumulate and the probe formulation — bf16
+        # partial rounding would change the numerics being compared)
+        part = jax.lax.dot_general(
+            tap, wk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc = part if acc is None else acc + part
     return jnp.transpose(acc.reshape(N, H, W, O),
                          (0, 3, 1, 2)).astype(data.dtype)
 
@@ -166,18 +180,16 @@ def _gemm_wgrad_eligible(attrs, data, nd):
     and 61 TF (7px) while the per-tap GEMM form hits 178/128 TF — ~2x —
     with XLA winning at 56/28px (259/307 TF), hence the H<=16 gate.
     Forward and dgrad stay on lax.conv; only the VJP's dw changes.
-    Off by default until the e2e bench confirms the in-graph win
-    (round-4 lesson: isolated chain wins can die in whole-graph
-    scheduling): enable with MXNET_TPU_GEMM_WGRAD=1."""
+    E2e-measured OFF-worthy (2,445 vs 2,497 img/s — see
+    docs/perf_analysis.md round 5); enable with MXNET_TPU_GEMM_WGRAD=1.
+    NOTE: like MXNET_TPU_CONV_SHIFTED_GEMM, the flag is read at TRACE
+    time and executables are cached per (op, attrs) — after toggling,
+    clear ``OPS['Convolution']._jit_cache`` (a fresh process is the
+    clean way to probe)."""
     import os
     if os.environ.get("MXNET_TPU_GEMM_WGRAD", "0") != "1":
         return False
-    k = attrs["kernel"]
-    return (nd == 2 and tuple(k) == (3, 3)
-            and tuple(attrs["stride"] or (1, 1)) == (1, 1)
-            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
-            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
-            and attrs["num_group"] == 1 and data.ndim == 4
+    return (_is_3x3_same_unit(attrs, data, nd)
             and data.shape[2] <= 16 and data.shape[3] <= 16)
 
 
@@ -205,16 +217,10 @@ def _c3g_bwd(res, g):
         dimension_numbers=_conv_dnums(2)).astype(data.dtype)
     # wgrad: dw[o,c,dy,dx] = sum_nhw x_pad[n,c,h+dy,w+dx] g[n,o,h,w] —
     # one (NHW,C)x(NHW,O) GEMM per tap, f32 accumulation
-    xh = jnp.transpose(data, (0, 2, 3, 1))               # NHWC
-    xp = jnp.pad(xh, ((0, 0), (1, 1), (1, 1), (0, 0)))
     g2 = jnp.transpose(g, (0, 2, 3, 1)).reshape(N * H * W, O)
-    taps = []
-    for dy in range(3):
-        for dx_ in range(3):
-            tap = xp[:, dy:dy + H, dx_:dx_ + W, :].reshape(N * H * W, C)
-            taps.append(jax.lax.dot_general(
-                tap, g2, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))     # (C, O)
+    taps = [jax.lax.dot_general(tap, g2, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for _, _, tap in _nhwc_taps(data)]           # each (C, O)
     dw = jnp.stack(taps).reshape(3, 3, C, O).transpose(3, 2, 0, 1)
     return dx, dw.astype(weight.dtype)
 

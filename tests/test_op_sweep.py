@@ -570,6 +570,63 @@ def test_bf16_forward_parity(name):
         np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
 
 
+# backward bands: gradients amplify the bf16 input rounding by another
+# chain-rule factor, so the default band doubles the forward one and the
+# amplifying ops get their own entries (reference model: check_consistency
+# WITH grads, tests/python/gpu/test_operator_gpu.py:28-48)
+_BF16_BWD_TOL = {
+    "tan": 0.4, "digamma": 0.3, "erfinv": 0.25, "gamma": 0.3,
+    "gammaln": 0.25, "_power": 0.25, "broadcast_power": 0.25,
+    "_rpower_scalar": 0.25, "arccos": 0.2, "arcsin": 0.2,
+    "arctanh": 0.25, "arccosh": 0.25, "rcbrt": 0.15, "rsqrt": 0.15,
+    "reciprocal": 0.15, "_rdiv_scalar": 0.15, "_div": 0.15,
+    "broadcast_div": 0.15, "log_softmax": 0.2, "softmax": 0.15,
+    "softmin": 0.2, "streaming_softmax_ce": 0.2, "LayerNorm": 0.25,
+    "InstanceNorm": 0.25, "L2Normalization": 0.15, "exp": 0.12,
+    "expm1": 0.12, "cosh": 0.12, "sinh": 0.12, "smooth_l1": 0.15,
+    "log": 0.12, "log2": 0.12, "log10": 0.12, "log1p": 0.12,
+    "sqrt": 0.1, "cbrt": 0.1, "square": 0.1, "_hypot": 0.12,
+    "arctan2": 0.15, "radians": 0.1, "degrees": 0.1,
+}
+
+
+@pytest.mark.parametrize("name", sorted(FD_SPECS))
+def test_bf16_backward_parity(name):
+    """bf16 GRADIENTS must track f32 gradients within banded tolerance
+    across the whole FD registry (round-4 verdict item 7) — bf16 is
+    where training breaks (accumulation order, cast placement; this
+    repo's own r01 conv-transpose-under-vjp bug), and the forward grid
+    alone never exercised the VJPs at bf16."""
+    from mxnet_tpu import nd
+    spec = FD_SPECS[name]
+    build, loc = spec[0], spec[1]
+    kwargs = spec[2] if len(spec) > 2 else {}
+    grad_nodes = kwargs.get("grad_nodes")
+    r = np.random.RandomState(24680)
+    location = loc(r)
+    grads_by_dt = {}
+    for dt in (np.float32, _BF16):
+        s = build()
+        args = {k: nd.array(np.asarray(v, np.float32), dtype=dt)
+                for k, v in location.items()}
+        gnodes = grad_nodes or list(args)
+        grads = {k: nd.zeros(args[k].shape, dtype=dt) for k in gnodes}
+        req = {k: ("write" if k in grads else "null") for k in args}
+        ex = s.bind(mx.cpu(0), args, args_grad=grads, grad_req=req)
+        outs = ex.forward(is_train=True)
+        # fixed ones head-grads: same cotangent for both dtypes
+        ex.backward([nd.ones(o.shape, dtype=o.dtype) for o in outs])
+        grads_by_dt[dt] = {k: np.asarray(g.asnumpy(), np.float64)
+                           for k, g in grads.items()}
+    tol = _BF16_BWD_TOL.get(name, 0.06)
+    # atol floor: gradient magnitudes here are O(1); bf16 ulp ~ 0.008
+    for k in grads_by_dt[np.float32]:
+        a, b = grads_by_dt[np.float32][k], grads_by_dt[_BF16][k]
+        scale = max(1.0, float(np.abs(a).max()))
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol * scale,
+                                   err_msg="%s grad %s" % (name, k))
+
+
 _FWD_ONLY_RUNNABLE = {
     # name -> (builder, location) for a forward smoke of the
     # forward-only class (bool/int ops just need to execute and agree
