@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Multivariate time-series forecasting (LSTNet-style conv+GRU).
+
+Reference analog: ``example/multivariate_time_series/src/lstnet.py`` —
+forecasting D correlated channels: a 1-D conv over the time window
+extracts short-term motifs, a GRU summarizes them, a dense head predicts
+the next value of every channel, trained with L2 loss and evaluated by
+relative error vs the naive last-value forecast.
+
+Synthetic data: D=8 channels of phase-shifted sinusoids where channel d
+is a lagged mixture of channels (d-1, d-2) plus noise — the
+cross-channel correlations LSTNet's conv stage exists to exploit; the
+naive forecast cannot use them.
+
+Run:  python example/multivariate_time_series/lstnet.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+parser = argparse.ArgumentParser(
+    description="LSTNet-style multivariate forecasting",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=200)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--window", type=int, default=24)
+parser.add_argument("--horizon", type=int, default=6)
+parser.add_argument("--channels", type=int, default=8)
+parser.add_argument("--lr", type=float, default=0.005)
+
+
+def make_series(T, D, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(T + 2)
+    base = np.stack([np.sin(2 * np.pi * t / (12 + 3 * d) + d)
+                     for d in range(D)], 1)
+    x = base.copy()
+    for d in range(2, D):
+        x[:, d] = 0.4 * x[:, d] + 0.4 * np.roll(base[:, d - 1], 1) \
+            + 0.2 * np.roll(base[:, d - 2], 2)
+    return (x + rng.randn(*x.shape) * 0.05).astype(np.float32)
+
+
+class LSTNet(gluon.Block):
+    def __init__(self, channels, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            # conv over (window, channels) viewed as a 1xWxD image
+            self.conv = nn.Conv2D(32, kernel_size=(3, channels),
+                                  activation="relu")
+            self.gru = rnn.GRU(32, layout="NTC")
+            self.head = nn.Dense(channels)
+
+    def forward(self, x):                      # x: (B, W, D)
+        c = self.conv(x.expand_dims(1))        # (B, 32, W-2, 1)
+        seq = c.squeeze(axis=3).transpose((0, 2, 1))   # (B, W-2, 32)
+        h = self.gru(seq)                      # (B, W-2, 32)
+        return self.head(h[:, -1, :])          # (B, D)
+
+
+def main(args):
+    W, D = args.window, args.channels
+    series = make_series(4096, D)
+    rng = np.random.RandomState(1)
+
+    # horizon-h forecasting (the reference benchmarks horizons 3-24):
+    # at h steps out the last-value naive forecast decorrelates, so the
+    # model must use the temporal + cross-channel structure to win
+    h = args.horizon
+
+    def batch(bs):
+        idx = rng.randint(0, len(series) - W - h - 1, bs)
+        xb = np.stack([series[i:i + W] for i in idx])
+        yb = np.stack([series[i + W + h - 1] for i in idx])
+        return nd.array(xb), nd.array(yb)
+
+    net = LSTNet(D)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    l2 = gluon.loss.L2Loss()
+
+    for it in range(args.iters):
+        xb, yb = batch(args.batch_size)
+        with autograd.record():
+            loss = l2(net(xb), yb)
+        loss.backward()
+        trainer.step(args.batch_size)
+
+    # eval: model MSE vs naive last-value forecast MSE
+    xb, yb = batch(256)
+    pred = net(xb).asnumpy()
+    naive = xb.asnumpy()[:, -1, :]
+    y = yb.asnumpy()
+    mse = float(((pred - y) ** 2).mean())
+    mse_naive = float(((naive - y) ** 2).mean())
+    rel = mse / mse_naive
+    print("model MSE %.5f, naive MSE %.5f, ratio %.3f"
+          % (mse, mse_naive, rel))
+    return rel
+
+
+if __name__ == "__main__":
+    a = parser.parse_args()
+    rel = main(a)
+    raise SystemExit(0 if rel < 0.5 else 1)

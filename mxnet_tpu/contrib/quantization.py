@@ -405,6 +405,19 @@ def quantize_graph_fused(sym, arg_params, th_dict,
             qmemo[(id(node), 0)] = (out, t)
             continue
 
+        if op_name == "Pooling" and node.name not in excluded \
+                and pattrs["pool_type"] == "avg" \
+                and pattrs["global_pool"] \
+                and (id(ins[0][0]), ins[0][1]) in qmemo:
+            # s8 head (round 5): the mean preserves the threshold, so the
+            # chain stays quantized into the final FC (which then runs
+            # s8xs8->s32 with a dequantized f32 output)
+            q, t = qmemo[(id(ins[0][0]), ins[0][1])]
+            out = _create("_sg_int8_global_avg_pool", [q], {},
+                          name=node.name + "_int8")
+            qmemo[(id(node), 0)] = (out, t)
+            continue
+
         if op_name in ("Flatten", "flatten", "Activation") \
                 and (id(ins[0][0]), ins[0][1]) in qmemo:
             q, t = qmemo[(id(ins[0][0]), ins[0][1])]

@@ -58,26 +58,14 @@ class KVStore:
             self._store[k] = v0.copy()
 
     def push(self, key, value, priority=0):
-        from .ndarray.sparse import RowSparseNDArray, add as _sparse_add
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
-            vlist = v if isinstance(v, (list, tuple)) else [v]
-            # reduce across devices: the CommDevice tree reduce of comm.h
-            # becomes one XLA add chain (ICI all-reduce on a pod mesh)
-            if all(isinstance(x, RowSparseNDArray) for x in vlist):
-                agg = vlist[0]
-                for x in vlist[1:]:
-                    agg = _sparse_add(agg, x)
-            else:
-                agg = vlist[0]
-                if len(vlist) > 1:
-                    agg = vlist[0].tostype("default") \
-                        if isinstance(vlist[0], RowSparseNDArray) \
-                        else vlist[0].copy()
-                    for x in vlist[1:]:
-                        agg += x.as_in_context(agg.context)
+            # reduce across devices (the CommDevice tree reduce of comm.h
+            # becomes one XLA add chain; sparse lists stay sparse) —
+            # shared with the dist stores' pre-wire reduce
+            agg = _local_sum(v)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             else:
@@ -221,7 +209,9 @@ def _local_sum(v):
     if len(vlist) > 1:
         if all(isinstance(x, RowSparseNDArray) for x in vlist):
             for x in vlist[1:]:
-                agg = _sparse_add(agg, x)
+                # co-locate before the sparse scatter-add: mixing arrays
+                # committed to different devices raises in eager ops
+                agg = _sparse_add(agg, x.as_in_context(agg.context))
         else:
             agg = vlist[0].tostype("default") \
                 if isinstance(vlist[0], RowSparseNDArray) \

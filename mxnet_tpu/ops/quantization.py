@@ -292,6 +292,20 @@ def _sg_int8_pooling(attrs, data):
     return _pooling(attrs, data.astype(jnp.int8))
 
 
+@register("_sg_int8_global_avg_pool", nin=1)
+def _sg_int8_global_avg_pool(attrs, data):
+    """Global average pool on s8: s32 accumulate over HxW, round back to
+    s8.  The mean of values in [-t, t] stays in [-t, t], so the output
+    rides the input threshold unchanged — no requantize step (round-5
+    head probe: keeps the s8 chain alive into the final FC so
+    _sg_int8_fully_connected gets a quantized input instead of falling
+    back to f32)."""
+    axes = tuple(range(2, data.ndim))   # all spatial dims (1-D/2-D/3-D)
+    s = jnp.sum(data.astype(jnp.int32), axis=axes, keepdims=True)
+    hw = int(np.prod([data.shape[a] for a in axes]))
+    return jnp.clip(jnp.rint(s / hw), -127, 127).astype(jnp.int8)
+
+
 @register("_contrib_quantized_pooling", nin=3, nout=3,
           params={"kernel": param("shape", ()),
                   "pool_type": param(["max", "avg"], "max"),

@@ -126,13 +126,15 @@ def run_variant(variant):
         return outs[0]
 
     t = chain_time(fwdq, x._data)
+    t2 = chain_time(fwdq, x._data)   # same-session repeat: within-process
     ref = net(x).asnumpy().argmax(1)
     # jit: the eager per-op replay would hold every layer's s32
     # activations live at once and exhaust HBM at batch 128
     q_top1 = np.asarray(jax.jit(fwdq)(x._data)).argmax(1)
     agree = float((q_top1 == ref).mean())
     print(json.dumps({"variant": "int8", "ms": t * 1e3,
-                      "img_per_sec": batch / t,
+                      "ms_repeat": t2 * 1e3,
+                      "img_per_sec": batch / max(t, t2),
                       "top1_agreement_vs_fp32": agree, "batch": batch}))
     return 0
 
@@ -147,15 +149,36 @@ def main():
         extra.append("/root/.axon_site")
     env["PYTHONPATH"] = os.pathsep.join(
         extra + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
-    rows = {}
-    for variant in ("bf16", "int8"):
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), variant],
-            env=env, capture_output=True, text=True, timeout=1500)
-        if p.returncode != 0:
-            rows[variant] = {"error": p.stderr[-400:]}
+    n_runs = {"bf16": 1, "int8": 3}    # r4 verdict: pin the int8
+    rows = {}                          # 6.2-7.8 ms swing within vs
+    for variant in ("bf16", "int8"):   # across processes
+        runs = []
+        for _ in range(n_runs[variant]):
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), variant],
+                env=env, capture_output=True, text=True, timeout=1500)
+            if p.returncode != 0:
+                runs.append({"error": p.stderr[-400:]})
+                continue
+            runs.append(json.loads(p.stdout.strip().splitlines()[-1]))
+        ok = [r for r in runs if "error" not in r]
+        if not ok:
+            rows[variant] = runs[0]
             continue
-        rows[variant] = json.loads(p.stdout.strip().splitlines()[-1])
+        # headline = the CONSERVATIVE (slowest) clean observation,
+        # consistent across ms and img_per_sec; all clean runs kept for
+        # the variance story, failures counted
+        def worst_ms(r):
+            return max(r["ms"], r.get("ms_repeat", r["ms"]))
+        head = dict(max(ok, key=worst_ms))
+        head["ms"] = worst_ms(head)
+        head["img_per_sec"] = head["batch"] / (head["ms"] / 1e3)
+        rows[variant] = head
+        if len(runs) > 1:
+            rows[variant]["all_ms"] = [r["ms"] for r in ok]
+            rows[variant]["all_ms_repeat"] = [r.get("ms_repeat")
+                                              for r in ok]
+            rows[variant]["failed_runs"] = len(runs) - len(ok)
 
     out = {"metric": "resnet50_int8_vs_bf16_inference"}
     out.update(rows)
