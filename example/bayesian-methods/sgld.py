@@ -3,7 +3,8 @@
 
 Reference analog: ``example/bayesian-methods/sgld.ipynb`` /
 ``bdk_demo.py`` (Welling & Teh 2011) — stochastic gradient Langevin
-dynamics: each step adds N(0, lr) noise to the SGD update so the iterates
+dynamics: each step adds N(0, eps) noise to the eps/2-scaled gradient
+step (eps = lr/N here) so the iterates
 SAMPLE the posterior instead of collapsing to the MAP; predictions
 average over the collected samples (Bayesian model averaging), and the
 posterior spread is meaningful uncertainty, not noise.

@@ -92,9 +92,8 @@ def main(args):
         with autograd.record():
             outs = net(x)
             # summed per-slot CE (the multi-head captcha loss)
-            loss = outs[0].sum() * 0
-            for j, o in enumerate(outs):
-                loss = loss + ce(o, y[:, j]).mean()
+            loss = sum(ce(o, y[:, j]).mean()
+                       for j, o in enumerate(outs))
         loss.backward()
         trainer.step(args.batch_size)
         if it >= args.iters - 15:

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""CNN visualization: vanilla saliency + Grad-CAM.
+
+Reference analog: ``example/cnn_visualization/gradcam.py`` — explain a
+CNN's prediction by (a) the input-gradient saliency map and (b) Grad-CAM:
+weight the last conv layer's activation maps by their pooled gradients
+and relu the sum, localizing WHERE the evidence is.
+
+Verifiable synthetic setup: train a small conv net on the lit-patch
+digits, then check that BOTH maps concentrate their mass inside the
+patch that determines the class — ground truth for "the explanation
+points at the evidence" that real photos can't give.
+
+Run:  python example/cnn_visualization/gradcam.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+parser = argparse.ArgumentParser(
+    description="Saliency + Grad-CAM on a synthetic-digit CNN",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=120)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--px", type=int, default=16)
+parser.add_argument("--lr", type=float, default=0.05)
+
+
+class Net(gluon.Block):
+    """Trunk conv stack with an exposed last-conv feature map."""
+
+    def __init__(self, n_class=10, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = nn.Conv2D(16, 3, padding=1, activation="relu")
+            self.c2 = nn.Conv2D(32, 3, padding=1, activation="relu")
+            self.head = nn.Dense(n_class)
+
+    def features(self, x):
+        return self.c2(self.c1(x))               # (B, 32, H, W)
+
+    def forward(self, x):
+        f = self.features(x)
+        return self.head(nd.mean(f, axis=(2, 3)))
+
+
+def make_batch(rng, bs, px, n_class=10):
+    xs = np.zeros((bs, 1, px, px), np.float32)
+    ys = np.zeros((bs,), np.float32)
+    boxes = []
+    for i in range(bs):
+        c = int(rng.randint(n_class))
+        ys[i] = c
+        r0, c0 = (c // 5) * (px // 2), (c % 5) * 3
+        xs[i, 0, r0:r0 + 4, c0:c0 + 4] = 1.0
+        boxes.append((r0, c0))
+    xs += rng.randn(bs, 1, px, px).astype(np.float32) * 0.1
+    return nd.array(xs), nd.array(ys), boxes
+
+
+def mass_inside(maps, boxes, pad=1):
+    """Fraction of (relu'd) map mass inside the evidence box, averaged."""
+    fr = []
+    for m, (r0, c0) in zip(maps, boxes):
+        m = np.maximum(m, 0)
+        total = m.sum() + 1e-9
+        r1, c1 = max(0, r0 - pad), max(0, c0 - pad)
+        inside = m[r1:r0 + 4 + pad, c1:c0 + 4 + pad].sum()
+        fr.append(inside / total)
+    return float(np.mean(fr))
+
+
+def main(args):
+    rng = np.random.RandomState(0)
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": args.lr, "momentum": 0.9})
+    for it in range(args.iters):
+        x, y, _ = make_batch(rng, args.batch_size, args.px)
+        with autograd.record():
+            loss = ce(net(x), y)
+        loss.backward()
+        tr.step(args.batch_size)
+
+    # --- explanations on a fresh batch -------------------------------
+    x, y, boxes = make_batch(rng, 16, args.px)
+    x.attach_grad()
+    with autograd.record():
+        f = net.features(x)
+        score = nd.pick(net.head(nd.mean(f, axis=(2, 3))), y)
+        s = nd.sum(score)
+    s.backward()
+    saliency = np.abs(x.grad.asnumpy())[:, 0]          # (B, H, W)
+
+    # Grad-CAM: pooled d score / d feature-map weights the channels.
+    # With a global-mean + linear head the pooled gradient IS the head
+    # row (dscore/df[c] = W[y,c]/HW), so the weights come straight from
+    # the trained head — same math, one backward saved
+    W = net.head.weight.data().asnumpy()               # (10, 32)
+    fmap = f.asnumpy()                                 # (B, 32, H, W)
+    cams = []
+    for i in range(len(fmap)):
+        wvec = W[int(y.asnumpy()[i])]                  # (32,)
+        cams.append(np.einsum("c,chw->hw", wvec, fmap[i]))
+    sal_frac = mass_inside(saliency, boxes)
+    cam_frac = mass_inside(np.stack(cams), boxes)
+    # baseline: the box covers 16/256 = 6% of the image
+    print("saliency mass in box: %.3f   grad-cam mass in box: %.3f "
+          "(box area fraction %.3f)"
+          % (sal_frac, cam_frac, 16.0 / (args.px * args.px)))
+    return sal_frac, cam_frac
+
+
+if __name__ == "__main__":
+    a = parser.parse_args()
+    sal, cam = main(a)
+    # both explanations concentrate well above the 6% area baseline
+    # (input-grad saliency is noisier than CAM by nature)
+    raise SystemExit(0 if sal > 0.15 and cam > 0.3 else 1)

@@ -66,8 +66,9 @@ class CharLM(gluon.Block):
 
 
 def train(layout, args):
-    rng = np.random.RandomState(7)     # same stream both layouts
-    net = CharLM(args.vocab, args.hidden, layout)
+    rng = np.random.RandomState(7)     # same DATA stream both layouts
+    mx.random.seed(0)                  # ...and the same parameter init,
+    net = CharLM(args.vocab, args.hidden, layout)   # so ppls compare
     net.initialize(mx.init.Xavier())
     ce = gluon.loss.SoftmaxCrossEntropyLoss()
     tr = gluon.Trainer(net.collect_params(), "adam",
@@ -99,7 +100,8 @@ def main(args):
 if __name__ == "__main__":
     a = parser.parse_args()
     p_ntc, p_tnc = main(a)
-    # both layouts learn the 90% rule (ppl well under uniform=16) and
-    # agree with each other (layout is semantics-free)
-    ok = p_ntc < 6 and p_tnc < 6 and abs(p_ntc - p_tnc) / p_ntc < 0.25
+    # both layouts learn the 90% rule (ppl well under uniform=16) and —
+    # with seeded init + identical data — match near-exactly (layout is
+    # semantics-free; only transpose-order float rounding differs)
+    ok = p_ntc < 6 and p_tnc < 6 and abs(p_ntc - p_tnc) / p_ntc < 0.02
     raise SystemExit(0 if ok else 1)

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Speech recognition: BiLSTM acoustic model + CTC on spectrogram frames.
+
+Reference analog: ``example/speech_recognition/main.py`` (the
+DeepSpeech-style recipe: spectrogram -> recurrent acoustic model -> CTC
+over unaligned transcripts; ``arch_deepspeech.py``).
+
+Synthetic speech: each "utterance" is a sequence of phones; a phone p is
+rendered as 3-6 frames of a characteristic spectral envelope (two
+"formant" bumps over 20 mel-ish bands) with speaker-level gain and
+additive noise, separated by silence gaps.  The acoustic model must
+learn BOTH the spectral identity of each phone and the alignment — the
+CTC marginalization handles the latter.  Greedy decode, phone error
+measured as exact-match rate of collapsed sequences.
+
+Run:  python example/speech_recognition/speech_lstm_ctc.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+parser = argparse.ArgumentParser(
+    description="BiLSTM+CTC acoustic model on synthetic speech",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=200)
+parser.add_argument("--batch-size", type=int, default=16)
+parser.add_argument("--n-phones", type=int, default=6)
+parser.add_argument("--n-bands", type=int, default=20)
+parser.add_argument("--max-frames", type=int, default=40)
+parser.add_argument("--hidden", type=int, default=32)
+parser.add_argument("--lr", type=float, default=0.01)
+
+
+def _phone_envelope(p, n_bands):
+    """Two formant bumps whose centers encode the phone identity."""
+    f1 = 2 + (p * 3) % (n_bands // 2)
+    f2 = n_bands // 2 + (p * 5) % (n_bands // 2 - 2)
+    band = np.arange(n_bands)
+    env = (np.exp(-0.5 * ((band - f1) / 1.5) ** 2)
+           + 0.8 * np.exp(-0.5 * ((band - f2) / 2.0) ** 2))
+    return env.astype(np.float32)
+
+
+def make_batch(rng, bs, n_phones, n_bands, T):
+    """(frames, labels, label_lens): 2-4 phones per utterance, each
+    3-6 frames, 1-3 silence frames between."""
+    xs = np.zeros((bs, T, n_bands), np.float32)
+    max_l = 4
+    # gluon CTCLoss convention (blank_label="last"): labels 0-based,
+    # padding -1, blank = n_phones (the last class)
+    ys = np.full((bs, max_l), -1.0, np.float32)
+    lens = np.zeros((bs,), np.int32)
+    for i in range(bs):
+        n = int(rng.randint(2, max_l + 1))
+        t = int(rng.randint(0, 3))
+        gain = 0.8 + 0.4 * rng.uniform()
+        lab = 0
+        for j in range(n):
+            if t >= T:
+                break          # no room: the transcript must not carry
+            p = int(rng.randint(n_phones))       # phones with no audio
+            ys[i, lab] = p
+            lab += 1
+            dur = int(rng.randint(4, 8))
+            env = _phone_envelope(p, n_bands) * gain
+            for _ in range(dur):
+                if t >= T:
+                    break
+                xs[i, t] = env
+                t += 1
+            t += int(rng.randint(1, 4))          # silence gap
+        lens[i] = lab
+    xs += rng.randn(bs, T, n_bands).astype(np.float32) * 0.08
+    return nd.array(xs), nd.array(ys), lens
+
+
+class AcousticModel(gluon.Block):
+    def __init__(self, n_out, hidden, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.proj_in = nn.Dense(hidden, flatten=False,
+                                    activation="relu")
+            self.lstm = rnn.LSTM(hidden, num_layers=1, bidirectional=True,
+                                 layout="NTC")
+            self.head = nn.Dense(n_out, flatten=False)
+
+    def forward(self, x):                        # (B, T, bands)
+        h = self.proj_in(x)
+        h = self.lstm(h)
+        return self.head(h)                      # (B, T, n_phones+1)
+
+
+def greedy_decode(logits):
+    """Best path: argmax per frame, collapse repeats, strip blanks
+    (blank = last class, the gluon CTCLoss convention)."""
+    blank = logits.shape[-1] - 1
+    path = logits.argmax(-1)
+    out = []
+    for row in path:
+        seq, prev = [], -1
+        for s in row:
+            if s != prev and s != blank:
+                seq.append(int(s))
+            prev = s
+        out.append(seq)
+    return out
+
+
+def main(args):
+    rng = np.random.RandomState(0)
+    net = AcousticModel(args.n_phones + 1, args.hidden)
+    net.initialize(mx.init.Xavier())
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    matches = []
+    for it in range(args.iters):
+        x, y, lens = make_batch(rng, args.batch_size, args.n_phones,
+                                args.n_bands, args.max_frames)
+        with autograd.record():
+            logits = net(x)
+            loss = ctc(logits, y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it >= args.iters - 15:
+            decoded = greedy_decode(logits.asnumpy())
+            for i in range(args.batch_size):
+                truth = [int(v) for v in y.asnumpy()[i][:lens[i]]]
+                matches.append(float(decoded[i] == truth))
+    acc = float(np.mean(matches))
+    print("utterance exact-match rate: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    a = parser.parse_args()
+    acc = main(a)
+    raise SystemExit(0 if acc > 0.7 else 1)

@@ -428,6 +428,9 @@ EXEMPT = {
                              "tests/test_quantization.py",
     "_sg_int8_pooling": "int8 fused inference op; "
                         "tests/test_quantization.py",
+    "_sg_int8_global_avg_pool": "int8 fused inference op (s8 head); "
+                                "tests/test_quantization.py + "
+                                "bench_int8 top-1 agreement",
     # random / init: stochastic or constant outputs
     "_arange": "deterministic init; tests/test_ndarray.py",
     "_eye": "init", "_full": "init", "_linspace": "init",

@@ -345,3 +345,19 @@ def test_fused_int8_skips_1d_conv():
     got = qsym.bind(mx.cpu(), {**qargs, "data": x}, aux_states=qauxs) \
         .forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.05)
+
+
+def test_sg_int8_global_avg_pool_exact():
+    """s8 global mean: s32 accumulate, rint back to s8, threshold
+    unchanged (the round-5 head op); matches the f32 mean of the
+    dequantized input within one s8 lattice step."""
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = np.random.RandomState(0)
+    q = rng.randint(-127, 128, size=(2, 4, 5, 5)).astype(np.int8)
+    out = invoke("_sg_int8_global_avg_pool", [nd.array(q, dtype="int8")],
+                 {}).asnumpy()
+    want = np.rint(q.astype(np.float64).mean((2, 3), keepdims=True))
+    np.testing.assert_allclose(out.astype(np.float64), want, atol=0.51)
+    assert out.dtype == np.int8

@@ -294,7 +294,8 @@ def test_rnn_time_major_example():
                "rnn_time_major")
     p_ntc, p_tnc = tm.main(tm.parser.parse_args(["--iters", "100"]))
     assert p_ntc < 6 and p_tnc < 6, (p_ntc, p_tnc)
-    assert abs(p_ntc - p_tnc) / p_ntc < 0.3, (p_ntc, p_tnc)
+    # seeded init + same data: near-exact layout parity
+    assert abs(p_ntc - p_tnc) / p_ntc < 0.02, (p_ntc, p_tnc)
 
 
 def test_long_context_ring_lm_example():
@@ -310,3 +311,23 @@ def test_long_context_ring_lm_example():
     p0, p1 = rl.main(rl.parser.parse_args(
         ["--iters", "150", "--sp", "4", "--seq-len", "128"]))
     assert p1 < 8.0 and p1 < 0.5 * p0, (p0, p1)
+
+
+def test_cnn_visualization_example():
+    """Saliency + Grad-CAM concentrate their mass on the evidence patch
+    (synthetic ground truth for 'the explanation points at the
+    evidence'); box covers only 6% of the image."""
+    gc = _load("example/cnn_visualization/gradcam.py", "gradcam")
+    sal, cam = gc.main(gc.parser.parse_args(["--iters", "100"]))
+    assert sal > 0.15, sal
+    assert cam > 0.3, cam
+
+
+def test_speech_recognition_example():
+    """BiLSTM+CTC acoustic model: learns phone identity AND alignment
+    from unaligned transcripts (blank=last convention)."""
+    sp = _load("example/speech_recognition/speech_lstm_ctc.py",
+               "speech_lstm_ctc")
+    acc = sp.main(sp.parser.parse_args(
+        ["--iters", "200", "--max-frames", "32"]))
+    assert acc > 0.6, acc

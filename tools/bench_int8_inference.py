@@ -92,9 +92,13 @@ def run_variant(variant):
             outs, _ = plan.execute({**vals, "data": data}, avals, keys)
             return outs[0]
 
-        t = chain_time(fwd, x._data.astype(jnp.bfloat16))
-        print(json.dumps({"variant": "bf16", "ms": t * 1e3,
-                          "img_per_sec": batch / t, "batch": batch}))
+        xb = x._data.astype(jnp.bfloat16)
+        t = chain_time(fwd, xb)
+        t2 = chain_time(fwd, xb)       # same-session repeat
+        worst = max(t, t2)
+        print(json.dumps({"variant": "bf16", "ms": worst * 1e3,
+                          "ms_first": t * 1e3, "ms_repeat": t2 * 1e3,
+                          "img_per_sec": batch / worst, "batch": batch}))
         return 0
 
     # int8
@@ -127,14 +131,15 @@ def run_variant(variant):
 
     t = chain_time(fwdq, x._data)
     t2 = chain_time(fwdq, x._data)   # same-session repeat: within-process
+    worst = max(t, t2)
     ref = net(x).asnumpy().argmax(1)
     # jit: the eager per-op replay would hold every layer's s32
     # activations live at once and exhaust HBM at batch 128
     q_top1 = np.asarray(jax.jit(fwdq)(x._data)).argmax(1)
     agree = float((q_top1 == ref).mean())
-    print(json.dumps({"variant": "int8", "ms": t * 1e3,
-                      "ms_repeat": t2 * 1e3,
-                      "img_per_sec": batch / max(t, t2),
+    print(json.dumps({"variant": "int8", "ms": worst * 1e3,
+                      "ms_first": t * 1e3, "ms_repeat": t2 * 1e3,
+                      "img_per_sec": batch / worst,
                       "top1_agreement_vs_fp32": agree, "batch": batch}))
     return 0
 
@@ -149,9 +154,9 @@ def main():
         extra.append("/root/.axon_site")
     env["PYTHONPATH"] = os.pathsep.join(
         extra + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
-    n_runs = {"bf16": 1, "int8": 3}    # r4 verdict: pin the int8
-    rows = {}                          # 6.2-7.8 ms swing within vs
-    for variant in ("bf16", "int8"):   # across processes
+    n_runs = {"bf16": 3, "int8": 3}    # both variants: 3 processes x 2
+    rows = {}                          # measurements — the bimodal
+    for variant in ("bf16", "int8"):   # lowering lands on either side
         runs = []
         for _ in range(n_runs[variant]):
             p = subprocess.run(
@@ -168,14 +173,9 @@ def main():
         # headline = the CONSERVATIVE (slowest) clean observation,
         # consistent across ms and img_per_sec; all clean runs kept for
         # the variance story, failures counted
-        def worst_ms(r):
-            return max(r["ms"], r.get("ms_repeat", r["ms"]))
-        head = dict(max(ok, key=worst_ms))
-        head["ms"] = worst_ms(head)
-        head["img_per_sec"] = head["batch"] / (head["ms"] / 1e3)
-        rows[variant] = head
+        rows[variant] = dict(max(ok, key=lambda r: r["ms"]))
         if len(runs) > 1:
-            rows[variant]["all_ms"] = [r["ms"] for r in ok]
+            rows[variant]["all_ms_first"] = [r.get("ms_first") for r in ok]
             rows[variant]["all_ms_repeat"] = [r.get("ms_repeat")
                                               for r in ok]
             rows[variant]["failed_runs"] = len(runs) - len(ok)
