@@ -75,6 +75,9 @@ def mass_inside(maps, boxes, pad=1):
 
 
 def main(args):
+    if args.px < 16:
+        raise SystemExit("--px must be >= 16: the 10-class patch layout "
+                         "places evidence up to column 15")
     rng = np.random.RandomState(0)
     net = Net()
     net.initialize(mx.init.Xavier())
