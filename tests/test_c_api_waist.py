@@ -37,8 +37,12 @@ def test_c_binary_full_surface():
     paths in a fresh process."""
     _make("./c_api_test")
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # PYTHONPATH = repo ONLY and JAX_PLATFORMS forced: an accelerator
+    # sitecustomize on the inherited path re-registers the real backend,
+    # and the axon client's teardown can crash an otherwise-successful
+    # embedded-interpreter process at exit (rc -11 after "TRAIN OK")
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run([os.path.join(SRC, "c_api_test")], env=env,
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
@@ -51,8 +55,12 @@ def test_c_binary_symbolic_surface():
     (round-5 addition — reference c_api.h Parts 3-4)."""
     _make("./c_api_sym_test")
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # PYTHONPATH = repo ONLY and JAX_PLATFORMS forced: an accelerator
+    # sitecustomize on the inherited path re-registers the real backend,
+    # and the axon client's teardown can crash an otherwise-successful
+    # embedded-interpreter process at exit (rc -11 after "TRAIN OK")
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run([os.path.join(SRC, "c_api_sym_test")], env=env,
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr

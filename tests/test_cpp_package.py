@@ -28,8 +28,12 @@ def _build():
 
 def _run(binary):
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # PYTHONPATH = repo ONLY and JAX_PLATFORMS forced: an accelerator
+    # sitecustomize on the inherited path re-registers the real backend,
+    # and the axon client's teardown can crash an otherwise-successful
+    # embedded-interpreter process at exit (rc -11 after "TRAIN OK")
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
     return subprocess.run([os.path.join(EXDIR, binary)], env=env,
                           capture_output=True, text=True, timeout=600)
 
