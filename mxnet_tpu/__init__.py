@@ -14,6 +14,7 @@ from .storage import apply_pool_env as _apply_pool_env
 _apply_pool_env()
 
 from .base import MXNetError
+from . import telemetry
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus)
 from . import engine

@@ -27,12 +27,45 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
 from .base import get_env
+from . import telemetry as _telemetry
 
 __all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get", "set_engine"]
+
+# Bound label children cached at module scope so the enabled hot path pays
+# one attribute check + one locked add per event (disabled: attribute
+# check only — the bench-critical fast path).
+_OPS_PUSHED = _telemetry.counter(
+    "engine_ops_pushed_total",
+    "Operations pushed to the dependency engine", ("engine",))
+_OPS_DONE = _telemetry.counter(
+    "engine_ops_completed_total",
+    "Operations completed by the dependency engine", ("engine",))
+_QUEUE_DEPTH = _telemetry.gauge(
+    "engine_queue_depth",
+    "Engine ops in flight (pushed but not yet completed)", ("engine",))
+_DISPATCH_LAT = _telemetry.histogram(
+    "engine_dispatch_latency_seconds",
+    "Delay between push and execution start (dependency wait + queueing)",
+    ("engine",))
+_WORKERS_BUSY = _telemetry.gauge(
+    "engine_workers_busy", "Worker threads currently executing an op")
+_WORKERS_TOTAL = _telemetry.gauge(
+    "engine_workers_total", "Size of the engine worker pool")
+
+_T_PUSHED = _OPS_PUSHED.labels(engine="threaded")
+_T_DONE = _OPS_DONE.labels(engine="threaded")
+_T_DEPTH = _QUEUE_DEPTH.labels(engine="threaded")
+_T_DISPATCH = _DISPATCH_LAT.labels(engine="threaded")
+_N_PUSHED = _OPS_PUSHED.labels(engine="naive")
+_N_DONE = _OPS_DONE.labels(engine="naive")
+_NAT_PUSHED = _OPS_PUSHED.labels(engine="native")
+_NAT_DONE = _OPS_DONE.labels(engine="native")
+_NAT_DEPTH = _QUEUE_DEPTH.labels(engine="native")
 
 
 class Var:
@@ -65,7 +98,7 @@ class _OprBlock:
     """Analog of ``OprBlock`` (``threaded_engine.h:66``)."""
 
     __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "name", "exc",
-                 "done")
+                 "done", "t_push")
 
     def __init__(self, fn, const_vars, mutable_vars, name):
         self.fn = fn
@@ -75,6 +108,7 @@ class _OprBlock:
         self.name = name
         self.exc: Optional[BaseException] = None
         self.done = threading.Event()
+        self.t_push = 0.0  # set at push only when telemetry is enabled
 
 
 class Engine:
@@ -113,6 +147,8 @@ class NaiveEngine(Engine):
     """Synchronous engine: ops run inline at push (``naive_engine.cc``)."""
 
     def push(self, fn, const_vars=(), mutable_vars=(), name=""):
+        if _telemetry.enabled:
+            _N_PUSHED.inc()
         for v in tuple(const_vars) + tuple(mutable_vars):
             if v.exc is not None:
                 raise v.exc
@@ -122,6 +158,9 @@ class NaiveEngine(Engine):
             for v in mutable_vars:
                 v.exc = e
             raise
+        finally:
+            if _telemetry.enabled:
+                _N_DONE.inc()
 
     def wait_for_var(self, var):
         if var.exc is not None:
@@ -143,6 +182,8 @@ class ThreadedEngine(Engine):
         self._lock = threading.Lock()  # guards all var state + counters
         self._inflight = 0
         self._idle = threading.Condition(self._lock)
+        if _telemetry.enabled:
+            _WORKERS_TOTAL.set(n)
 
     def push(self, fn, const_vars=(), mutable_vars=(), name=""):
         self._push(fn, const_vars, mutable_vars, name)
@@ -151,9 +192,14 @@ class ThreadedEngine(Engine):
         mvars = list(dict.fromkeys(mutable_vars))
         cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
         opr = _OprBlock(fn, cvars, mvars, name)
+        if _telemetry.enabled:
+            opr.t_push = time.perf_counter()
+            _T_PUSHED.inc()
         to_run: List[_OprBlock] = []
         with self._lock:
             self._inflight += 1
+            if _telemetry.enabled:
+                _T_DEPTH.set(self._inflight)
             opr.wait = len(cvars) + len(mvars)
             for v in cvars:
                 v.queue.append((opr, False))
@@ -187,6 +233,11 @@ class ThreadedEngine(Engine):
                 break
 
     def _execute(self, opr: _OprBlock):
+        tel = _telemetry.enabled  # one sample: pair the inc with its dec
+        if tel:
+            if opr.t_push:
+                _T_DISPATCH.observe(time.perf_counter() - opr.t_push)
+            _WORKERS_BUSY.inc()
         try:
             for v in opr.const_vars + opr.mutable_vars:
                 if v.exc is not None:
@@ -197,6 +248,9 @@ class ThreadedEngine(Engine):
             for v in opr.mutable_vars:
                 v.exc = e
         finally:
+            if tel:
+                _WORKERS_BUSY.dec()
+                _T_DONE.inc()
             self._on_complete(opr)
 
     def _on_complete(self, opr: _OprBlock):
@@ -210,6 +264,8 @@ class ThreadedEngine(Engine):
                 v.granted_write = False
                 self._try_grant(v, to_run)
             self._inflight -= 1
+            if _telemetry.enabled:
+                _T_DEPTH.set(self._inflight)
             if self._inflight == 0:
                 self._idle.notify_all()
         opr.done.set()
@@ -286,7 +342,13 @@ class NativeThreadedEngine(Engine):
             # fn is skipped — so closure state is released and push_sync
             # waiters are woken (src/engine.cc Execute contract)
             with eng._lock:
-                fn, done = eng._pending.pop(key)
+                fn, done, t_push = eng._pending.pop(key)
+                depth = len(eng._pending)
+            if _telemetry.enabled:
+                if t_push:
+                    _DISPATCH_LAT.labels(engine="native").observe(
+                        time.perf_counter() - t_push)
+                _NAT_DEPTH.set(depth)
             code = int(prior_err)
             if code == 0:
                 try:
@@ -301,6 +363,8 @@ class NativeThreadedEngine(Engine):
             if done is not None:
                 done.code = code
                 done.set()
+            if _telemetry.enabled:
+                _NAT_DONE.inc()
             return code
 
         self._trampoline = _trampoline  # keep alive
@@ -329,10 +393,16 @@ class NativeThreadedEngine(Engine):
     def _push(self, fn, const_vars, mutable_vars, done=None, prio=0):
         mvars = list(dict.fromkeys(mutable_vars))
         cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
+        tel = _telemetry.enabled
+        if tel:
+            _NAT_PUSHED.inc()
         with self._lock:
             key = self._next[0]
             self._next[0] += 1
-            self._pending[key] = (fn, done)
+            self._pending[key] = (fn, done,
+                                  time.perf_counter() if tel else 0.0)
+            if tel:
+                _NAT_DEPTH.set(len(self._pending))
         self._lib.MXNativeEnginePush(
             self._handle, self._fn_ptr, key,
             self._var_array(cvars), len(cvars),

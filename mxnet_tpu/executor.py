@@ -24,8 +24,19 @@ import numpy as np
 from .base import MXNetError, AttrDict
 from .context import Context
 from . import random as _random
+from . import telemetry as _telemetry
 
 __all__ = ["Executor"]
+
+# wall-time histograms fed through profiler.span so the Chrome trace and
+# the metrics registry share one measurement per call
+_FWD_TIME = _telemetry.histogram(
+    "executor_forward_seconds", "Executor.forward wall time")
+_BWD_TIME = _telemetry.histogram(
+    "executor_backward_seconds", "Executor.backward wall time")
+_FWDBWD_TIME = _telemetry.histogram(
+    "executor_forward_backward_seconds",
+    "Fused Executor.forward_backward wall time")
 
 
 class _Plan:
@@ -374,20 +385,18 @@ class Executor:
         plan = self._plan(bool(is_train))
         keys = self._keys(plan)
         self._last_keys = keys
-        _prof = _profiler.is_running()
-        _pt0 = _profiler._now_us() if _prof else 0.0
-        if self._monitor is not None:
-            args, auxs = self._gather()
-            outs, new_aux = plan.execute(
-                dict(zip(self.arg_names, args)),
-                dict(zip(self.aux_names, auxs)), keys,
-                monitor=self._monitor)
-            new_aux = [new_aux[n] for n in self.aux_names]
-        else:
-            outs, new_aux = self._fwd_fn(bool(is_train))(*self._gather(), keys)
-        if _prof:
-            _profiler.record_span("Executor::Forward", _pt0,
-                                  _profiler._now_us(), "executor")
+        with _profiler.span("Executor::Forward", "executor",
+                            histogram=_FWD_TIME):
+            if self._monitor is not None:
+                args, auxs = self._gather()
+                outs, new_aux = plan.execute(
+                    dict(zip(self.arg_names, args)),
+                    dict(zip(self.aux_names, auxs)), keys,
+                    monitor=self._monitor)
+                new_aux = [new_aux[n] for n in self.aux_names]
+            else:
+                outs, new_aux = self._fwd_fn(bool(is_train))(*self._gather(),
+                                                             keys)
         if is_train:
             self._writeback_aux(new_aux)
         return self._wrap_outputs(outs)
@@ -410,8 +419,11 @@ class Executor:
         keys = self._last_keys if self._last_keys is not None \
             else self._keys(plan)
         args, auxs = self._gather()
-        outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
-        self._apply_grads(grads)
+        from . import profiler as _profiler
+        with _profiler.span("Executor::Backward", "executor",
+                            histogram=_BWD_TIME):
+            outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+            self._apply_grads(grads)
         return
 
     def forward_backward(self, out_grads=None, **kwargs):
@@ -437,9 +449,12 @@ class Executor:
         else:
             ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
-        outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
-        self._writeback_aux(new_aux)
-        self._apply_grads(grads)
+        from . import profiler as _profiler
+        with _profiler.span("Executor::ForwardBackward", "executor",
+                            histogram=_FWDBWD_TIME):
+            outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+            self._writeback_aux(new_aux)
+            self._apply_grads(grads)
         return self._wrap_outputs(outs)
 
     def _apply_grads(self, grads):

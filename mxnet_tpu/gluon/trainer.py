@@ -11,12 +11,21 @@ parameter.
 """
 from __future__ import annotations
 
+import time
+
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from .. import telemetry as _telemetry
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
+
+_STEPS = _telemetry.counter(
+    "trainer_steps_total", "Optimization steps taken by gluon.Trainer")
+_SYNC_LAT = _telemetry.histogram(
+    "trainer_grad_sync_seconds",
+    "Gradient push/pull (allreduce) latency per Trainer step")
 
 
 class Trainer:
@@ -129,6 +138,8 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if _telemetry.enabled:
+            _STEPS.inc()
 
     def allreduce_grads(self):
         """Reduce gradients over devices only (then call update())."""
@@ -144,11 +155,15 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        tel = _telemetry.enabled
+        t0 = time.perf_counter() if tel else 0.0
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 self._kvstore.push(i, param.list_grad(), priority=-i)
                 if not self._update_on_kvstore:
                     self._kvstore.pull(i, param.list_grad(), priority=-i)
+        if tel:
+            _SYNC_LAT.observe(time.perf_counter() - t0)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Update parameters only (after allreduce_grads)."""

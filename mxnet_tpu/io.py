@@ -15,6 +15,7 @@ import gzip
 import queue
 import struct
 import threading
+import time
 from collections import namedtuple
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -22,7 +23,18 @@ import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
+
+_IO_BATCHES = _telemetry.counter(
+    "io_batches_total", "Batches produced by data iterators", ("iter",))
+_IO_WAIT = _telemetry.histogram(
+    "io_prefetch_wait_seconds",
+    "Consumer-side wait on the prefetch queue (0 when a batch was ready)",
+    ("iter",))
+_IO_WS = _telemetry.gauge(
+    "io_workspace_bytes",
+    "Pooled staging-workspace bytes held by the iterator", ("iter",))
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
@@ -64,8 +76,11 @@ class DataIter:
 
     def next(self) -> DataBatch:
         if self.iter_next():
-            return DataBatch(self.getdata(), self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+            batch = DataBatch(self.getdata(), self.getlabel(),
+                              pad=self.getpad(), index=self.getindex())
+            if _telemetry.enabled:
+                _IO_BATCHES.labels(iter=type(self).__name__).inc()
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -358,15 +373,27 @@ class PrefetchingIter(DataIter):
         self._stop.clear()
         self._start()
 
+    def _get_timed(self):
+        """Queue get, measuring how long the consumer sat starved."""
+        if not _telemetry.enabled:
+            return self._queue.get()
+        t0 = time.perf_counter()
+        batch = self._queue.get()
+        _IO_WAIT.labels(iter="PrefetchingIter").observe(
+            time.perf_counter() - t0)
+        return batch
+
     def __next__(self):
         # honor a batch already fetched by iter_next() (reference
         # PrefetchingIter: iter_next fills current_batch, next returns it)
         if self.current_batch is not None:
             batch, self.current_batch = self.current_batch, None
             return batch
-        batch = self._queue.get()
+        batch = self._get_timed()
         if batch is None:
             raise StopIteration
+        if _telemetry.enabled:
+            _IO_BATCHES.labels(iter="PrefetchingIter").inc()
         return batch
 
     next = __next__
@@ -374,9 +401,11 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if self.current_batch is not None:
             return True
-        batch = self._queue.get()
+        batch = self._get_timed()
         if batch is None:
             return False
+        if _telemetry.enabled:
+            _IO_BATCHES.labels(iter="PrefetchingIter").inc()
         self.current_batch = batch
         return True
 
@@ -536,6 +565,15 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self._stop_producer()
+        if getattr(self, "_workspace_res", None) is None:
+            # resuming after close(): reset() is the one sanctioned way to
+            # bring the iterator back, so re-acquire the temp-space slot
+            # (the pool is likewise rebuilt by _start_producer below)
+            from . import resource as _resource
+            from . import context as _ctx
+            self._workspace_res = _resource.ResourceManager.get().request(
+                _ctx.cpu(0), _resource.ResourceRequest(
+                    _resource.ResourceRequest.kTempSpace))
         self.rec.reset()
         if self.keys is not None:
             self._order = list(self.keys)
@@ -560,16 +598,16 @@ class ImageRecordIter(DataIter):
 
     @property
     def _workspace(self):
-        # reset() after close() restarts the producer, so re-acquire the
-        # temp-space slot lazily instead of crashing on the released one
-        # (advisor r04: close-then-reuse must keep working)
-        if getattr(self, "_workspace_res", None) is None:
-            from . import resource as _resource
-            from . import context as _ctx
-            self._workspace_res = _resource.ResourceManager.get().request(
-                _ctx.cpu(0), _resource.ResourceRequest(
-                    _resource.ResourceRequest.kTempSpace))
-        return self._workspace_res
+        # close() releases the temp-space slot for good; only an explicit
+        # reset() re-acquires it.  Lazily re-acquiring here would silently
+        # resurrect a half-closed iterator (dead pool, no producer) the
+        # first time anything touched the workspace.
+        ws = self._workspace_res
+        if ws is None:
+            raise MXNetError(
+                "ImageRecordIter: used after close(); call reset() to "
+                "restart the iterator")
+        return ws
 
     def _read_raw(self):
         """Sequential record read (reader stage of the pipeline)."""
@@ -654,6 +692,8 @@ class ImageRecordIter(DataIter):
         n_img = self.batch_size * h * w * c
         ws = self._workspace.get_space(
             (2 * n_img + self.batch_size,), np.float32)
+        if _telemetry.enabled:
+            _IO_WS.labels(iter="ImageRecordIter").set(ws.nbytes)
         data = ws[:n_img].reshape((self.batch_size, h, w, c))
         chw = ws[n_img:2 * n_img].reshape((self.batch_size, c, h, w))
         label = ws[2 * n_img:]
@@ -705,13 +745,22 @@ class ImageRecordIter(DataIter):
     def __next__(self):
         if self._done:
             raise StopIteration
-        batch = self._queue.get()
+        tel = _telemetry.enabled
+        if tel:
+            t0 = time.perf_counter()
+            batch = self._queue.get()
+            _IO_WAIT.labels(iter="ImageRecordIter").observe(
+                time.perf_counter() - t0)
+        else:
+            batch = self._queue.get()
         if batch is None:
             self._done = True
             raise StopIteration
         if isinstance(batch, Exception):
             self._done = True
             raise batch
+        if tel:
+            _IO_BATCHES.labels(iter="ImageRecordIter").inc()
         return batch
 
     next = __next__

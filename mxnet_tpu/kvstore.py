@@ -30,8 +30,28 @@ from .base import MXNetError, get_env
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create"]
+
+_KV_PUSH = _telemetry.counter(
+    "kvstore_push_total", "KVStore push operations (one per key)",
+    ("type",))
+_KV_PULL = _telemetry.counter(
+    "kvstore_pull_total", "KVStore pull operations (one per key)",
+    ("type",))
+_KV_PUSH_LAT = _telemetry.histogram(
+    "kvstore_push_latency_seconds", "Wall time of one push() call",
+    ("type",))
+_KV_PULL_LAT = _telemetry.histogram(
+    "kvstore_pull_latency_seconds", "Wall time of one pull() call",
+    ("type",))
+_KV_BYTES_TX = _telemetry.counter(
+    "kvstore_bytes_sent_total",
+    "Tensor payload bytes sent to the parameter server", ("key",))
+_KV_BYTES_RX = _telemetry.counter(
+    "kvstore_bytes_received_total",
+    "Tensor payload bytes received from the parameter server", ("key",))
 
 
 def _key(k):
@@ -58,6 +78,8 @@ class KVStore:
             self._store[k] = v0.copy()
 
     def push(self, key, value, priority=0):
+        tel = _telemetry.enabled
+        t0 = _time.perf_counter() if tel else 0.0
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
@@ -76,8 +98,14 @@ class KVStore:
                     from .ndarray.sparse import cast_storage
                     agg = cast_storage(agg, dst.stype)
                 agg.copyto(dst)
+        if tel:
+            _KV_PUSH.labels(type=self.kind).inc(len(keys))
+            _KV_PUSH_LAT.labels(type=self.kind).observe(
+                _time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        tel = _telemetry.enabled
+        t0 = _time.perf_counter() if tel else 0.0
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
@@ -86,6 +114,10 @@ class KVStore:
             olist = o if isinstance(o, (list, tuple)) else [o]
             for dst in olist:
                 src.copyto(dst)
+        if tel:
+            _KV_PULL.labels(type=self.kind).inc(len(keys))
+            _KV_PULL_LAT.labels(type=self.kind).observe(
+                _time.perf_counter() - t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore_local.h:109-247);
@@ -258,6 +290,8 @@ class DistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray
+        tel = _telemetry.enabled
+        t0 = _time.perf_counter() if tel else 0.0
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
@@ -283,6 +317,10 @@ class DistKVStore(KVStore):
                 # default updater is ASSIGN (reference kvstore docs): the
                 # aggregate replaces the stored value
                 agg.copyto(self._store[k])
+        if tel:
+            _KV_PUSH.labels(type=self.kind).inc(len(keys))
+            _KV_PUSH_LAT.labels(type=self.kind).observe(
+                _time.perf_counter() - t0)
 
     def barrier(self):
         self._pg.barrier()
@@ -356,6 +394,8 @@ class DistAsyncKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray
+        tel = _telemetry.enabled
+        t0 = _time.perf_counter() if tel else 0.0
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             agg = _local_sum(v)
@@ -368,9 +408,11 @@ class DistAsyncKVStore(KVStore):
                         "row_sparse push (key %r)" % k)
                 # only touched rows cross the wire (reference
                 # kvstore_dist.h:228-291 row-sparse push)
-                self._rpc("push_rsp", k,
-                          agg.indices.asnumpy().astype("int64"),
-                          agg.data.asnumpy())
+                ids = agg.indices.asnumpy().astype("int64")
+                rows = agg.data.asnumpy()
+                if tel:
+                    _KV_BYTES_TX.labels(key=k).inc(ids.nbytes + rows.nbytes)
+                self._rpc("push_rsp", k, ids, rows)
                 continue
             if self._compression:
                 # quantize with error feedback, then the PACKED 2-bit
@@ -378,19 +420,37 @@ class DistAsyncKVStore(KVStore):
                 # bytes (reference kvstore_dist.h:336-359, N13)
                 q = self._compression.compress(k, agg._data)
                 words = self._compression.pack(np.asarray(q))
+                if tel:
+                    _KV_BYTES_TX.labels(key=k).inc(words.nbytes)
                 self._rpc("push_2bit", k, words,
                           self._compression.threshold)
                 continue
-            self._rpc("push", k, agg.asnumpy())
+            arr = agg.asnumpy()
+            if tel:
+                _KV_BYTES_TX.labels(key=k).inc(arr.nbytes)
+            self._rpc("push", k, arr)
+        if tel:
+            _KV_PUSH.labels(type=self.kind).inc(len(keys))
+            _KV_PUSH_LAT.labels(type=self.kind).observe(
+                _time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        tel = _telemetry.enabled
+        t0 = _time.perf_counter() if tel else 0.0
         keys, outs = self._normalize(key, out)
         for k, dst in zip(keys, outs):
             arr = self._rpc("pull", k)
+            if tel:
+                _KV_BYTES_RX.labels(key=k).inc(
+                    getattr(arr, "nbytes", 0))
             dsts = dst if isinstance(dst, (list, tuple)) else [dst]
             for d in dsts:
                 from .ndarray.ndarray import array as _array
                 _array(arr, ctx=d.context, dtype=d.dtype).copyto(d)
+        if tel:
+            _KV_PULL.labels(type=self.kind).inc(len(keys))
+            _KV_PULL_LAT.labels(type=self.kind).observe(
+                _time.perf_counter() - t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Fetch only the requested rows from the server (reference
@@ -404,6 +464,9 @@ class DistAsyncKVStore(KVStore):
             for dst, rid in zip(olist, rlist):
                 ids = np.unique(rid.asnumpy().astype("int64"))
                 rows = self._rpc("pull_rows", k, ids)
+                if _telemetry.enabled:
+                    _KV_BYTES_RX.labels(key=k).inc(
+                        getattr(rows, "nbytes", 0))
                 if isinstance(dst, RowSparseNDArray):
                     row_sparse_array(
                         (rows, ids),

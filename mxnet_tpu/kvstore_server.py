@@ -42,14 +42,29 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from .base import MXNetError
+from . import telemetry as _telemetry
 
 __all__ = ["KVStoreServer", "run_server", "ps_address",
            "send_msg", "recv_msg"]
+
+# Frame errors count unconditionally (cold path — a malformed frame is
+# exactly the event an operator wants visible even before opting into
+# hot-path telemetry); request counters/latency are `enabled`-gated.
+_FRAME_ERRORS = _telemetry.counter(
+    "kvstore_frame_errors_total",
+    "Malformed KVStore wire frames rejected by recv_msg")
+_SRV_REQS = _telemetry.counter(
+    "kvstore_server_requests_total",
+    "Requests handled by the parameter server", ("cmd",))
+_SRV_LAT = _telemetry.histogram(
+    "kvstore_server_request_latency_seconds",
+    "Parameter-server request handling latency", ("cmd",))
 
 
 def ps_address():
@@ -123,6 +138,13 @@ def send_msg(sock: socket.socket, obj: Any):
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
+def _frame_error(why):
+    """Reject a malformed frame loudly: slicing past the payload end would
+    silently truncate ``__bytes__`` blobs (and desync every frame after)."""
+    _FRAME_ERRORS.inc()
+    raise MXNetError("kvstore wire: %s" % why)
+
+
 def recv_msg(sock: socket.socket):
     header = _recv_exact(sock, 8)
     if header is None:
@@ -131,17 +153,31 @@ def recv_msg(sock: socket.socket):
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
+    if len(payload) < 4:
+        _frame_error("frame shorter than its header-length field")
     (hlen,) = struct.unpack_from("<I", payload, 0)
+    if 4 + hlen + 4 > len(payload):
+        _frame_error("header length %d overruns %d-byte frame"
+                     % (hlen, len(payload)))
     hdr = json.loads(payload[4:4 + hlen].decode())
     off = 4 + hlen
     (nblobs,) = struct.unpack_from("<I", payload, off)
     off += 4
     blobs = []
     for _ in range(nblobs):
+        if off + 8 > len(payload):
+            _frame_error("blob length field overruns %d-byte frame"
+                         % len(payload))
         (blen,) = struct.unpack_from("<Q", payload, off)
         off += 8
+        if off + blen > len(payload):
+            _frame_error("blob of %d bytes overruns %d-byte frame"
+                         % (blen, len(payload)))
         blobs.append(payload[off:off + blen])
         off += blen
+    if off != len(payload):
+        _frame_error("%d trailing bytes after last blob"
+                     % (len(payload) - off))
     return _decode(hdr, blobs)
 
 
@@ -199,7 +235,15 @@ class KVStoreServer:
                         return
                     if msg is None:
                         return
-                    reply = outer._dispatch(msg)
+                    if _telemetry.enabled:
+                        t0 = time.perf_counter()
+                        reply = outer._dispatch(msg)
+                        cmd = str(msg[0])
+                        _SRV_REQS.labels(cmd=cmd).inc()
+                        _SRV_LAT.labels(cmd=cmd).observe(
+                            time.perf_counter() - t0)
+                    else:
+                        reply = outer._dispatch(msg)
                     send_msg(self.request, reply)
                     if msg[0] == "stop":
                         return

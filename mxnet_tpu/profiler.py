@@ -21,9 +21,17 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
+from . import telemetry as _telemetry
+
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+# user-defined profiler counters mirrored into the telemetry registry so a
+# /metrics scrape sees the same values a Chrome trace would
+_PROF_GAUGE = _telemetry.gauge(
+    "profiler_counter", "Latest value of each profiler.Counter",
+    ("domain", "counter"))
 
 _lock = threading.Lock()
 _config = {
@@ -65,20 +73,28 @@ def record_span(name: str, begin_us: float, end_us: float,
 
 
 class span:
-    """Context manager used by the dispatch layer around each op."""
+    """Context manager used by the dispatch layer around each op.
 
-    __slots__ = ("name", "cat", "begin")
+    ``histogram`` (a telemetry Histogram or bound child) receives the same
+    wall-clock measurement in seconds when telemetry is enabled, so one
+    timing path feeds both the Chrome trace and the metrics registry."""
 
-    def __init__(self, name, category="operator"):
+    __slots__ = ("name", "cat", "begin", "hist")
+
+    def __init__(self, name, category="operator", histogram=None):
         self.name = name
         self.cat = category
+        self.hist = histogram
 
     def __enter__(self):
         self.begin = _now_us()
         return self
 
     def __exit__(self, *exc):
-        record_span(self.name, self.begin, _now_us(), self.cat)
+        end = _now_us()
+        record_span(self.name, self.begin, end, self.cat)
+        if self.hist is not None and _telemetry.enabled:
+            self.hist.observe((end - self.begin) * 1e-6)
         return False
 
 
@@ -226,6 +242,9 @@ class Counter:
 
     def set_value(self, value):
         self._value = value
+        if _telemetry.enabled:
+            _PROF_GAUGE.labels(domain=self.domain.name,
+                               counter=self.name).set(value)
         if is_running():
             with _lock:
                 _events.append({"name": "%s::%s" % (self.domain.name,

@@ -1,0 +1,155 @@
+"""Exporters: Prometheus text exposition, JSON snapshot, /metrics HTTP.
+
+The text format follows the Prometheus text-exposition rules
+(``# HELP`` / ``# TYPE`` headers, escaped label values, ``_bucket``/
+``_sum``/``_count`` series for histograms) so a stock Prometheus scrape of
+the optional ``http.server`` endpoint works unmodified.  The JSON snapshot
+carries the same data as one nested dict for programmatic consumers
+(tests, dashboards, the bench harness).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from .registry import Counter, Gauge, Histogram, MetricRegistry
+
+__all__ = ["prometheus_text", "snapshot", "snapshot_json",
+           "start_http_server", "stop_http_server"]
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+
+
+def _fmt_labels(names, values, extra: str = "") -> str:
+    parts = ['%s="%s"' % (n, _escape_label(v))
+             for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(registry: MetricRegistry) -> str:
+    """The whole registry in Prometheus text-exposition format."""
+    lines = []
+    for fam in registry.collect():
+        lines.append("# HELP %s %s" % (fam.name, _escape_help(fam.help)))
+        lines.append("# TYPE %s %s" % (fam.name, fam.kind))
+        for labelvalues, data in fam.samples():
+            if isinstance(fam, Histogram):
+                for bound, cum in data["buckets"].items():
+                    lines.append("%s_bucket%s %d" % (
+                        fam.name,
+                        _fmt_labels(fam.labelnames, labelvalues,
+                                    'le="%s"' % bound),
+                        cum))
+                lbl = _fmt_labels(fam.labelnames, labelvalues)
+                lines.append("%s_sum%s %s"
+                             % (fam.name, lbl, _fmt_value(data["sum"])))
+                lines.append("%s_count%s %d"
+                             % (fam.name, lbl, data["count"]))
+            else:
+                lines.append("%s%s %s" % (
+                    fam.name, _fmt_labels(fam.labelnames, labelvalues),
+                    _fmt_value(data)))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot(registry: MetricRegistry) -> Dict[str, dict]:
+    """JSON-able snapshot: name -> {type, help, samples:[{labels, ...}]}.
+
+    Counter/gauge samples carry ``value``; histogram samples carry
+    ``buckets`` (cumulative, keyed by upper bound), ``sum`` and ``count``.
+    """
+    out: Dict[str, dict] = {}
+    for fam in registry.collect():
+        samples = []
+        for labelvalues, data in fam.samples():
+            entry = {"labels": dict(zip(fam.labelnames, labelvalues))}
+            if isinstance(fam, Histogram):
+                entry.update(data)
+            else:
+                entry["value"] = data
+            samples.append(entry)
+        out[fam.name] = {"type": fam.kind, "help": fam.help,
+                         "samples": samples}
+    return out
+
+
+def snapshot_json(registry: MetricRegistry, **json_kwargs) -> str:
+    return json.dumps(snapshot(registry), **json_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# optional stdlib HTTP endpoint (gated by MXNET_TELEMETRY_PORT)
+# ---------------------------------------------------------------------------
+_server = None
+_server_thread = None
+_server_lock = threading.Lock()
+
+
+def start_http_server(port: int, registry: MetricRegistry,
+                      host: str = "127.0.0.1"):
+    """Serve ``/metrics`` (text exposition) and ``/metrics.json`` on a
+    daemon thread.  Binds loopback by default — the wire is unauthenticated,
+    so exposing it wider is an explicit operator choice
+    (``MXNET_TELEMETRY_HOST``).  Returns the bound port."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            path = self.path.split("?", 1)[0]
+            if path in ("/", "/metrics"):
+                body = prometheus_text(registry).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = snapshot_json(registry).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # keep scrapes out of stderr
+            pass
+
+    global _server, _server_thread
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        srv = http.server.ThreadingHTTPServer((host, int(port)), Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever,
+                             name="mxtpu-telemetry-http", daemon=True)
+        t.start()
+        _server, _server_thread = srv, t
+        return srv.server_address[1]
+
+
+def stop_http_server():
+    global _server, _server_thread
+    with _server_lock:
+        if _server is None:
+            return
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _server_thread = None

@@ -1,0 +1,321 @@
+"""Metric instruments and the process-wide registry.
+
+Reference analog: the engine-side aggregate statistics of
+``src/profiler/aggregate_stats.cc`` (per-op tables the reference keeps
+always-on once profiling starts), redesigned in the Prometheus mold: a
+process-wide registry of named ``Counter``/``Gauge``/``Histogram``
+instruments with label support, scraped by the exporters in
+:mod:`mxnet_tpu.telemetry.export`.
+
+Threading model: one lock per metric family guards its child table AND
+every child's value — increments arrive concurrently from the
+ThreadedEngine worker pool, KVStore server handler threads, and data
+pipeline producers.  Bound children (``metric.labels(...)``) are cached so
+hot paths pay one dict lookup + one locked add per event.
+
+The registry is always live: creating and incrementing instruments does
+not depend on the global ``telemetry.enabled`` flag.  That flag only gates
+the *built-in* instrumentation sites in engine/kvstore/io/executor, so the
+default-off fast path stays a single attribute check.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "log_buckets", "DEFAULT_TIME_BUCKETS"]
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def log_buckets(lo: float = 1e-6, hi: float = 10.0,
+                per_decade: int = 3) -> Tuple[float, ...]:
+    """Fixed log-scale bucket bounds from ``lo`` to at least ``hi``
+    (``per_decade`` bounds per power of ten).  The implicit +Inf bucket is
+    appended by the Histogram itself."""
+    if lo <= 0 or hi <= lo or per_decade < 1:
+        raise MXNetError("log_buckets: need 0 < lo < hi, per_decade >= 1")
+    out: List[float] = []
+    step = 10.0 ** (1.0 / per_decade)
+    v = lo
+    while v < hi * (1 + 1e-9):
+        out.append(float("%.6g" % v))  # stable, readable bound labels
+        v *= step
+    return tuple(out)
+
+
+# 1us .. ~21s in half-decade steps: wide enough for dispatch latencies and
+# whole-epoch waits without per-instrument tuning.
+DEFAULT_TIME_BUCKETS = log_buckets(1e-6, 20.0, per_decade=2)
+
+
+class _Child:
+    """One labelled time series of a metric family."""
+
+    __slots__ = ("_family", "_labelvalues")
+
+    def __init__(self, family: "_MetricFamily", labelvalues: Tuple[str, ...]):
+        self._family = family
+        self._labelvalues = labelvalues
+
+
+class _CounterChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, family, labelvalues):
+        super().__init__(family, labelvalues)
+        self._value = 0.0
+
+    def _zero(self):
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise MXNetError("counter %r cannot decrease"
+                             % self._family.name)
+        with self._family._lock:
+            self._value += amount
+
+    def get(self) -> float:
+        with self._family._lock:
+            return self._value
+
+
+class _GaugeChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, family, labelvalues):
+        super().__init__(family, labelvalues)
+        self._value = 0.0
+
+    def _zero(self):
+        self._value = 0.0
+
+    def set(self, value: float):
+        with self._family._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        with self._family._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        with self._family._lock:
+            self._value -= amount
+
+    def get(self) -> float:
+        with self._family._lock:
+            return self._value
+
+
+class _HistogramChild(_Child):
+    __slots__ = ("_counts", "_sum", "_count")
+
+    def __init__(self, family, labelvalues):
+        super().__init__(family, labelvalues)
+        # one slot per finite bound + the +Inf overflow slot
+        self._counts = [0] * (len(family.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def _zero(self):
+        self._counts = [0] * len(self._counts)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float):
+        value = float(value)
+        if math.isnan(value):
+            return  # a NaN sample would poison sum forever
+        idx = bisect.bisect_left(self._family.buckets, value)
+        with self._family._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def get(self) -> Dict[str, object]:
+        """Snapshot: cumulative bucket counts keyed by upper bound."""
+        with self._family._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._count
+        cum, out = 0, {}
+        for bound, c in zip(self._family.buckets, counts):
+            cum += c
+            out["%g" % bound] = cum
+        out["+Inf"] = cum + counts[-1]
+        return {"buckets": out, "sum": s, "count": n}
+
+
+class _MetricFamily:
+    """Common machinery: name/help/label validation + the child table."""
+
+    kind = "untyped"
+    _child_cls = _Child
+
+    def __init__(self, name: str, help: str = "",  # noqa: A002
+                 labelnames: Sequence[str] = ()):
+        if not _METRIC_NAME_RE.match(name):
+            raise MXNetError("invalid metric name %r" % name)
+        for ln in labelnames:
+            if not _LABEL_NAME_RE.match(ln) or ln.startswith("__"):
+                raise MXNetError("invalid label name %r on metric %r"
+                                 % (ln, name))
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+
+    def labels(self, **labelkv) -> _Child:
+        """The child bound to these label values (created on first use)."""
+        if set(labelkv) != set(self.labelnames):
+            raise MXNetError(
+                "metric %r takes labels %r, got %r"
+                % (self.name, list(self.labelnames), sorted(labelkv)))
+        key = tuple(str(labelkv[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child_cls(self, key)
+                self._children[key] = child
+            return child
+
+    def _default_child(self) -> _Child:
+        """The no-label child (only valid when labelnames is empty)."""
+        if self.labelnames:
+            raise MXNetError(
+                "metric %r has labels %r; bind them with .labels()"
+                % (self.name, list(self.labelnames)))
+        return self.labels()
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            children = list(self._children.items())
+        return [(lv, child.get()) for lv, child in sorted(children)]
+
+    def clear(self):
+        """Zero every child's samples IN PLACE: bound children cached at
+        call sites (module-level bindings in engine.py etc.) must stay
+        valid across a registry reset."""
+        with self._lock:
+            for child in self._children.values():
+                child._zero()
+
+
+class Counter(_MetricFamily):
+    """Monotonically increasing count (Prometheus counter)."""
+
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def inc(self, amount: float = 1.0):
+        self._default_child().inc(amount)
+
+    def get(self) -> float:
+        return self._default_child().get()
+
+
+class Gauge(_MetricFamily):
+    """A value that can go up and down (queue depth, busy workers)."""
+
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, value: float):
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0):
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._default_child().dec(amount)
+
+    def get(self) -> float:
+        return self._default_child().get()
+
+
+class Histogram(_MetricFamily):
+    """Distribution over fixed log-scale buckets (latencies, sizes)."""
+
+    kind = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name, help="", labelnames=(),  # noqa: A002
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help, labelnames)
+        bounds = tuple(float(b) for b in
+                       (DEFAULT_TIME_BUCKETS if buckets is None else buckets))
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise MXNetError(
+                "histogram %r: bucket bounds must be sorted and unique"
+                % name)
+        self.buckets = bounds
+
+    def observe(self, value: float):
+        self._default_child().observe(value)
+
+    def get(self) -> Dict[str, object]:
+        return self._default_child().get()
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricRegistry:
+    """Thread-safe name -> metric family table with get-or-create
+    semantics (modules and tests referring to the same name share one
+    instrument, like the reference's per-name aggregate rows)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _MetricFamily] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames,  # noqa: A002
+                       **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise MXNetError(
+                        "metric %r already registered as %s, not %s"
+                        % (name, m.kind, cls.kind))
+                if tuple(labelnames) != m.labelnames:
+                    raise MXNetError(
+                        "metric %r already registered with labels %r"
+                        % (name, list(m.labelnames)))
+                return m
+            m = cls(name, help, labelnames, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:  # noqa: A002
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:  # noqa: A002
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),  # noqa: A002
+                  buckets=None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_MetricFamily]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def collect(self) -> List[_MetricFamily]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def reset(self):
+        """Drop every recorded sample but keep the registered families
+        (instrument objects cached at module scope stay valid)."""
+        for m in self.collect():
+            m.clear()

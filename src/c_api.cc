@@ -736,6 +736,8 @@ MXNET_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
   sc.names.clear(); sc.types.clear();
   sc.name_ptrs.clear(); sc.type_ptrs.clear(); sc.desc_ptrs.clear();
   const char *doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  if (doc == nullptr) PyErr_Clear();  // tolerate a missing doc, but don't
+                                      // leave its exception pending
   sc.doc.assign(doc ? doc : "");
   PyObject *tensor_args = PyTuple_GetItem(r, 1);
   PyObject *pnames = PyTuple_GetItem(r, 2);
@@ -743,12 +745,18 @@ MXNET_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
   PyObject *preq = PyTuple_GetItem(r, 4);
   long variadic = PyLong_AsLong(PyTuple_GetItem(r, 5));
   for (Py_ssize_t i = 0; i < PyList_Size(tensor_args); ++i) {
-    sc.names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(tensor_args, i)));
+    const char *an = PyUnicode_AsUTF8(PyList_GetItem(tensor_args, i));
+    if (an == nullptr) { Py_DECREF(r); SetPyError("op_info"); return -1; }
+    sc.names.emplace_back(an);
     sc.types.emplace_back("NDArray-or-Symbol");
   }
   for (Py_ssize_t i = 0; i < PyList_Size(pnames); ++i) {
-    sc.names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(pnames, i)));
-    std::string t = PyUnicode_AsUTF8(PyList_GetItem(ptypes, i));
+    const char *pn = PyUnicode_AsUTF8(PyList_GetItem(pnames, i));
+    if (pn == nullptr) { Py_DECREF(r); SetPyError("op_info"); return -1; }
+    sc.names.emplace_back(pn);
+    const char *pt = PyUnicode_AsUTF8(PyList_GetItem(ptypes, i));
+    if (pt == nullptr) { Py_DECREF(r); SetPyError("op_info"); return -1; }
+    std::string t = pt;
     t += PyLong_AsLong(PyList_GetItem(preq, i)) ? ", required"
                                                 : ", optional";
     sc.types.emplace_back(t);
