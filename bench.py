@@ -28,6 +28,13 @@ fetched, so nothing serialized the step chain):
     number is reported with ``window_suspect``;
   - an achieved-TFLOPS / MFU line makes impossible results self-evident;
     >1.2x chip peak exits nonzero instead of reporting.
+
+Conv-formulation A/B runs: the Convolution dispatch honors the four env
+flags tabulated in docs/perf_analysis.md round 6 (MXNET_TPU_PALLAS_CONV
+etc.); they are part of the op's jit-cache key, so an A/B is just two
+bench invocations with the flag flipped — same process or not.  Probe
+the kernels standalone first with tools/probe_pallas_conv.py (JSON
+TFLOPS per shape).
 """
 import json
 import os

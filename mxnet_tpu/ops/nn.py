@@ -18,6 +18,15 @@ import numpy as np
 
 from .registry import register, param
 from ..base import MXNetError
+from .. import telemetry as _telemetry
+
+# Trace-time dispatch mix of the Convolution formulations (one inc per
+# compiled specialization, not per step — executables are cached).  Lets
+# /metrics answer "which conv path did this process actually take".
+_CONV_DISPATCH = _telemetry.counter(
+    "conv_dispatch_total",
+    "Convolution dispatch decisions by formulation path (trace-time)",
+    ("path",))
 
 
 def _spatial_dims(kernel):
@@ -145,10 +154,10 @@ def _shifted_gemm_eligible(attrs, data, nd):
     training graph the same formulation collapses to 125 img/s (~18x
     slower than lax.conv) — the chain win does not survive whole-graph
     scheduling (docs/perf_analysis.md round-4 probe).  Kept behind
-    MXNET_TPU_CONV_SHIFTED_GEMM=1 as a probing tool.  NOTE: the flag is
-    read at TRACE time and compiled executables are cached per (op,
-    attrs) — after toggling it, clear ``OPS['Convolution']._jit_cache``
-    (a fresh process is the clean way to probe)."""
+    MXNET_TPU_CONV_SHIFTED_GEMM=1 as a probing tool.  The flag is read
+    at TRACE time and is part of Convolution's jit-cache key
+    (``env_keys`` in ops/registry.py), so toggling it takes effect on
+    the next call — no cache clearing or process restart needed."""
     import os
     if os.environ.get("MXNET_TPU_CONV_SHIFTED_GEMM", "0") != "1":
         return False
@@ -182,10 +191,9 @@ def _gemm_wgrad_eligible(attrs, data, nd):
     Forward and dgrad stay on lax.conv; only the VJP's dw changes.
     E2e-measured OFF-worthy (2,445 vs 2,497 img/s — see
     docs/perf_analysis.md round 5); enable with MXNET_TPU_GEMM_WGRAD=1.
-    NOTE: like MXNET_TPU_CONV_SHIFTED_GEMM, the flag is read at TRACE
-    time and executables are cached per (op, attrs) — after toggling,
-    clear ``OPS['Convolution']._jit_cache`` (a fresh process is the
-    clean way to probe)."""
+    Like MXNET_TPU_CONV_SHIFTED_GEMM, the flag is read at TRACE time and
+    is part of Convolution's jit-cache key (``env_keys`` in
+    ops/registry.py), so toggling it takes effect on the next call."""
     import os
     if os.environ.get("MXNET_TPU_GEMM_WGRAD", "0") != "1":
         return False
@@ -228,8 +236,42 @@ def _c3g_bwd(res, g):
 _conv3x3_same_gemm_wgrad.defvjp(_c3g_fwd, _c3g_bwd)
 
 
+def _pallas_conv_mode(attrs, data, nd):
+    """Return "s1" / "s2" when the Pallas implicit-GEMM kernels
+    (ops/pallas_conv.py) cover this conv, else None.
+
+    "s1" = the `_is_3x3_same_unit` class with full lane tiles and a
+    VMEM-feasible plan; "s2" = 3x3 / stride-2 / pad-1, run through the
+    exact space-to-depth rewrite.  Gated by MXNET_TPU_PALLAS_CONV
+    (default OFF — every prior hand-conv formulation won its isolated
+    chain and lost e2e; see docs/perf_analysis.md round 6).  The flag is
+    part of Convolution's jit-cache key, so toggling takes effect on the
+    next call."""
+    import os
+    if os.environ.get("MXNET_TPU_PALLAS_CONV", "0") != "1":
+        return None
+    if nd != 2 or data.ndim != 4 or attrs["num_group"] != 1:
+        return None
+    from . import pallas_conv
+    N, C, H, W = data.shape
+    O = attrs["num_filter"]
+    if _is_3x3_same_unit(attrs, data, nd):
+        if pallas_conv.conv3x3_same_available(N, H, W, C, O, data.dtype):
+            return "s1"
+        return None
+    if (tuple(attrs["kernel"]) == (3, 3)
+            and tuple(attrs["stride"] or (1, 1)) == (2, 2)
+            and tuple(attrs["dilate"] or (1, 1)) == (1, 1)
+            and tuple(attrs["pad"] or (0, 0)) == (1, 1)
+            and pallas_conv.conv3x3_s2_available(N, H, W, C, O, data.dtype)):
+        return "s2"
+    return None
+
+
 @register("Convolution", nin=-1, aliases=("convolution", "Convolution_v1"),
-          params=dict(_CONV_PARAMS))
+          params=dict(_CONV_PARAMS),
+          env_keys=("MXNET_TPU_PALLAS_CONV", "MXNET_TPU_CONV_SHIFTED_GEMM",
+                    "MXNET_TPU_GEMM_WGRAD", "MXNET_TPU_STEM_S2D"))
 def _convolution(attrs, data, weight, *maybe_bias):
     """N-D convolution on the MXU (ref: src/operator/nn/convolution.cc)."""
     k = attrs["kernel"]
@@ -237,13 +279,26 @@ def _convolution(attrs, data, weight, *maybe_bias):
     stride = attrs["stride"] or (1,) * nd
     dilate = attrs["dilate"] or (1,) * nd
     pad = attrs["pad"] or (0,) * nd
+    pallas_mode = _pallas_conv_mode(attrs, data, nd)
     if _stem_s2d_eligible(attrs, data, nd):
+        path = "s2d_stem"
         out = _stem_s2d_conv(attrs, data, weight)
+    elif pallas_mode is not None:
+        from . import pallas_conv
+        if pallas_mode == "s1":
+            path = "pallas"
+            out = pallas_conv.conv3x3_same(data, weight)
+        else:
+            path = "pallas_s2"
+            out = pallas_conv.conv3x3_s2(data, weight)
     elif _shifted_gemm_eligible(attrs, data, nd):
+        path = "shifted_gemm"
         out = _shifted_gemm_conv(data, weight)
     elif _gemm_wgrad_eligible(attrs, data, nd):
+        path = "gemm_wgrad"
         out = _conv3x3_same_gemm_wgrad(data, weight)
     else:
+        path = "lax"
         out = jax.lax.conv_general_dilated(
             data, weight,
             window_strides=stride,
@@ -251,6 +306,8 @@ def _convolution(attrs, data, weight, *maybe_bias):
             rhs_dilation=dilate,
             dimension_numbers=_conv_dnums(nd),
             feature_group_count=attrs["num_group"])
+    if _telemetry.enabled:
+        _CONV_DISPATCH.labels(path=path).inc()
     # NOTE: no preferred_element_type here — the MXU accumulates bf16 convs
     # in f32 natively, and an explicit f32 preference breaks the conv
     # transpose rule (mixed-dtype cotangents) under jax.vjp

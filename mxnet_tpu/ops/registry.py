@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ast
 import functools
+import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -103,6 +104,7 @@ class Operator:
                  arg_names: Optional[Sequence[str]] = None,
                  aliases: Sequence[str] = (),
                  mutate_inputs: Sequence[int] = (),
+                 env_keys: Sequence[str] = (),
                  doc: str = ""):
         self.name = name
         self.fn = fn
@@ -130,8 +132,13 @@ class Operator:
         self.arg_names = list(arg_names) if arg_names else None
         self.aliases = tuple(aliases)
         self.mutate_inputs = tuple(mutate_inputs)  # e.g. optimizer update ops
+        # env vars the op's fn reads at TRACE time (formulation flags like
+        # MXNET_TPU_PALLAS_CONV).  Their current values join the jit-cache
+        # key, so toggling a flag mid-process can never serve a stale
+        # executable compiled under the old value.
+        self.env_keys = tuple(env_keys)
         self.doc = doc
-        self._jit_cache: Dict[AttrDict, Callable] = {}
+        self._jit_cache: Dict[Any, Callable] = {}
 
     # ---- attrs ----------------------------------------------------------
     def parse_attrs(self, kwargs: Dict[str, Any]) -> AttrDict:
@@ -167,12 +174,19 @@ class Operator:
 
     # ---- execution ------------------------------------------------------
     def compiled(self, attrs: AttrDict) -> Callable:
-        """jit-compiled entry for these attrs (shape-specialized by XLA)."""
-        c = self._jit_cache.get(attrs)
+        """jit-compiled entry for these attrs (shape-specialized by XLA).
+
+        Cache key is ``attrs`` alone, or ``(attrs, env-values)`` when the
+        op declares ``env_keys`` — trace-time formulation flags then take
+        effect immediately instead of being baked into a stale executable.
+        """
+        key = attrs if not self.env_keys else (
+            attrs, tuple(os.environ.get(k) for k in self.env_keys))
+        c = self._jit_cache.get(key)
         if c is None:
             fn = self.fn
             c = jax.jit(lambda *arrays: fn(attrs, *arrays))
-            self._jit_cache[attrs] = c
+            self._jit_cache[key] = c
         return c
 
     def __call__(self, attrs: AttrDict, *arrays):
@@ -189,7 +203,8 @@ class Operator:
 
 def register(name: str, *, params=None, nin=None, nout=1, needs_rng=False,
              train_aware=False, aux_writeback=None, visible=None,
-             arg_names=None, aliases=(), mutate_inputs=(), doc=""):
+             arg_names=None, aliases=(), mutate_inputs=(), env_keys=(),
+             doc=""):
     """Decorator: register a pure JAX function as an operator."""
 
     def deco(fn):
@@ -197,6 +212,7 @@ def register(name: str, *, params=None, nin=None, nout=1, needs_rng=False,
                       needs_rng=needs_rng, train_aware=train_aware,
                       aux_writeback=aux_writeback, arg_names=arg_names,
                       aliases=aliases, mutate_inputs=mutate_inputs,
+                      env_keys=env_keys,
                       doc=doc or (fn.__doc__ or ""))
         op.visible = visible
         OPS[name] = op

@@ -25,6 +25,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`; register the marker so the probe
+    # smoke tests don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: long-running (excluded from tier-1 via -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Analog of the reference @with_seed() fixture (tests/python/unittest/

@@ -1,0 +1,234 @@
+"""Pallas implicit-GEMM conv kernels vs lax.conv_general_dilated.
+
+Interpret mode on CPU (same jaxpr the TPU compiles) — the pattern
+test_pallas_attention.py established.  Covers forward / dgrad / wgrad
+parity across a shape sweep, the stride-2 space-to-depth path, grid>1
+framing, eligibility boundaries, and the jit-cache env-key regression
+(toggling MXNET_TPU_PALLAS_CONV must re-dispatch without clearing
+``_jit_cache`` or restarting the process).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu  # noqa: F401  (registers ops)
+from mxnet_tpu import telemetry
+from mxnet_tpu.ops import pallas_conv as pc
+from mxnet_tpu.ops.registry import apply_op
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    pc.INTERPRET = True
+    yield
+    pc.INTERPRET = False
+
+
+def _ref_s1(x, w):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+
+
+def _ref_s2(x, w):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    return jax.lax.conv_general_dilated(
+        x, w, (2, 2), [(1, 1), (1, 1)], dimension_numbers=dn)
+
+
+def _case(N, C, H, W, O, seed=0):
+    r = np.random.default_rng(seed)
+    x = jnp.asarray(r.standard_normal((N, C, H, W)), jnp.float32)
+    w = jnp.asarray(r.standard_normal((O, C, 3, 3)) * 0.1, jnp.float32)
+    return x, w
+
+
+# spatial sweep includes odd dims (frame padding) and multi-image batches
+@pytest.mark.parametrize("N,C,H,W,O", [
+    (2, 8, 6, 6, 16),
+    (4, 16, 5, 7, 8),
+    (1, 8, 8, 8, 8),
+    (3, 8, 7, 9, 8),
+])
+def test_forward_parity(N, C, H, W, O):
+    x, w = _case(N, C, H, W, O)
+    got = pc.conv3x3_same(x, w)
+    ref = _ref_s1(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_grads_parity():
+    x, w = _case(2, 8, 6, 6, 16, seed=3)
+
+    def loss_p(x, w):
+        return jnp.sum(pc.conv3x3_same(x, w) ** 2)
+
+    def loss_r(x, w):
+        return jnp.sum(_ref_s1(x, w) ** 2)
+
+    gp = jax.grad(loss_p, (0, 1))(x, w)
+    gr = jax.grad(loss_r, (0, 1))(x, w)
+    for a, b, nm in zip(gp, gr, ("dx", "dw")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=nm)
+
+
+@pytest.mark.parametrize("N,C,H,W,O", [
+    (2, 8, 8, 8, 16),
+    (1, 4, 6, 10, 8),
+])
+def test_stride2_parity(N, C, H, W, O):
+    x, w = _case(N, C, H, W, O, seed=5)
+    got = pc.conv3x3_s2(x, w)
+    ref = _ref_s2(x, w)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss_p(x, w):
+        return jnp.sum(pc.conv3x3_s2(x, w) ** 2)
+
+    def loss_r(x, w):
+        return jnp.sum(_ref_s2(x, w) ** 2)
+
+    gp = jax.grad(loss_p, (0, 1))(x, w)
+    gr = jax.grad(loss_r, (0, 1))(x, w)
+    for a, b, nm in zip(gp, gr, ("dx", "dw")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=nm)
+
+
+def _nb1_plan(N, H, W, KH, KW, pads):
+    """Force NB=1 (grid = N) to exercise the multi-step unblocked
+    slab offsets — the default planner picks NB=N for tiny shapes."""
+    Hp, WP, Ho, Wo = pc._frame_geometry(H, W, KH, KW, pads)
+    F_in, F_out = Hp * WP, Ho * WP
+    L = pc._align(max(F_in, F_out), 8)
+    TILE = L
+    SLAB = pc._align(TILE + (KH - 1) * WP + (KW - 1), 8)
+    total = pc._align((N - 1) * TILE + SLAB, 8)
+    return pc._Plan(1, N, L, TILE, SLAB, WP, Hp, Ho, Wo, F_in, F_out, total)
+
+
+def test_grid_framing_forward_and_wgrad():
+    """grid > 1: valid outputs must never read across image frames."""
+    N, C, H, W, O = 4, 8, 5, 6, 8
+    pads = ((1, 1), (1, 1))
+    x, w = _case(N, C, H, W, O, seed=7)
+    xh = jnp.transpose(x, (0, 2, 3, 1))
+    taps = w.transpose(2, 3, 1, 0).reshape(9, C, O)
+    plan = _nb1_plan(N, H, W, 3, 3, pads)
+    got = pc._conv_s1(xh, taps, pads, 3, 3, plan=plan)
+    ref = jnp.transpose(_ref_s1(x, w), (0, 2, 3, 1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    g = jnp.asarray(np.random.default_rng(8).standard_normal(ref.shape),
+                    jnp.float32)
+    dw = pc._wgrad_s1(xh, g, pads, 3, 3, plan=plan)
+    dw_ref = jax.grad(
+        lambda w_: jnp.vdot(_ref_s1(x, w_), jnp.transpose(g, (0, 3, 1, 2)))
+    )(w)
+    np.testing.assert_allclose(
+        np.asarray(dw.reshape(3, 3, C, O).transpose(3, 2, 0, 1)),
+        np.asarray(dw_ref), rtol=1e-4, atol=1e-4)
+
+
+def test_eligibility_boundaries(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_PALLAS_CONV", raising=False)
+    # default OFF
+    assert not pc.conv3x3_same_available(8, 14, 14, 256, 256)
+    monkeypatch.setenv("MXNET_TPU_PALLAS_CONV", "1")
+    # INTERPRET lifts the TPU-platform gate (fixture sets it)
+    assert pc.conv3x3_same_available(8, 14, 14, 256, 256)
+    # lane gates: partial channel/filter tiles measured 10 TF (round 3)
+    assert not pc.conv3x3_same_available(8, 56, 56, 64, 64)
+    assert not pc.conv3x3_same_available(8, 14, 14, 256, 192)
+    # no VMEM-feasible plan at stem-scale shapes
+    assert not pc.conv3x3_same_available(8, 112, 112, 1024, 1024)
+    # stride-2: s2d needs even spatial dims and full 4C lanes
+    assert pc.conv3x3_s2_available(8, 14, 14, 128, 256)
+    assert not pc.conv3x3_s2_available(8, 13, 14, 128, 256)
+    assert not pc.conv3x3_s2_available(8, 14, 14, 24, 256)
+    # platform gate holds without interpret mode (CPU backend here)
+    pc.INTERPRET = False
+    assert not pc.conv3x3_same_available(8, 14, 14, 256, 256)
+    pc.INTERPRET = True
+
+
+def _conv_op(x, w, stride):
+    return apply_op("Convolution", x, w, kernel=(3, 3), stride=stride,
+                    pad=(1, 1), num_filter=w.shape[0], no_bias=True)
+
+
+def test_dispatch_and_env_cache_key(monkeypatch):
+    """Toggling MXNET_TPU_PALLAS_CONV re-dispatches on the NEXT call:
+    the env value is part of Convolution's jit-cache key, so the stale
+    pre-toggle executable can never be served (the round-4/5 footgun)."""
+    x, w = _case(1, 128, 4, 4, 128, seed=11)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        monkeypatch.setenv("MXNET_TPU_PALLAS_CONV", "0")
+        ref = _conv_op(x, w, (1, 1))
+        assert telemetry.value("conv_dispatch_total", path="lax") == 1
+        assert telemetry.value("conv_dispatch_total", path="pallas") == 0
+
+        monkeypatch.setenv("MXNET_TPU_PALLAS_CONV", "1")
+        got = _conv_op(x, w, (1, 1))
+        assert telemetry.value("conv_dispatch_total", path="pallas") == 1
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+        # toggling back serves the cached lax executable — no re-trace
+        monkeypatch.setenv("MXNET_TPU_PALLAS_CONV", "0")
+        _conv_op(x, w, (1, 1))
+        assert telemetry.value("conv_dispatch_total", path="lax") == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_dispatch_stride2(monkeypatch):
+    x, w = _case(1, 32, 8, 8, 128, seed=13)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        monkeypatch.setenv("MXNET_TPU_PALLAS_CONV", "1")
+        got = _conv_op(x, w, (2, 2))
+        assert telemetry.value("conv_dispatch_total", path="pallas_s2") == 1
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref_s2(x, w)),
+                                   rtol=1e-4, atol=1e-4)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+@pytest.mark.slow
+def test_probe_smoke():
+    """The probe's --smoke mode (tiny shapes, interpret, CPU) must run
+    and emit valid JSON with per-shape TFLOPS fields."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "probe_pallas_conv.py"),
+         "--smoke"],
+        capture_output=True, text=True, cwd=_REPO, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "pallas_conv_probe"
+    assert out["shapes"]
+    for row in out["shapes"]:
+        assert "shape" in row
+        assert "pallas_fwd_tf" in row or "pallas_fwd_err" in row

@@ -1,259 +1,193 @@
 #!/usr/bin/env python
-"""Probe: where does ResNet-50 conv time actually go on this chip?
+"""Probe: Pallas implicit-GEMM conv (ops/pallas_conv.py) vs lax.conv on
+the ResNet-50 3x3 shapes, chained-block TFLOPS per shape as JSON.
 
-Round-2's docs/perf_analysis.md measured an aggregate 35-45 TF "conv
-ceiling"; this probe decomposes it per conv class (3x3 vs 1x1, per stage)
-and tests Pallas implicit-GEMM replacements where XLA is below roofline.
-Reports both TFLOPS (compute roofline: ~125 TF measured matmul) and
-effective GB/s (bandwidth roofline: ~660 GB/s measured).
+Round 3 prototyped the implicit-GEMM framing in this file and measured
+87-171 TF standalone (vs the 35-45 TF in-graph conv aggregate and
+150-195 TF isolated XLA convs).  Round 6 moved the kernels into
+``mxnet_tpu/ops/pallas_conv.py`` with a full Pallas VJP; this probe now
+drives the LIBRARY kernels — the exact code the ``MXNET_TPU_PALLAS_CONV``
+dispatch runs — so probe numbers and production numbers cannot drift.
 
-Timing: chained applications inside one jit, differential (2N)-(N) to
-cancel the ~100 ms tunnel round trip.
+Protocol (same as tools/probe_wgrad.py): windowed timing with a
+data-feedback chain — each jitted call folds a loss-dependent epsilon
+back into its input so neither XLA nor the runtime can overlap, reorder
+or dead-code the kernels; per-call time is the median of paired
+(2N - N) window differences; DEPTH convs chain inside one executable to
+amortize dispatch.  TFLOPS uses 2 flops/MAC over KH*KW*C contractions
+(the consistent-currency convention bench.py fixed in round 3).
 
-Run on the TPU:  python tools/probe_pallas_conv.py [--quick]
+Run:  python tools/probe_pallas_conv.py            (needs the TPU chip)
+      python tools/probe_pallas_conv.py --smoke    (CPU: tiny shapes in
+          interpret mode, numerics only — the CI guard for this probe)
+
+Output: one JSON object on stdout, {"shapes": [{shape, *_tf | *_err}]}.
 """
+import json
+import statistics
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
 
-REPS = 4
+REPS = 5
+WINDOW = 12
+DEPTH = 4          # convs chained inside one executable
 
-
-def _align(v, m):
-    return (v + m - 1) // m * m
-
-
-# ----------------------------------------------------------------- kernels
-def conv3x3_kernel_factory(TILE, WP):
-    def kern(x_ref, w_ref, o_ref):
-        acc = None
-        for dh in range(3):
-            for dw in range(3):
-                xs = x_ref[pl.ds(dh * WP + dw, TILE), :]
-                p = jax.lax.dot_general(
-                    xs, w_ref[dh * 3 + dw],
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                acc = p if acc is None else acc + p
-        o_ref[:] = acc.astype(o_ref.dtype)
-    return kern
+# ResNet-50/224 3x3 conv shapes, batch 128: (name, N, C, O, HW, stride).
+# stage1 is lane-starved (C=64 < 128 lanes; r3 measured 10 TF) and
+# gated OFF by conv3x3_same_available — probed anyway for the record.
+SHAPES = [
+    ("stage1_56px", 128, 64, 64, 56, 1),
+    ("stage2_28px", 128, 128, 128, 28, 1),
+    ("stage3_14px", 128, 256, 256, 14, 1),
+    ("stage4_7px", 128, 512, 512, 7, 1),
+    ("s2_28to14px", 128, 128, 256, 28, 2),
+]
+SMOKE_SHAPES = [
+    ("smoke_s1", 2, 8, 8, 6, 1),
+    ("smoke_s2", 2, 8, 8, 6, 2),
+]
 
 
-def make_pallas_conv(N, H, W, C, K, TH, interpret=False):
-    """Compact-H framing: out frame (H, W+2) per image; x halo-padded."""
-    WP = W + 2
-    assert H % TH == 0
-    T = H // TH
-    TILE = TH * WP
-    assert TILE % 8 == 0, (TH, WP)
-    SLAB = _align(TILE + 2 * WP + 2, 8)
-    Lx = _align((H + 2) * WP, 8)
-    total_x = _align((N - 1) * Lx + (T - 1) * TILE + SLAB, 8)
-
-    kern = conv3x3_kernel_factory(TILE, WP)
-
-    def conv(x, w):  # x: (N, H, W, C), w: (3, 3, C, K)
-        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-        xf = xp.reshape(N, (H + 2) * WP, C)
-        xf = jnp.pad(xf, ((0, 0), (0, Lx - (H + 2) * WP), (0, 0)))
-        xf = xf.reshape(N * Lx, C)
-        xf = jnp.pad(xf, ((0, total_x - N * Lx), (0, 0)))
-        w9 = w.reshape(9, C, K)
-        out = pl.pallas_call(
-            kern,
-            grid=(N, T),
-            in_specs=[
-                # (n*Lx + t*TILE) written so Mosaic can prove 8-divisibility
-                pl.BlockSpec((pl.Element(SLAB), pl.Element(C)),
-                             lambda n, t: ((n * (Lx // 8) + t * (TILE // 8)) * 8, 0)),
-                pl.BlockSpec((9, C, K), lambda n, t: (0, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((TILE, K), lambda n, t: (n * T + t, 0)),
-            out_shape=jax.ShapeDtypeStruct((N * H * WP, K), x.dtype),
-            interpret=interpret,
-        )(xf, w9)
-        return out.reshape(N, H, WP, K)[:, :, :W, :]
-
-    return conv
+def _win_time(fn, fetch, n):
+    """One window: n async dispatches, one hard D2H fetch."""
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(n):
+        r = fn()
+    fetch(r)
+    return time.perf_counter() - t0
 
 
-def make_pallas_conv_stacked(N, H, W, C, K, NB, interpret=False):
-    """Halo framing with NB images stacked per grid step (small spatial)."""
-    WP = W + 2
-    F = (H + 2) * WP              # frame rows per image (halo frame)
-    assert N % NB == 0
-    TILE = NB * F
-    assert TILE % 8 == 0, (NB, F)
-    SLAB = _align(TILE + 2 * WP + 2, 8)
-    LEAD = WP + 1                 # so tap offsets stay the same dh*WP+dw
-    total_x = _align(LEAD + N * F + 2 * WP + 2 + 8, 8)
-
-    kern = conv3x3_kernel_factory(TILE, WP)
-
-    def conv(x, w):
-        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-        xf = xp.reshape(N * F, C)
-        xf = jnp.pad(xf, ((LEAD, total_x - N * F - LEAD), (0, 0)))
-        w9 = w.reshape(9, C, K)
-        out = pl.pallas_call(
-            kern,
-            grid=(N // NB,),
-            in_specs=[
-                pl.BlockSpec((pl.Element(SLAB), pl.Element(C)),
-                             lambda g: (g * TILE, 0)),
-                pl.BlockSpec((9, C, K), lambda g: (0, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((TILE, K), lambda g: (g, 0)),
-            out_shape=jax.ShapeDtypeStruct((N * F, K), x.dtype),
-            interpret=interpret,
-        )(xf, w9)
-        return out.reshape(N, H + 2, WP, K)[:, 1:H + 1, 1:W + 1, :]
-
-    return conv
+def _per_call(fn, fetch):
+    """Median of paired (2N - N) window differences -> seconds/call."""
+    _win_time(fn, fetch, 2)                    # warm
+    diffs = []
+    for _ in range(REPS):
+        d1 = _win_time(fn, fetch, WINDOW)
+        d2 = _win_time(fn, fetch, 2 * WINDOW)
+        diffs.append(d2 - d1)
+    med = statistics.median(diffs)
+    return med / WINDOW if med > 0 else None
 
 
-def make_pallas_1x1(N, H, W, C, K, TR=2048, interpret=False):
-    """1x1 conv = row-tiled GEMM (R, C) @ (C, K)."""
-    R = N * H * W
-    Rp = _align(R, TR)
+def probe_shape(name, N, C, O, HW, stride, smoke):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import pallas_conv as pc
 
-    def kern(x_ref, w_ref, o_ref):
-        o_ref[:] = jax.lax.dot_general(
-            x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    dtype = jnp.float32 if smoke else jnp.bfloat16
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.standard_normal((N, C, HW, HW)) * 0.1, dtype)
+    w = jnp.asarray(r.standard_normal((O, C, 3, 3)) * 0.1, dtype)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
 
-    def conv(x, w):  # w: (1,1,C,K) or (C,K)
-        w2 = w.reshape(C, K)
-        xf = x.reshape(R, C)
-        if Rp != R:
-            xf = jnp.pad(xf, ((0, Rp - R), (0, 0)))
-        out = pl.pallas_call(
-            kern,
-            grid=(Rp // TR,),
-            in_specs=[pl.BlockSpec((TR, C), lambda i: (i, 0)),
-                      pl.BlockSpec((C, K), lambda i: (0, 0))],
-            out_specs=pl.BlockSpec((TR, K), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((Rp, K), x.dtype),
-            interpret=interpret,
-        )(xf, w2)
-        return out[:R].reshape(N, H, W, K)
-
-    return conv
-
-
-def xla_conv(stride=1):
-    def f(x, w):
+    def lax_conv(d, w_):
         return jax.lax.conv_general_dilated(
-            x, w, (stride, stride), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return f
+            d, w_, (stride, stride), [(1, 1), (1, 1)],
+            dimension_numbers=dn)
 
+    def pl_conv(d, w_):
+        if stride == 1:
+            return pc.conv3x3_same(d, w_)
+        return pc.conv3x3_s2(d, w_)
 
-# ----------------------------------------------------------------- timing
-def time_chain(step, x0, chain):
-    """step: x -> x (same shape). Differential timing over `chain` reps."""
-    def build(n):
+    Ho = HW // stride
+    flops = 2 * N * O * C * 9 * Ho * Ho          # 2 flops/MAC, 9 taps
+    row = {"shape": name, "N": N, "C": C, "O": O, "hw": HW,
+           "stride": stride}
+
+    if smoke:
+        # numerics guard: forward and both grads vs the lax lowering
+        got = np.asarray(pl_conv(x, w).astype(jnp.float32))
+        ref = np.asarray(lax_conv(x, w).astype(jnp.float32))
+        row["pallas_fwd_err"] = float(np.max(np.abs(got - ref)))
+
+        def loss(conv):
+            return lambda d, w_: jnp.sum(conv(d, w_).astype(jnp.float32)
+                                         ** 2)
+        gp = jax.grad(loss(pl_conv), (0, 1))(x, w)
+        gr = jax.grad(loss(lax_conv), (0, 1))(x, w)
+        row["pallas_grad_err"] = float(max(
+            np.max(np.abs(np.asarray(a) - np.asarray(b)))
+            / (np.max(np.abs(np.asarray(b))) + 1e-9)
+            for a, b in zip(gp, gr)))
+        return row
+
+    def chain_fwd(conv):
         @jax.jit
-        def f(x):
-            def body(c, _):
-                return step(c) * jnp.bfloat16(0.25), None
-            y, _ = jax.lax.scan(body, x, None, length=n)
-            return jnp.sum(y.astype(jnp.float32))
+        def f(d):
+            for _ in range(DEPTH):
+                y = conv(d, w)
+                d = d + (jnp.mean(y.astype(jnp.float32))
+                         * 1e-12).astype(d.dtype)
+            return d
         return f
 
-    f1, f2 = build(chain), build(2 * chain)
-    float(f1(x0)); float(f2(x0))
-    best1 = best2 = 1e9
-    for _ in range(REPS):
-        t0 = time.perf_counter(); float(f1(x0))
-        best1 = min(best1, time.perf_counter() - t0)
-        t0 = time.perf_counter(); float(f2(x0))
-        best2 = min(best2, time.perf_counter() - t0)
-    return max(best2 - best1, 1e-9) / chain
+    def chain_train(conv):
+        def loss(d, w_):
+            return 0.5 * jnp.sum(conv(d, w_).astype(jnp.float32) ** 2)
 
+        @jax.jit
+        def f(d):
+            for _ in range(DEPTH):
+                gd, gw = jax.grad(loss, (0, 1))(d, w)
+                eps = jnp.mean(gw.astype(jnp.float32)) * 1e-12
+                d = d + gd.astype(d.dtype) * 1e-12 + eps.astype(d.dtype)
+            return d
+        return f
 
-# ----------------------------------------------------------------- main
-def report(tag, name, t, flops, gbytes, extra=""):
-    print(f"{tag:>20} {name:>13} {t*1e3:8.3f}ms {flops/t/1e12:7.1f}TF "
-          f"{gbytes/t:6.0f}GB/s {extra}", flush=True)
+    def fetch(d):
+        np.asarray(jax.device_get(d[0, 0, 0, :1]))
 
+    for impl, conv in (("pallas", pl_conv), ("lax", lax_conv)):
+        for pass_, mk, nflops in (("fwd", chain_fwd, flops),
+                                  ("train", chain_train, 3 * flops)):
+            f = mk(conv)
+            state = {"d": x}
 
-def main():
-    quick = "--quick" in sys.argv
-    N = 128
-    rng = np.random.default_rng(0)
-
-    # ---- 3x3 stride-1 shapes (C == K) --------------------------------
-    for (H, W, C) in [(56, 56, 64), (28, 28, 128), (14, 14, 256), (7, 7, 512)]:
-        K = C
-        x = jnp.asarray(rng.standard_normal((N, H, W, C)) * 0.1, jnp.bfloat16)
-        w = jnp.asarray(rng.standard_normal((3, 3, C, K)) * 0.1, jnp.bfloat16)
-        flops = 2 * N * H * W * C * K * 9
-        gbytes = (2 * N * H * W * C * 2) / 1e9   # read x + write out, bf16
-        tag = f"3x3 {H}x{W}x{C}"
-        chain = max(64, min(512, int(0.25 / (flops / 45e12))))
-
-        ref = np.asarray(xla_conv()(x, w).astype(jnp.float32))
-        t = time_chain(lambda c: xla_conv()(c, w), x, chain)
-        report(tag, "xla", t, flops, gbytes, f"chain={chain}")
-
-        variants = []
-        if H >= 14:
-            for th in (H, H // 2):
-                if H % th == 0 and (th * (W + 2)) % 8 == 0:
-                    variants.append((f"pl th={th}",
-                                     make_pallas_conv(N, H, W, C, K, th)))
-        for nb in (8, 4):
-            F = (H + 2) * (W + 2)
-            if N % nb == 0 and (nb * F) % 8 == 0 and nb * F * max(C, 128) * 6 < 12e6:
-                variants.append((f"pl nb={nb}",
-                                 make_pallas_conv_stacked(N, H, W, C, K, nb)))
-        if quick:
-            variants = variants[:1]
-        for name, impl in variants:
+            def call(f=f):
+                state["d"] = f(state["d"])
+                return state["d"]
             try:
-                got = np.asarray(impl(x, w).astype(jnp.float32))
-                err = np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-6)
-                t = time_chain(lambda c: impl(c, w), x, chain)
-                report(tag, name, t, flops, gbytes,
-                       f"err={err:.0e}{'' if err < 2e-2 else ' BAD'}")
-            except Exception as e:
-                print(f"{tag:>20} {name:>13}    FAIL "
-                      f"{str(e).splitlines()[0][:80]}", flush=True)
+                t = _per_call(call, fetch)
+            except Exception as e:                     # noqa: BLE001
+                row["%s_%s_error" % (impl, pass_)] = repr(e)[:200]
+                continue
+            if t:
+                per_conv = t / DEPTH
+                row["%s_%s_ms" % (impl, pass_)] = round(per_conv * 1e3, 3)
+                row["%s_%s_tf" % (impl, pass_)] = round(
+                    nflops / per_conv / 1e12, 1)
+    return row
 
-    # ---- 1x1 shapes: chain expand+reduce pairs -----------------------
-    for (H, W, Cs, Cl) in [(56, 56, 64, 256), (28, 28, 128, 512),
-                           (14, 14, 256, 1024), (7, 7, 512, 2048)]:
-        xs = jnp.asarray(rng.standard_normal((N, H, W, Cs)) * 0.1, jnp.bfloat16)
-        w_up = jnp.asarray(rng.standard_normal((1, 1, Cs, Cl)) * 0.1, jnp.bfloat16)
-        w_dn = jnp.asarray(rng.standard_normal((1, 1, Cl, Cs)) * 0.1, jnp.bfloat16)
-        R = N * H * W
-        flops = 2 * R * Cs * Cl * 2              # up + down
-        gbytes = (R * Cs * 2 + R * Cl * 2) * 2 / 1e9
-        tag = f"1x1 {H}x{W} {Cs}<->{Cl}"
-        chain = max(32, min(256, int(0.25 / (flops / 30e12))))
 
-        conv = xla_conv()
-        t = time_chain(lambda c: conv(conv(c, w_up), w_dn), xs, chain)
-        report(tag, "xla", t, flops, gbytes, f"chain={chain}")
+def main(argv):
+    smoke = "--smoke" in argv
+    import jax
+    from mxnet_tpu.ops import pallas_conv as pc
 
-        pu = make_pallas_1x1(N, H, W, Cs, Cl)
-        pd = make_pallas_1x1(N, H, W, Cl, Cs)
-        ref = np.asarray(conv(conv(xs, w_up), w_dn).astype(jnp.float32))
-        try:
-            got = np.asarray(pd(pu(xs, w_up), w_dn).astype(jnp.float32))
-            err = np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-6)
-            t = time_chain(lambda c: pd(pu(c, w_up), w_dn), xs, chain)
-            report(tag, "pl gemm", t, flops, gbytes,
-                   f"err={err:.0e}{'' if err < 2e-2 else ' BAD'}")
-        except Exception as e:
-            print(f"{tag:>20} {'pl gemm':>13}    FAIL "
-                  f"{str(e).splitlines()[0][:80]}", flush=True)
+    out = {"metric": "pallas_conv_probe", "smoke": smoke,
+           "backend": jax.default_backend(), "depth": DEPTH}
+    if smoke:
+        pc.INTERPRET = True
+    elif out["backend"] != "tpu":
+        out["error"] = ("requires the TPU chip; use --smoke for the "
+                        "CPU interpret-mode numerics guard")
+        print(json.dumps(out))
+        return 2
+    rows = []
+    for spec in (SMOKE_SHAPES if smoke else SHAPES):
+        rows.append(probe_shape(*spec, smoke=smoke))
+    out["shapes"] = rows
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.exit(main(sys.argv[1:]))
