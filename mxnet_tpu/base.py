@@ -31,8 +31,22 @@ numeric_types = (float, int, np.generic)
 integer_types = (int, np.integer)
 
 
+# Set by mxnet_tpu.tracing at import: called with each constructed MXNetError
+# so the flight recorder can dump its ring for post-mortem context.  Must
+# never interfere with raising the error itself.
+_ERROR_HOOK: Optional[Callable] = None
+
+
 class MXNetError(RuntimeError):
     """Top-level framework error (parity with ``mxnet.base.MXNetError``)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if _ERROR_HOOK is not None:
+            try:
+                _ERROR_HOOK(self)
+            except Exception:
+                pass
 
 
 class NotSupportedForSparseNDArray(MXNetError):

@@ -33,6 +33,7 @@ from typing import Callable, List, Optional, Sequence
 
 from .base import get_env
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get", "set_engine"]
 
@@ -98,7 +99,7 @@ class _OprBlock:
     """Analog of ``OprBlock`` (``threaded_engine.h:66``)."""
 
     __slots__ = ("fn", "const_vars", "mutable_vars", "wait", "name", "exc",
-                 "done", "t_push")
+                 "done", "t_push", "trace")
 
     def __init__(self, fn, const_vars, mutable_vars, name):
         self.fn = fn
@@ -109,6 +110,7 @@ class _OprBlock:
         self.exc: Optional[BaseException] = None
         self.done = threading.Event()
         self.t_push = 0.0  # set at push only when telemetry is enabled
+        self.trace = None  # _tracing._EngineFlow when tracing is enabled
 
 
 class Engine:
@@ -152,13 +154,23 @@ class NaiveEngine(Engine):
         for v in tuple(const_vars) + tuple(mutable_vars):
             if v.exc is not None:
                 raise v.exc
+        tr = None
+        if _tracing.enabled:
+            tr = _tracing.engine_push(name, const_vars, mutable_vars)
+            tr.pushed()
+            tr.exec_begin()
         try:
             fn()
         except BaseException as e:  # noqa: BLE001 - must propagate like ref
             for v in mutable_vars:
                 v.exc = e
+            _tracing.flight.on_engine_crash(
+                name, e, [_tracing._var_name(v) for v in mutable_vars])
             raise
         finally:
+            if tr is not None:
+                tr.exec_end()
+                tr.completed()
             if _telemetry.enabled:
                 _N_DONE.inc()
 
@@ -192,6 +204,8 @@ class ThreadedEngine(Engine):
         mvars = list(dict.fromkeys(mutable_vars))
         cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
         opr = _OprBlock(fn, cvars, mvars, name)
+        if _tracing.enabled:
+            opr.trace = _tracing.engine_push(name, cvars, mvars)
         if _telemetry.enabled:
             opr.t_push = time.perf_counter()
             _T_PUSHED.inc()
@@ -209,6 +223,9 @@ class ThreadedEngine(Engine):
                 to_run.append(opr)
             for v in cvars + mvars:
                 self._try_grant(v, to_run)
+        if opr.trace is not None:
+            # flow-start before any worker can emit the flow-step
+            opr.trace.pushed()
         for o in to_run:
             self._pool.submit(self._execute, o)
         return opr
@@ -234,10 +251,13 @@ class ThreadedEngine(Engine):
 
     def _execute(self, opr: _OprBlock):
         tel = _telemetry.enabled  # one sample: pair the inc with its dec
+        tr = opr.trace
         if tel:
             if opr.t_push:
                 _T_DISPATCH.observe(time.perf_counter() - opr.t_push)
             _WORKERS_BUSY.inc()
+        if tr is not None:
+            tr.exec_begin()
         try:
             for v in opr.const_vars + opr.mutable_vars:
                 if v.exc is not None:
@@ -245,9 +265,19 @@ class ThreadedEngine(Engine):
             opr.fn()
         except BaseException as e:  # noqa: BLE001
             opr.exc = e
+            # a dump only for the crash origin — ops failing because a
+            # dependency poisoned them would re-dump the same root cause
+            propagated = any(v.exc is e
+                             for v in opr.const_vars + opr.mutable_vars)
             for v in opr.mutable_vars:
                 v.exc = e
+            if not propagated:
+                _tracing.flight.on_engine_crash(
+                    opr.name, e, opr.trace.mutable_names if opr.trace
+                    else [_tracing._var_name(v) for v in opr.mutable_vars])
         finally:
+            if tr is not None:
+                tr.exec_end(error=opr.exc)
             if tel:
                 _WORKERS_BUSY.dec()
                 _T_DONE.inc()
@@ -255,6 +285,10 @@ class ThreadedEngine(Engine):
 
     def _on_complete(self, opr: _OprBlock):
         """Analog of ``ThreadedEngine::OnComplete`` (threaded_engine.cc:412)."""
+        if opr.trace is not None:
+            # before the inflight decrement: wait_for_all returning must
+            # imply the flow-end is already in the event stream
+            opr.trace.completed()
         to_run: List[_OprBlock] = []
         with self._lock:
             for v in opr.const_vars:
@@ -331,7 +365,7 @@ class NativeThreadedEngine(Engine):
                                    min(16, os.cpu_count() or 4), int)
         self._handle = self._lib.MXNativeEngineCreate(int(n))
         self._errors = collections.OrderedDict()  # error code -> exception
-        self._pending = {}           # payload key -> (fn, done_event_or_None)
+        self._pending = {}  # payload key -> (fn, done, t_push, trace)
         self._next = [1]
         self._lock = threading.Lock()
         eng = self
@@ -342,24 +376,35 @@ class NativeThreadedEngine(Engine):
             # fn is skipped — so closure state is released and push_sync
             # waiters are woken (src/engine.cc Execute contract)
             with eng._lock:
-                fn, done, t_push = eng._pending.pop(key)
+                fn, done, t_push, tr = eng._pending.pop(key)
                 depth = len(eng._pending)
             if _telemetry.enabled:
                 if t_push:
                     _DISPATCH_LAT.labels(engine="native").observe(
                         time.perf_counter() - t_push)
                 _NAT_DEPTH.set(depth)
+            if tr is not None:
+                tr.exec_begin()
             code = int(prior_err)
+            err = None
             if code == 0:
                 try:
                     fn()
                 except BaseException as e:  # noqa: BLE001 - ref propagates
+                    err = e
                     with eng._lock:
                         code = eng._next[0]
                         eng._next[0] += 1
                         eng._errors[code] = e
                         while len(eng._errors) > eng.MAX_STORED_ERRORS:
                             eng._errors.popitem(last=False)
+            if tr is not None:
+                tr.exec_end(error=err)
+                tr.completed()
+            if err is not None:
+                _tracing.flight.on_engine_crash(
+                    tr.name if tr is not None else "native_engine_op", err,
+                    tr.mutable_names if tr is not None else None)
             if done is not None:
                 done.code = code
                 done.set()
@@ -390,31 +435,36 @@ class NativeThreadedEngine(Engine):
             arr[i] = v.handle
         return arr
 
-    def _push(self, fn, const_vars, mutable_vars, done=None, prio=0):
+    def _push(self, fn, const_vars, mutable_vars, done=None, prio=0, name=""):
         mvars = list(dict.fromkeys(mutable_vars))
         cvars = [v for v in dict.fromkeys(const_vars) if v not in mvars]
         tel = _telemetry.enabled
         if tel:
             _NAT_PUSHED.inc()
+        tr = None
+        if _tracing.enabled:
+            tr = _tracing.engine_push(name, cvars, mvars)
         with self._lock:
             key = self._next[0]
             self._next[0] += 1
             self._pending[key] = (fn, done,
-                                  time.perf_counter() if tel else 0.0)
+                                  time.perf_counter() if tel else 0.0, tr)
             if tel:
                 _NAT_DEPTH.set(len(self._pending))
+        if tr is not None:
+            tr.pushed()
         self._lib.MXNativeEnginePush(
             self._handle, self._fn_ptr, key,
             self._var_array(cvars), len(cvars),
             self._var_array(mvars), len(mvars), prio)
 
     def push(self, fn, const_vars=(), mutable_vars=(), name=""):
-        self._push(fn, const_vars, mutable_vars)
+        self._push(fn, const_vars, mutable_vars, name=name)
 
     def push_sync(self, fn, const_vars=(), mutable_vars=(), name=""):
         done = threading.Event()
         done.code = 0
-        self._push(fn, const_vars, mutable_vars, done=done)
+        self._push(fn, const_vars, mutable_vars, done=done, name=name)
         done.wait()
         if done.code:
             with self._lock:

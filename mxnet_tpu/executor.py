@@ -385,8 +385,12 @@ class Executor:
         plan = self._plan(bool(is_train))
         keys = self._keys(plan)
         self._last_keys = keys
+        # first_run marks the trace+compile invocation of this (mode,
+        # shape-set) so recompiles stand out from steady-state iterations
+        first_run = ("fwd", bool(is_train)) not in self._jitted
         with _profiler.span("Executor::Forward", "executor",
-                            histogram=_FWD_TIME):
+                            histogram=_FWD_TIME,
+                            args={"first_run": first_run}):
             if self._monitor is not None:
                 args, auxs = self._gather()
                 outs, new_aux = plan.execute(
@@ -420,8 +424,10 @@ class Executor:
             else self._keys(plan)
         args, auxs = self._gather()
         from . import profiler as _profiler
+        first_run = ("fwdbwd",) not in self._jitted
         with _profiler.span("Executor::Backward", "executor",
-                            histogram=_BWD_TIME):
+                            histogram=_BWD_TIME,
+                            args={"first_run": first_run}):
             outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
             self._apply_grads(grads)
         return
@@ -450,8 +456,10 @@ class Executor:
             ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
         from . import profiler as _profiler
+        first_run = ("fwdbwd",) not in self._jitted
         with _profiler.span("Executor::ForwardBackward", "executor",
-                            histogram=_FWDBWD_TIME):
+                            histogram=_FWDBWD_TIME,
+                            args={"first_run": first_run}):
             outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
             self._writeback_aux(new_aux)
             self._apply_grads(grads)

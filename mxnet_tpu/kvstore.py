@@ -31,6 +31,7 @@ from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["KVStore", "create"]
 
@@ -369,14 +370,29 @@ class DistAsyncKVStore(KVStore):
         self._lock = threading.Lock()
 
     def _rpc(self, *msg):
-        with self._lock:
-            self._ps.send_msg(self._sock, msg)
-            reply = self._ps.recv_msg(self._sock)
+        if _tracing.enabled:
+            # client span around the round-trip; flow_out() starts a
+            # cross-process flow whose end the server handler span emits,
+            # and returns the wire trace context the frame carries
+            with _tracing.span("KVStore::%s" % (msg[0],), "kvstore") as sp:
+                reply = self._roundtrip(msg, sp.flow_out())
+        else:
+            reply = self._roundtrip(msg, None)
         if reply is None:
             raise MXNetError("parameter server closed the connection")
         if reply[0] != "ok":
             raise MXNetError("parameter server: %s" % reply[1])
         return reply[1] if len(reply) > 1 else None
+
+    def _roundtrip(self, msg, trace_ctx):
+        with self._lock:
+            # positional-compatible call when untraced: tests (and any
+            # wrapper) may substitute a two-argument send_msg
+            if trace_ctx:
+                self._ps.send_msg(self._sock, msg, trace_ctx=trace_ctx)
+            else:
+                self._ps.send_msg(self._sock, msg)
+            return self._ps.recv_msg(self._sock)
 
     @property
     def rank(self):

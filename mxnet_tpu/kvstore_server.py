@@ -49,9 +49,10 @@ import numpy as np
 
 from .base import MXNetError
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["KVStoreServer", "run_server", "ps_address",
-           "send_msg", "recv_msg"]
+           "send_msg", "recv_msg", "recv_msg_tc"]
 
 # Frame errors count unconditionally (cold path — a malformed frame is
 # exactly the event an operator wants visible even before opting into
@@ -125,10 +126,19 @@ def _decode(node, blobs):
     return node
 
 
-def send_msg(sock: socket.socket, obj: Any):
-    """Frame: <Q total><I header_len><header json><I nblobs>(<Q len><raw>)*"""
+def send_msg(sock: socket.socket, obj: Any, trace_ctx: Optional[dict] = None):
+    """Frame: <Q total><I header_len><header json><I nblobs>(<Q len><raw>)*
+
+    Without ``trace_ctx`` the header is the encoded message list — the
+    original wire format, byte-identical.  With one, the header becomes
+    ``{"m": <encoded list>, "tc": {"t": trace_id, "s": span_id}}`` so the
+    receiving handler span can adopt the sender's trace (Dapper-style
+    propagation); old receivers never see it unless tracing is on."""
     blobs: list = []
-    header = json.dumps(_encode(list(obj), blobs)).encode()
+    node: Any = _encode(list(obj), blobs)
+    if trace_ctx:
+        node = {"m": node, "tc": dict(trace_ctx)}
+    header = json.dumps(node).encode()
     parts = [struct.pack("<I", len(header)), header,
              struct.pack("<I", len(blobs))]
     for b in blobs:
@@ -145,7 +155,32 @@ def _frame_error(why):
     raise MXNetError("kvstore wire: %s" % why)
 
 
-def recv_msg(sock: socket.socket):
+# trace-context bounds: ids are "<pid-hex>.<seq-hex>" strings, far under
+# this cap — anything larger/unknown is a malformed frame, not data
+_TC_KEYS = frozenset(("t", "s"))
+_TC_MAX_LEN = 64
+
+
+def _check_trace_ctx(tc):
+    """Validate an incoming wire trace context with the same loud-reject
+    discipline as the framing bounds checks above."""
+    if not isinstance(tc, dict):
+        _frame_error("trace context is not an object")
+    unknown = set(tc) - _TC_KEYS
+    if unknown:
+        _frame_error("unknown trace-context keys %s" % sorted(unknown))
+    for k, v in tc.items():
+        if not isinstance(v, str) or not v or len(v) > _TC_MAX_LEN:
+            _frame_error("trace-context field %r malformed or oversized" % k)
+    return tc
+
+
+def recv_msg_tc(sock: socket.socket):
+    """Receive one message plus its optional trace context.
+
+    Returns ``(msg, tc)`` where ``tc`` is ``{"t":..., "s":...}`` or None
+    (old-format frames, whose header is the bare message list, keep
+    parsing unchanged), or None on clean EOF."""
     header = _recv_exact(sock, 8)
     if header is None:
         return None
@@ -160,6 +195,19 @@ def recv_msg(sock: socket.socket):
         _frame_error("header length %d overruns %d-byte frame"
                      % (hlen, len(payload)))
     hdr = json.loads(payload[4:4 + hlen].decode())
+    tc = None
+    if isinstance(hdr, dict):
+        # traced framing: {"m": message, "tc": {...}} — the message list
+        # itself is always a JSON array at top level, so a dict here can
+        # only be the trace wrapper
+        unknown = set(hdr) - {"m", "tc"}
+        if unknown:
+            _frame_error("unknown header keys %s" % sorted(unknown))
+        if "m" not in hdr:
+            _frame_error("traced header missing message body")
+        if hdr.get("tc") is not None:
+            tc = _check_trace_ctx(hdr["tc"])
+        hdr = hdr["m"]
     off = 4 + hlen
     (nblobs,) = struct.unpack_from("<I", payload, off)
     off += 4
@@ -178,7 +226,13 @@ def recv_msg(sock: socket.socket):
     if off != len(payload):
         _frame_error("%d trailing bytes after last blob"
                      % (len(payload) - off))
-    return _decode(hdr, blobs)
+    return _decode(hdr, blobs), tc
+
+
+def recv_msg(sock: socket.socket):
+    """Receive one message, dropping any trace context (original API)."""
+    got = recv_msg_tc(sock)
+    return None if got is None else got[0]
 
 
 def _recv_exact(sock, n):
@@ -221,7 +275,7 @@ class KVStoreServer:
             def handle(self):
                 while True:
                     try:
-                        msg = recv_msg(self.request)
+                        got = recv_msg_tc(self.request)
                     except Exception as e:
                         # a malformed frame (old wire format, framing bug,
                         # bad blob index) answers with a diagnostic instead
@@ -233,20 +287,31 @@ class KVStoreServer:
                         except Exception:
                             pass
                         return
-                    if msg is None:
+                    if got is None:
                         return
-                    if _telemetry.enabled:
-                        t0 = time.perf_counter()
-                        reply = outer._dispatch(msg)
-                        cmd = str(msg[0])
-                        _SRV_REQS.labels(cmd=cmd).inc()
-                        _SRV_LAT.labels(cmd=cmd).observe(
-                            time.perf_counter() - t0)
+                    msg, tc = got
+                    if _tracing.enabled:
+                        # adopt the worker's trace context: the handler
+                        # span joins the pushing span's trace and ends
+                        # its cross-process flow
+                        with _tracing.server_span(
+                                "Server::%s" % (msg[0],), tc):
+                            reply = self._timed_dispatch(msg)
                     else:
-                        reply = outer._dispatch(msg)
+                        reply = self._timed_dispatch(msg)
                     send_msg(self.request, reply)
                     if msg[0] == "stop":
                         return
+
+            def _timed_dispatch(self, msg):
+                if not _telemetry.enabled:
+                    return outer._dispatch(msg)
+                t0 = time.perf_counter()
+                reply = outer._dispatch(msg)
+                cmd = str(msg[0])
+                _SRV_REQS.labels(cmd=cmd).inc()
+                _SRV_LAT.labels(cmd=cmd).observe(time.perf_counter() - t0)
+                return reply
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -427,6 +492,14 @@ def run_server():
     # not be assignable on this host under NAT/port-mapping, so the bind
     # host is a separate knob; set MXNET_PS_BIND_HOST="" to bind-all.
     bind_host = os.environ.get("MXNET_PS_BIND_HOST", host)
+    if _tracing.enabled:
+        # collect handler spans for the whole serving lifetime, dumped
+        # rank/role-keyed for tools/merge_traces.py when the stop command
+        # shuts the server down
+        from . import profiler as _profiler
+        _profiler.set_state("run")
     server = KVStoreServer(host=bind_host, port=port,
                            num_workers=num_workers)
     server.serve_forever()
+    if _tracing.enabled:
+        _tracing.dump_process_trace(role="server")

@@ -25,17 +25,38 @@ from __future__ import annotations
 import ast
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from ..base import AttrDict, MXNetError
+from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 
 __all__ = ["Operator", "register", "get_op", "list_ops", "apply_op",
            "param", "OPS"]
 
 OPS: Dict[str, "Operator"] = {}
+
+# jit-cache observability: recompiles are the classic silent TPU perf bug
+# (a drifting shape or env flag turns every step into a compile).  Hit/miss
+# counts and the compile-duration histogram make them visible in a /metrics
+# scrape; the XLA::Compile trace span makes them visible in Perfetto.
+_JIT_HITS = _telemetry.counter(
+    "op_jit_cache_hits_total",
+    "Operator jit-cache lookups served by an existing entry", ("op",))
+_JIT_MISSES = _telemetry.counter(
+    "op_jit_cache_misses_total",
+    "Operator jit-cache lookups that built a new entry", ("op",))
+_JIT_ENTRIES = _telemetry.gauge(
+    "op_jit_cache_entries", "Live operator jit-cache entries (all ops)")
+_COMPILE_TIME = _telemetry.histogram(
+    "op_compile_seconds",
+    "First-invocation duration of a fresh jit-cache entry (where jax "
+    "traces and XLA compiles — jax.jit construction itself is lazy)",
+    ("op",))
 
 
 # --------------------------------------------------------------------------
@@ -179,15 +200,44 @@ class Operator:
         Cache key is ``attrs`` alone, or ``(attrs, env-values)`` when the
         op declares ``env_keys`` — trace-time formulation flags then take
         effect immediately instead of being baked into a stale executable.
+
+        Observability: hit/miss counters and a per-op compile-duration
+        histogram when telemetry is enabled.  jax.jit is lazy — tracing
+        and XLA compilation happen at the first *invocation* — so a fresh
+        entry is a self-replacing wrapper that times that first call and
+        records an ``XLA::Compile`` span, then swaps in the raw jitted
+        callable: steady state pays nothing beyond the cache lookup.
         """
         key = attrs if not self.env_keys else (
             attrs, tuple(os.environ.get(k) for k in self.env_keys))
         c = self._jit_cache.get(key)
-        if c is None:
-            fn = self.fn
-            c = jax.jit(lambda *arrays: fn(attrs, *arrays))
-            self._jit_cache[key] = c
-        return c
+        if c is not None:
+            if _telemetry.enabled:
+                _JIT_HITS.labels(op=self.name).inc()
+            return c
+        if _telemetry.enabled:
+            _JIT_MISSES.labels(op=self.name).inc()
+        fn = self.fn
+        jfn = jax.jit(lambda *arrays: fn(attrs, *arrays))
+        name, cache = self.name, self._jit_cache
+
+        def _first_call(*arrays):
+            begin = _profiler._now_us()
+            t0 = time.perf_counter()
+            try:
+                return jfn(*arrays)
+            finally:
+                cache[key] = jfn
+                if _telemetry.enabled:
+                    _COMPILE_TIME.labels(op=name).observe(
+                        time.perf_counter() - t0)
+                _profiler.record_span("XLA::Compile %s" % name, begin,
+                                      _profiler._now_us(), "compile")
+
+        self._jit_cache[key] = _first_call
+        if _telemetry.enabled:
+            _JIT_ENTRIES.inc()
+        return _first_call
 
     def __call__(self, attrs: AttrDict, *arrays):
         return self.compiled(attrs)(*arrays)

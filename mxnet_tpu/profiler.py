@@ -22,6 +22,7 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 from . import telemetry as _telemetry
+from .base import get_env
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state",
@@ -32,6 +33,12 @@ __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
 _PROF_GAUGE = _telemetry.gauge(
     "profiler_counter", "Latest value of each profiler.Counter",
     ("domain", "counter"))
+
+# the in-memory event list is capped (long runs used to grow it until OOM);
+# drops are counted unconditionally — losing trace data is an error signal
+_DROPPED = _telemetry.counter(
+    "profiler_events_dropped_total",
+    "Profiler events dropped by the in-memory cap (MXNET_PROFILER_MAX_EVENTS)")
 
 _lock = threading.Lock()
 _config = {
@@ -48,8 +55,13 @@ _config = {
 _state = "stop"          # 'run' | 'stop'
 _paused = False
 _events: List[dict] = []
+_max_events = get_env("MXNET_PROFILER_MAX_EVENTS", 1_000_000, int)
 _t0 = time.perf_counter()
 _jax_trace_active = False
+
+# set by mxnet_tpu.tracing at import: its FlightRecorder, fed every span that
+# goes through record_span even when the profiler is stopped
+_flight = None
 
 
 def _now_us():
@@ -60,16 +72,34 @@ def is_running():
     return _state == "run" and not _paused
 
 
+def _append_event(ev: dict):
+    """Capped append shared by spans, counters, markers and flow events."""
+    with _lock:
+        if len(_events) >= _max_events:
+            _DROPPED.inc()
+            return
+        _events.append(ev)
+
+
 def record_span(name: str, begin_us: float, end_us: float,
-                category: str = "operator"):
-    """Append one complete span (the ProfileOperator analog)."""
+                category: str = "operator", args: Optional[dict] = None):
+    """Append one complete span (the ProfileOperator analog).
+
+    Also feeds the flight-recorder ring (tracing.flight) when that is on —
+    the ring stays warm even with the profiler stopped, so a post-mortem
+    dump has the last N spans regardless of collection state."""
+    fl = _flight
+    if fl is not None and fl.enabled:
+        fl.record(name, category, begin_us, end_us, args)
     if not is_running():
         return
-    with _lock:
-        _events.append({"name": name, "cat": category, "ph": "X",
-                        "ts": begin_us, "dur": end_us - begin_us,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % 100000})
+    ev = {"name": name, "cat": category, "ph": "X",
+          "ts": begin_us, "dur": end_us - begin_us,
+          "pid": os.getpid(),
+          "tid": threading.get_ident() % 100000}
+    if args:
+        ev["args"] = args
+    _append_event(ev)
 
 
 class span:
@@ -79,12 +109,13 @@ class span:
     wall-clock measurement in seconds when telemetry is enabled, so one
     timing path feeds both the Chrome trace and the metrics registry."""
 
-    __slots__ = ("name", "cat", "begin", "hist")
+    __slots__ = ("name", "cat", "begin", "hist", "args")
 
-    def __init__(self, name, category="operator", histogram=None):
+    def __init__(self, name, category="operator", histogram=None, args=None):
         self.name = name
         self.cat = category
         self.hist = histogram
+        self.args = args
 
     def __enter__(self):
         self.begin = _now_us()
@@ -92,7 +123,7 @@ class span:
 
     def __exit__(self, *exc):
         end = _now_us()
-        record_span(self.name, self.begin, end, self.cat)
+        record_span(self.name, self.begin, end, self.cat, args=self.args)
         if self.hist is not None and _telemetry.enabled:
             self.hist.observe((end - self.begin) * 1e-6)
         return False
@@ -140,15 +171,33 @@ def resume():
     _paused = False
 
 
-def dump(finished=True):
-    """Write the Chrome-trace JSON file (parity: Profiler::DumpProfile)."""
+def dump(finished=True, filename=None):
+    """Write the Chrome-trace JSON file (parity: Profiler::DumpProfile).
+
+    ``finished=False`` keeps the event buffer intact (mid-run snapshot);
+    only ``finished=True`` clears it.  The write is atomic (temp file +
+    rename) so a crash mid-dump can never leave a truncated trace.  The
+    ``metadata`` block carries what ``tools/merge_traces.py`` needs to
+    clock-align and label per-process traces from a dist run."""
     with _lock:
         events = list(_events)
         if finished:
             _events.clear()
-    with open(_config["filename"], "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "us"}, f)
-    return _config["filename"]
+    path = filename or _config["filename"]
+    meta = {
+        # unix epoch (us) of this process's ts origin: merge_traces.py uses
+        # the per-file difference to shift events onto one clock
+        "t0_unix_us": time.time() * 1e6 - _now_us(),
+        "pid": os.getpid(),
+        "rank": int(os.environ.get("DMLC_WORKER_ID", "0") or 0),
+        "role": os.environ.get("DMLC_ROLE", "worker"),
+    }
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "us",
+                   "metadata": meta}, f)
+    os.replace(tmp, path)
+    return path
 
 
 def dumps(reset=False):
@@ -246,12 +295,10 @@ class Counter:
             _PROF_GAUGE.labels(domain=self.domain.name,
                                counter=self.name).set(value)
         if is_running():
-            with _lock:
-                _events.append({"name": "%s::%s" % (self.domain.name,
-                                                    self.name),
-                                "cat": "counter", "ph": "C",
-                                "ts": _now_us(), "pid": os.getpid(),
-                                "args": {"value": value}})
+            _append_event({"name": "%s::%s" % (self.domain.name, self.name),
+                           "cat": "counter", "ph": "C",
+                           "ts": _now_us(), "pid": os.getpid(),
+                           "args": {"value": value}})
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -275,8 +322,6 @@ class Marker:
 
     def mark(self, scope="process"):
         if is_running():
-            with _lock:
-                _events.append({"name": "%s::%s" % (self.domain.name,
-                                                    self.name),
-                                "cat": "marker", "ph": "i", "ts": _now_us(),
-                                "pid": os.getpid(), "s": scope[0]})
+            _append_event({"name": "%s::%s" % (self.domain.name, self.name),
+                           "cat": "marker", "ph": "i", "ts": _now_us(),
+                           "pid": os.getpid(), "s": scope[0]})

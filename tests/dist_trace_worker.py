@@ -1,0 +1,55 @@
+"""dist_async worker for the tracing test: a few traced push/pull
+round-trips, then a per-process trace dump for tools/merge_traces.py.
+
+Launched by tests/test_tracing.py via tools/launch.py with MXNET_TRACING=1
+and MXNET_TRACE_DIR set; the server process (same env) dumps its own
+trace when the stop command shuts it down.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler, tracing
+
+
+def main():
+    assert tracing.enabled, "worker must run with MXNET_TRACING=1"
+    profiler.set_state("run")
+    # create() first: in a DMLC_ROLE=server process this enters the server
+    # loop and never returns
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+
+    kv.init("w", nd.zeros((4, 2)))
+    kv.barrier()
+    for step in range(5):
+        kv.push("w", nd.array(np.full((4, 2), rank + step, np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+
+    profiler.set_state("stop")
+    path = tracing.dump_process_trace(role="worker")
+    print("rank %d dumped %s" % (rank, path))
+    assert path and os.path.exists(path)
+    if rank == 0:
+        # keep the launcher's worker-liveness window open so the server
+        # finishes its own trace dump before cleanup kills it
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome traces from a dist run into one clock-aligned
+trace, or schema-check trace files (``--validate``).
+
+Each process of a ``dist_async`` run under ``MXNET_TRACING=1`` +
+``MXNET_TRACE_DIR=<dir>`` dumps its own ``trace_worker<r>.json`` /
+``trace_server.json`` (see ``mxnet_tpu.tracing.dump_process_trace``).
+Timestamps are relative to each process's own perf_counter origin, so the
+files cannot be overlaid as-is; ``profiler.dump`` records that origin as
+unix epoch in ``metadata.t0_unix_us``, and this tool shifts every event by
+the per-file offset to the earliest origin.  Rows are keyed by rank: the
+server becomes pid 1 (sorted first), worker r becomes pid 100+r, each with
+a ``process_name`` metadata event Perfetto displays.  Span/flow ids embed
+the producing pid, so cross-process flow links (a worker's ``s`` ending at
+a server handler's ``f``) survive the merge without remapping.
+
+Usage:
+    python tools/merge_traces.py -o merged.json trace_worker0.json \\
+        trace_worker1.json trace_server.json
+    python tools/merge_traces.py --validate merged.json
+
+stdlib-only on purpose: usable on any machine holding the trace files.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# phases we emit plus common Chrome-trace ones a hand-built file may use
+_KNOWN_PHASES = frozenset("XBEiIsftMCbenO")
+_FLOW_PHASES = frozenset("stf")
+
+
+def load_trace(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _events_of(trace):
+    if isinstance(trace, list):  # bare-array Chrome trace form
+        return trace
+    if isinstance(trace, dict):
+        return trace.get("traceEvents")
+    return None
+
+
+def validate_trace(trace):
+    """Schema-check one loaded trace; returns a list of error strings.
+
+    Checks: traceEvents is a list of objects with known ``ph``, string
+    names, numeric ``ts`` (and ``dur`` for X spans); flow events carry an
+    ``id``; flow-start ids are unique; every flow step/end has a matching
+    start.
+    """
+    errors = []
+    events = _events_of(trace)
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    start_ids = set()
+    continuations = []  # (index, ph, id) for t/f events
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            errors.append("event #%d: not an object" % i)
+            continue
+        ph = e.get("ph")
+        if ph not in _KNOWN_PHASES:
+            errors.append("event #%d: unknown phase %r" % (i, ph))
+            continue
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            errors.append("event #%d (%s): missing name" % (i, ph))
+        if ph != "M" and not isinstance(e.get("ts"), (int, float)):
+            errors.append("event #%d (%s): missing numeric ts" % (i, ph))
+        if ph == "X" and not isinstance(e.get("dur"), (int, float)):
+            errors.append("event #%d (X): missing numeric dur" % i)
+        if ph in _FLOW_PHASES:
+            fid = e.get("id")
+            if not isinstance(fid, (str, int)):
+                errors.append("event #%d (%s): flow event without id"
+                              % (i, ph))
+                continue
+            if ph == "s":
+                if fid in start_ids:
+                    errors.append("event #%d (s): duplicate flow-start id %r"
+                                  % (i, fid))
+                start_ids.add(fid)
+            else:
+                continuations.append((i, ph, fid))
+    for i, ph, fid in continuations:
+        if fid not in start_ids:
+            errors.append("event #%d (%s): flow id %r has no matching start"
+                          % (i, ph, fid))
+    return errors
+
+
+def merge(traces):
+    """Merge loaded per-process traces into one Chrome trace dict."""
+    bases = []
+    for tr in traces:
+        meta = tr.get("metadata", {}) if isinstance(tr, dict) else {}
+        bases.append(float(meta.get("t0_unix_us", 0.0) or 0.0))
+    known = [b for b in bases if b > 0]
+    base0 = min(known) if known else 0.0
+    out = []
+    used_pids = set()
+    for idx, tr in enumerate(traces):
+        meta = tr.get("metadata", {}) if isinstance(tr, dict) else {}
+        role = str(meta.get("role", "worker"))
+        rank = int(meta.get("rank", idx) or 0)
+        pid = 1 if role == "server" else 100 + rank
+        while pid in used_pids:  # duplicate role/rank inputs still merge
+            pid += 1000
+        used_pids.add(pid)
+        label = "server" if role == "server" else "%s %d" % (role, rank)
+        out.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                    "ts": 0, "args": {"name": label}})
+        out.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                    "tid": 0, "ts": 0,
+                    "args": {"sort_index": -1 if role == "server" else rank}})
+        shift = (bases[idx] - base0) if bases[idx] > 0 else 0.0
+        for e in _events_of(tr) or []:
+            e = dict(e)
+            e["pid"] = pid
+            if e.get("ph") != "M" and isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] + shift
+            out.append(e)
+    return {"traceEvents": out, "displayTimeUnit": "us"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-process mxnet_tpu traces / validate a trace")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check the input files instead of merging")
+    ap.add_argument("-o", "--output", default="merged_trace.json",
+                    help="merged trace path (merge mode)")
+    ap.add_argument("inputs", nargs="+", help="trace json files")
+    args = ap.parse_args(argv)
+
+    if args.validate:
+        ok = True
+        for path in args.inputs:
+            try:
+                errs = validate_trace(load_trace(path))
+            except (OSError, ValueError) as e:
+                errs = ["unreadable: %s" % e]
+            for err in errs:
+                print("%s: %s" % (path, err))
+            print("%s: %s" % (path, "OK" if not errs else "INVALID"))
+            ok = ok and not errs
+        return 0 if ok else 1
+
+    merged = merge([load_trace(p) for p in args.inputs])
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    print("wrote %s (%d events from %d files)"
+          % (args.output, len(merged["traceEvents"]), len(args.inputs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
