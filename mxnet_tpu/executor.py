@@ -226,8 +226,39 @@ class _Segment:
         self.fn = _jax.jit(fn)
 
 
+def build_update_program(update_fns, donate_params=True):
+    """One donated XLA program applying every parameter's optimizer update.
+
+    ``gvals[i]`` is the list of per-replica gradients for param ``i``;
+    replicas are summed in-trace (the local-kvstore reduce), so the whole
+    update phase — reduce + N optimizer kernels — is a single device
+    launch.  ``donate_params=False`` keeps the weight inputs alive for
+    callers whose autograd tape may still reference them (gluon Trainer);
+    opt-state is always donated (it never escapes the updater).
+    """
+    update_fns = tuple(update_fns)
+
+    def fn(pvals, svals, gvals, lrs, wds, ts, rescale):
+        new_p, new_s = [], []
+        for i, upd in enumerate(update_fns):
+            g = gvals[i][0]
+            for extra in gvals[i][1:]:
+                g = g + extra
+            w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale, ts[i])
+            new_p.append(w)
+            new_s.append(s)
+        return new_p, new_s
+
+    return jax.jit(fn, donate_argnums=(0, 1) if donate_params else (1,))
+
+
 class Executor:
     """A bound executor (parity: mxnet.executor.Executor)."""
+
+    # env flags that select a different fused-step program; they join the
+    # program cache key so a toggle takes effect without a rebind (same
+    # contract as ops/registry.py env_keys)
+    STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP",)
 
     def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
                  args_grad: Dict[str, Any], grad_req: Dict[str, str],
@@ -358,10 +389,83 @@ class Executor:
             self._jitted[("fwdbwd",)] = fn if placements else jax.jit(fn)
         return self._jitted[("fwdbwd",)]
 
+    def _step_env(self):
+        import os
+        return tuple(os.environ.get(k) for k in self.STEP_ENV_KEYS)
+
+    def step_program(self, pnames, update_fns):
+        """Whole-step program: forward + vjp-backward + optimizer update in
+        ONE ``jax.jit`` with params and opt-state donated — weights update
+        in place on device, zero per-param python dispatch.
+
+        ``pnames`` are the trainable args (vjp is taken w.r.t. exactly
+        these); ``update_fns[i]`` is the param's bound
+        ``Optimizer.fused_update``.  Both are closure-captured at first
+        build, so callers must drop cached ``("step", ...)`` entries when
+        the optimizer binding changes (fused_step.ModuleFusedStep does).
+        Per-slot lr/wd/t and rescale_grad arrive as traced scalars: one
+        compiled program serves every step.
+        """
+        key = ("step",) + self._step_env()
+        fn = self._jitted.get(key)
+        if fn is not None:
+            return fn
+        plan = self._plan(True)
+        arg_names, aux_names = plan.arg_names, plan.aux_names
+        pnames = tuple(pnames)
+        update_fns = tuple(update_fns)
+        pset = set(pnames)
+        other_names = [n for n in arg_names if n not in pset]
+
+        def fn(pvals, svals, others, auxs, keys, ograds, lrs, wds, ts,
+               rescale):
+            base = dict(zip(other_names, others))
+
+            def pure(gvals):
+                av = dict(base)
+                av.update(zip(pnames, gvals))
+                outs, new_aux = plan.execute(
+                    av, dict(zip(aux_names, auxs)), keys)
+                return outs, [new_aux[n] for n in aux_names]
+
+            (outs, new_aux), vjp = jax.vjp(lambda *g: pure(list(g)), *pvals)
+            grads = vjp((list(ograds), [jnp.zeros_like(a) for a in new_aux]))
+            new_p, new_s = [], []
+            for i, upd in enumerate(update_fns):
+                w, s = upd(pvals[i], grads[i], svals[i],
+                           lrs[i], wds[i], rescale, ts[i])
+                new_p.append(w)
+                new_s.append(s)
+            return new_p, new_s, outs, new_aux
+
+        fn = jax.jit(fn, donate_argnums=(0, 1))
+        self._jitted[key] = fn
+        return fn
+
+    def update_program(self, update_fns):
+        """Cached donated update-only program (multi-device local path:
+        fwdbwd stays per-device, the update fuses into one launch)."""
+        key = ("update",) + self._step_env()
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = build_update_program(update_fns)
+            self._jitted[key] = fn
+        return fn
+
     def _gather(self):
         args = [self.arg_dict[n]._data for n in self.arg_names]
         auxs = [self.aux_dict[n]._data for n in self.aux_names]
         return args, auxs
+
+    def _default_ograds(self):
+        """Ones head-gradients with shapes from (cached) shape inference."""
+        shape_key = tuple(self.arg_dict[n].shape for n in self.arg_names)
+        cached = self._jitted.get(("oshapes", shape_key))
+        if cached is None:
+            _, cached, _ = self._symbol.infer_shape(
+                **{n: self.arg_dict[n].shape for n in self.arg_names})
+            self._jitted[("oshapes", shape_key)] = cached
+        return [jnp.ones(s, np.float32) for s in cached]
 
     def _wrap_outputs(self, outs):
         from .ndarray.ndarray import NDArray
@@ -445,13 +549,7 @@ class Executor:
         self._last_keys = keys
         args, auxs = self._gather()
         if out_grads is None:
-            shape_key = tuple(self.arg_dict[n].shape for n in self.arg_names)
-            cached = self._jitted.get(("oshapes", shape_key))
-            if cached is None:
-                _, cached, _ = self._symbol.infer_shape(
-                    **{n: self.arg_dict[n].shape for n in self.arg_names})
-                self._jitted[("oshapes", shape_key)] = cached
-            ogs = [jnp.ones(s, np.float32) for s in cached]
+            ogs = self._default_ograds()
         else:
             ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]

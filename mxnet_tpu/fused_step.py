@@ -1,0 +1,420 @@
+"""Fused whole-step training dispatch.
+
+The paper's "fast as the hardware allows" step has three launches on the
+eager path: one fwdbwd XLA program plus a python loop of per-param
+optimizer kernels plus per-param KVStore round-trips.  This module drives
+the fused alternative: ``Executor.step_program`` compiles forward + vjp +
+every optimizer update into ONE ``jax.jit`` with params and opt-state
+donated (``donate_argnums``), so a local single-device step is exactly one
+device launch and weights update in place.  Multi-device local training
+keeps per-device fwdbwd programs and fuses the reduce+update phase into
+one donated ``Executor.update_program`` per device.
+
+Gated by ``MXNET_TPU_FUSED_STEP`` (default ON for the local path); the
+eager per-param loop remains both the OFF fallback and the parity oracle —
+any structural surprise (monitor installed, sparse grads, exotic optimizer
+state, kvstore-side update) falls back per step, counted by
+``step_dispatch_total{path=...}``.
+
+Donation safety: XLA donation genuinely deletes the input buffer (also on
+the CPU backend), while NDArray handles are freely re-pointed by python
+callers (``set_params``, ``__setitem__``, ``set_states``).  ``DonationPool``
+therefore tracks, per logical slot, the exact jax array the fused program
+last produced; anything else found in the handle is defensively copied
+before being donated, so no caller-held buffer is ever invalidated and no
+donated buffer is ever double-used.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from . import telemetry as _telemetry
+
+__all__ = ["enabled", "ModuleFusedStep", "TrainerFusedUpdate",
+           "DonationPool", "STEP_DISPATCH", "STEP_TIME", "ENV_FLAG"]
+
+ENV_FLAG = "MXNET_TPU_FUSED_STEP"
+
+STEP_DISPATCH = _telemetry.counter(
+    "step_dispatch_total",
+    "Training-step dispatches by path: fused one-program step vs eager "
+    "per-param loop; bucketed vs per-key KVStore gradient traffic",
+    ("path",))
+STEP_TIME = _telemetry.histogram(
+    "step_update_seconds",
+    "Wall time of the train-step update phase (fused path: the whole "
+    "fwd+bwd+update program; eager path: the per-param update loop)")
+
+
+def enabled():
+    """MXNET_TPU_FUSED_STEP gate; default ON."""
+    return os.environ.get(ENV_FLAG, "1").lower() not in \
+        ("0", "false", "off", "")
+
+
+def _env_tuple():
+    from .executor import Executor
+    return tuple(os.environ.get(k) for k in Executor.STEP_ENV_KEYS)
+
+
+class DonationPool:
+    """Ownership ledger for buffers the fused step donates.
+
+    ``take`` returns a buffer safe to donate for a slot: the handle's
+    current array if this pool produced it (nobody else can hold it — the
+    program output went straight into the handle), else a fresh copy
+    (externally written handles may share their buffer with caller-held
+    arrays via no-op device_put/astype/broadcast_to).  ``give`` writes a
+    program output back into the handle and records it as pool-owned.
+    """
+
+    def __init__(self):
+        self._own = {}
+
+    def take(self, slot, handle):
+        cur = handle._data
+        if self._own.get(slot) is not cur:
+            cur = jnp.array(cur)
+        return cur
+
+    def give(self, slot, handle, new_data):
+        self._own[slot] = new_data
+        handle._data = new_data
+
+
+def _dense(arr):
+    from .ndarray.sparse import BaseSparseNDArray
+    return arr is not None and not isinstance(arr, BaseSparseNDArray)
+
+
+class ModuleFusedStep:
+    """Drives Module's fused train step.
+
+    ``forward_backward`` stages the per-device feeds; ``update`` then
+    dispatches, for a single device, ONE whole-step program (fwd + vjp +
+    update, params/opt-state donated) or, for multiple devices, the
+    per-device fwdbwd programs followed by one donated update program per
+    device.  Gradients are not written back to ``grad_dict`` on the
+    single-device fused path (they only exist inside the program); the
+    flush hooks replay a staged batch through the eager oracle whenever
+    outputs or input grads must be observable before ``update``.
+    """
+
+    def __init__(self, module):
+        self._mod = module
+        self._eg = module._exec_group
+        self._pools = [DonationPool() for _ in self._eg.execs]
+        self._pending = None
+        self._unsupported = False
+        self._structural_ok = None
+        # program closures capture the optimizer binding; a new driver
+        # (new init_optimizer / rebind) must not reuse a predecessor's
+        for ex in self._eg.execs:
+            for k in [k for k in ex._jitted
+                      if isinstance(k, tuple) and k
+                      and k[0] in ("step", "update")]:
+                del ex._jitted[k]
+        req = self._eg.grad_req
+        self._pnames = [n for n in module._param_names
+                        if req.get(n) == "write"]
+        self._pset = set(self._pnames)
+        self._has_add = any(req.get(n) == "add"
+                            for n in module._param_names)
+
+    # -- lifecycle --------------------------------------------------------
+    def stale(self):
+        return self._eg is not self._mod._exec_group
+
+    @property
+    def pending(self):
+        return self._pending is not None
+
+    def stage(self, data_batch):
+        self._pending = self._eg._load_batch(data_batch)
+
+    def flush_eager(self):
+        """Replay a staged batch through the eager fwdbwd programs so
+        outputs/grads/aux become observable exactly as if the batch had
+        never been deferred."""
+        if self._pending is None:
+            return
+        feeds, self._pending = self._pending, None
+        for ex, feed in zip(self._eg.execs, feeds):
+            ex.forward_backward(**feed)
+
+    # -- eligibility ------------------------------------------------------
+    def eligible(self):
+        if not enabled() or self._unsupported:
+            return False
+        m = self._mod
+        if m._updater is None:  # update_on_kvstore
+            return False
+        kv = m._kvstore
+        if kv is not None and (kv.type.startswith("dist")
+                               or kv._updater is not None
+                               or kv._compression is not None):
+            return False
+        for ex in self._eg.execs:
+            if ex._monitor is not None or ex._group2ctx:
+                return False
+        if self._structural_ok is None:
+            self._structural_ok = self._check_structure()
+        return self._structural_ok
+
+    def _check_structure(self):
+        m = self._mod
+        if self._eg.inputs_need_grad or self._has_add or not self._pnames:
+            return False
+        opt_ = m._optimizer
+        if opt_.fused_state_arity() is None:
+            return False
+        for ex in self._eg.execs:
+            for n in self._pnames:
+                w = ex.arg_dict[n]
+                if not _dense(w) or not _dense(ex.grad_dict.get(n)) \
+                        or not opt_.supports_fused(w):
+                    return False
+        return True
+
+    # -- dispatch ---------------------------------------------------------
+    def step(self):
+        """Consume the staged batch with fused programs.  Returns False
+        (after replaying the batch eagerly) when the updater state turns
+        out not to be fusable, so Module.update can run the eager loop."""
+        m = self._mod
+        opt_ = m._optimizer
+        ndev = len(self._eg.execs)
+        arity = opt_.fused_state_arity()
+        # validate any pre-existing (e.g. preloaded) updater states before
+        # touching counts or consuming the pending feed
+        from . import optimizer as _opt
+        states = m._updater.states
+        for slot, st in states.items():
+            leaves = _opt.fused_state_leaves(st)
+            if leaves is None or len(leaves) != arity:
+                self._unsupported = True
+                self.flush_eager()
+                return False
+        if ndev == 1:
+            self._step_single()
+        else:
+            feeds, self._pending = self._pending, None
+            if feeds is not None:
+                for ex, feed in zip(self._eg.execs, feeds):
+                    ex.forward_backward(**feed)
+            self._update_multi()
+        return True
+
+    def _slots_for_device(self, ex, k, ndev):
+        """Create-missing-state + count + capture per-slot scalars, in the
+        exact order of the eager loop (param-major, device-minor ordering
+        is handled by the caller for ndev > 1)."""
+        out = []
+        for i, name in enumerate(self._mod._param_names):
+            if name in self._pset:
+                out.extend(self._slots_for_device_one(ex, i, k, ndev))
+        return out
+
+    def _gather_update_inputs(self, ex, k, slots):
+        """Pool-guarded param/state buffers + per-slot scalar arrays."""
+        from . import optimizer as _opt
+        m = self._mod
+        pool = self._pools[k]
+        states = m._updater.states
+        pvals, svals = [], []
+        for name, slot, _, _, _ in slots:
+            pvals.append(pool.take(("w", name), ex.arg_dict[name]))
+            leaves = _opt.fused_state_leaves(states[slot])
+            svals.append(tuple(pool.take(("s", slot, j), leaf)
+                               for j, leaf in enumerate(leaves)))
+        lrs = jnp.asarray([s[2] for s in slots], jnp.float32)
+        wds = jnp.asarray([s[3] for s in slots], jnp.float32)
+        ts = jnp.asarray([s[4] for s in slots], jnp.float32)
+        return pvals, svals, lrs, wds, ts
+
+    def _writeback(self, ex, k, slots, new_p, new_s):
+        from . import optimizer as _opt
+        pool = self._pools[k]
+        states = self._mod._updater.states
+        for (name, slot, _, _, _), w, st in zip(slots, new_p, new_s):
+            pool.give(("w", name), ex.arg_dict[name], w)
+            leaves = _opt.fused_state_leaves(states[slot])
+            for j, (leaf, arr) in enumerate(zip(leaves, st)):
+                pool.give(("s", slot, j), leaf, arr)
+
+    def _step_single(self):
+        from . import profiler as _profiler
+        from .ndarray.ndarray import NDArray
+        m = self._mod
+        opt_ = m._optimizer
+        ex = self._eg.execs[0]
+        feeds, self._pending = self._pending, None
+        for kname, v in (feeds[0] if feeds else {}).items():
+            dst = ex.arg_dict[kname]
+            dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
+                else jnp.asarray(v, dst.dtype)
+        slots = self._slots_for_device(ex, 0, 1)
+        pvals, svals, lrs, wds, ts = self._gather_update_inputs(ex, 0, slots)
+        rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
+        others = [ex.arg_dict[n]._data for n in ex.arg_names
+                  if n not in self._pset]
+        auxs = [ex.aux_dict[n]._data for n in ex.aux_names]
+        plan = ex._plan(True)
+        keys = ex._keys(plan)
+        ex._last_keys = keys
+        ogs = ex._default_ograds()
+        update_fns = [opt_.fused_update] * len(slots)
+        first_run = ("step",) + ex._step_env() not in ex._jitted
+        fn = ex.step_program([s[0] for s in slots], update_fns)
+        with _profiler.span("Executor::FusedStep", "executor",
+                            args={"first_run": first_run}):
+            new_p, new_s, outs, new_aux = fn(
+                pvals, svals, others, auxs, keys, ogs, lrs, wds, ts, rescale)
+        self._writeback(ex, 0, slots, new_p, new_s)
+        ex._writeback_aux(new_aux)
+        ex._wrap_outputs(outs)
+
+    def _update_multi(self):
+        from . import profiler as _profiler
+        m = self._mod
+        opt_ = m._optimizer
+        execs = self._eg.execs
+        ndev = len(execs)
+        reduce_grads = m._kvstore is not None
+        # eager count order is param-major, device-minor: interleave the
+        # per-device slot capture accordingly
+        per_dev = [[] for _ in range(ndev)]
+        for i, name in enumerate(m._param_names):
+            if name not in self._pset:
+                continue
+            for k, ex in enumerate(execs):
+                per_dev[k].extend(self._slots_for_device_one(ex, i, k, ndev))
+        for k, ex in enumerate(execs):
+            slots = per_dev[k]
+            pvals, svals, lrs, wds, ts = \
+                self._gather_update_inputs(ex, k, slots)
+            dev = ex._ctx.jax_device
+            gvals = []
+            for name, _, _, _, _ in slots:
+                if reduce_grads:
+                    gvals.append([jax.device_put(e.grad_dict[name]._data, dev)
+                                  for e in execs])
+                else:
+                    gvals.append([ex.grad_dict[name]._data])
+            rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
+            fn = ex.update_program([opt_.fused_update] * len(slots))
+            with _profiler.span("Executor::FusedUpdate", "executor"):
+                new_p, new_s = fn(pvals, svals, gvals, lrs, wds, ts, rescale)
+            self._writeback(ex, k, slots, new_p, new_s)
+
+    def _slots_for_device_one(self, ex, i, k, ndev):
+        """Single-param slot capture (multi-device interleaving order)."""
+        m = self._mod
+        opt_ = m._optimizer
+        states = m._updater.states
+        name = m._param_names[i]
+        slot = opt_.slot_index(i, ndev, k)
+        w = ex.arg_dict[name]
+        if slot not in states:
+            states[slot] = opt_.create_state_multi_precision(slot, w)
+            m._updater.states_synced[slot] = True
+        opt_._update_count(slot)
+        return [(name, slot, opt_._get_lr(slot), opt_._get_wd(slot),
+                 opt_._index_update_count[slot])]
+
+
+class TrainerFusedUpdate:
+    """Fused update phase for gluon.Trainer: one donated program per
+    device replaces the per-param updater loop.  Weights are NOT donated
+    (the autograd tape and user code may hold live references to
+    ``param.data()`` buffers); optimizer state — which never escapes the
+    updater un-copied — is."""
+
+    def __init__(self, trainer):
+        self._tr = trainer
+        self._pools = [DonationPool() for _ in trainer._contexts]
+        self._programs = {}
+        self._unsupported = False
+
+    def eligible(self):
+        if not enabled() or self._unsupported:
+            return False
+        tr = self._tr
+        if tr._update_on_kvstore:
+            return False
+        opt_ = tr._optimizer
+        if opt_.fused_state_arity() is None:
+            return False
+        for p in tr._params:
+            if p.grad_req == "null":
+                continue
+            if getattr(p, "_stype", "default") != "default" or \
+                    getattr(p, "_grad_stype", "default") != "default":
+                return False
+            if not opt_.supports_fused(p.list_data()[0]):
+                return False
+        return True
+
+    def step(self):
+        from . import optimizer as _opt
+        from . import profiler as _profiler
+        tr = self._tr
+        opt_ = tr._optimizer
+        live = [(i, p) for i, p in enumerate(tr._params)
+                if p.grad_req != "null"]
+        if not live:
+            return True
+        arity = opt_.fused_state_arity()
+        ncty = len(tr._contexts)
+        per_dev = [{"p": [], "s": [], "g": [], "lr": [], "wd": [], "t": []}
+                   for _ in range(ncty)]
+        # eager order: param-major, device-minor — each device's updater
+        # shares the optimizer, so the update count really does advance
+        # once per (param, device) visit
+        for i, p in live:
+            datas, grads = p.list_data(), p.list_grad()
+            for k, upd in enumerate(tr._updaters):
+                w = datas[k]
+                if i not in upd.states:
+                    upd.states[i] = \
+                        opt_.create_state_multi_precision(i, w)
+                    upd.states_synced[i] = True
+                leaves = _opt.fused_state_leaves(upd.states[i])
+                if leaves is None or len(leaves) != arity:
+                    self._unsupported = True
+                    return False
+                opt_._update_count(i)
+                d = per_dev[k]
+                d["p"].append(w._data)
+                d["s"].append(tuple(self._pools[k].take((i, j), leaf)
+                                    for j, leaf in enumerate(leaves)))
+                d["g"].append([grads[k]._data])
+                d["lr"].append(opt_._get_lr(i))
+                d["wd"].append(opt_._get_wd(i))
+                d["t"].append(opt_._index_update_count[i])
+        rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
+        env = _env_tuple()
+        fn = self._programs.get(env)
+        if fn is None:
+            from .executor import build_update_program
+            fn = build_update_program([opt_.fused_update] * len(live),
+                                      donate_params=False)
+            self._programs[env] = fn
+        for k in range(ncty):
+            d = per_dev[k]
+            with _profiler.span("Trainer::FusedUpdate", "executor"):
+                new_p, new_s = fn(
+                    d["p"], d["s"], d["g"],
+                    jnp.asarray(d["lr"], jnp.float32),
+                    jnp.asarray(d["wd"], jnp.float32),
+                    jnp.asarray(d["t"], jnp.float32), rescale)
+            pool = self._pools[k]
+            for (i, p), w, st in zip(live, new_p, new_s):
+                p.list_data()[k]._data = w
+                leaves = _opt.fused_state_leaves(tr._updaters[k].states[i])
+                for j, (leaf, arr) in enumerate(zip(leaves, st)):
+                    pool.give((i, j), leaf, arr)
+        return True

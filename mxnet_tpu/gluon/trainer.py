@@ -17,6 +17,7 @@ from ..base import MXNetError
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from .. import telemetry as _telemetry
+from .. import fused_step as _fused
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
@@ -55,6 +56,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = update_on_kvstore
         self._kvstore_name = kvstore
+        self._fused_update = None
 
     def _check_contexts(self):
         contexts = None
@@ -157,11 +159,15 @@ class Trainer:
             return
         tel = _telemetry.enabled
         t0 = time.perf_counter() if tel else 0.0
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+        # batched push/pull over every live param: one call lets the
+        # dist_async wire layer coalesce per-key traffic into buckets
+        live = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if live:
+            grads = [self._params[i].list_grad() for i in live]
+            self._kvstore.push(live, grads)
+            if not self._update_on_kvstore:
+                self._kvstore.pull(live, out=grads)
         if tel:
             _SYNC_LAT.observe(time.perf_counter() - t0)
 
@@ -178,6 +184,17 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        tel = _telemetry.enabled
+        t0 = time.perf_counter() if tel else 0.0
+        if not self._update_on_kvstore:
+            if self._fused_update is None:
+                self._fused_update = _fused.TrainerFusedUpdate(self)
+            fu = self._fused_update
+            if fu.eligible() and fu.step():
+                if tel:
+                    _fused.STEP_DISPATCH.labels(path="fused").inc()
+                    _fused.STEP_TIME.observe(time.perf_counter() - t0)
+                return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -187,6 +204,9 @@ class Trainer:
             for upd, arr, grad in zip(
                     self._updaters, param.list_data(), param.list_grad()):
                 upd(i, grad, arr)
+        if tel:
+            _fused.STEP_DISPATCH.labels(path="eager").inc()
+            _fused.STEP_TIME.observe(time.perf_counter() - t0)
 
     def save_states(self, fname):
         """Save optimizer (updater) states to a file."""

@@ -59,6 +59,62 @@ def _key(k):
     return str(k)
 
 
+# ---- gradient bucketing ---------------------------------------------------
+# dist_async coalesces dense uncompressed push/pull traffic into flat
+# dtype-segregated buckets: O(num_params) wire messages become
+# O(total_bytes / bucket_bytes).  Per-key frames are untouched — a
+# singleton bucket goes out as a plain "push"/"pull".
+
+BUCKET_BYTES_ENV = "MXNET_KVSTORE_BUCKET_BYTES"
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+def bucket_bytes():
+    """Bucket byte budget; <= 0 disables bucketing."""
+    raw = os.environ.get(BUCKET_BYTES_ENV)
+    if raw is None or not raw.strip():
+        return DEFAULT_BUCKET_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        return DEFAULT_BUCKET_BYTES
+
+
+def pack_buckets(entries, budget, nbytes=None, group=None):
+    """Greedily pack ``(key, payload)`` entries into buckets of at most
+    ``budget`` payload bytes, segregated by ``group(payload)`` (dtype: a
+    flat bucket is one contiguous array, so mixed dtypes can't share one).
+    Order is preserved within a group; an oversized single payload gets a
+    bucket of its own.  ``budget <= 0`` (or < 2 entries) disables packing.
+    """
+    if nbytes is None:
+        nbytes = lambda a: a.nbytes
+    if group is None:
+        group = lambda a: np.dtype(a.dtype).str
+    if budget <= 0 or len(entries) < 2:
+        return [[e] for e in entries]
+    groups, order = {}, []
+    for e in entries:
+        gk = group(e[1])
+        if gk not in groups:
+            groups[gk] = []
+            order.append(gk)
+        groups[gk].append(e)
+    buckets = []
+    for gk in order:
+        cur, cur_b = [], 0
+        for e in groups[gk]:
+            b = nbytes(e[1])
+            if cur and cur_b + b > budget:
+                buckets.append(cur)
+                cur, cur_b = [], 0
+            cur.append(e)
+            cur_b += b
+        if cur:
+            buckets.append(cur)
+    return buckets
+
+
 class KVStore:
     """Single-process store: local/device/nccl (all XLA-reduced on TPU)."""
 
@@ -413,6 +469,7 @@ class DistAsyncKVStore(KVStore):
         tel = _telemetry.enabled
         t0 = _time.perf_counter() if tel else 0.0
         keys, values = self._normalize(key, value)
+        dense = []
         for k, v in zip(keys, values):
             agg = _local_sum(v)
             if isinstance(agg, RowSparseNDArray):
@@ -441,11 +498,27 @@ class DistAsyncKVStore(KVStore):
                 self._rpc("push_2bit", k, words,
                           self._compression.threshold)
                 continue
-            arr = agg.asnumpy()
+            arr = np.ascontiguousarray(agg.asnumpy())
             if tel:
                 _KV_BYTES_TX.labels(key=k).inc(arr.nbytes)
-            self._rpc("push", k, arr)
+            dense.append((k, arr))
+        bucketed = False
+        for bucket in pack_buckets(dense, bucket_bytes()):
+            if len(bucket) == 1:
+                # singleton: unchanged per-key wire format
+                self._rpc("push", bucket[0][0], bucket[0][1])
+                continue
+            bucketed = True
+            bkeys = [k for k, _ in bucket]
+            shapes = [list(a.shape) for _, a in bucket]
+            flat = np.concatenate([a.ravel() for _, a in bucket])
+            self._rpc("push_bucket", bkeys, shapes, flat)
         if tel:
+            if dense:
+                from .fused_step import STEP_DISPATCH
+                STEP_DISPATCH.labels(
+                    path="kvstore_bucketed" if bucketed
+                    else "kvstore_perkey").inc()
             _KV_PUSH.labels(type=self.kind).inc(len(keys))
             _KV_PUSH_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)
@@ -453,17 +526,56 @@ class DistAsyncKVStore(KVStore):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         tel = _telemetry.enabled
         t0 = _time.perf_counter() if tel else 0.0
+        from .ndarray.ndarray import array as _array
         keys, outs = self._normalize(key, out)
+        # payload = (dsts, shape, dtype): the wire request carries shape +
+        # dtype of the first destination; remaining dsts recast locally
+        entries = []
         for k, dst in zip(keys, outs):
-            arr = self._rpc("pull", k)
-            if tel:
-                _KV_BYTES_RX.labels(key=k).inc(
-                    getattr(arr, "nbytes", 0))
-            dsts = dst if isinstance(dst, (list, tuple)) else [dst]
-            for d in dsts:
-                from .ndarray.ndarray import array as _array
-                _array(arr, ctx=d.context, dtype=d.dtype).copyto(d)
+            dsts = list(dst) if isinstance(dst, (list, tuple)) else [dst]
+            d0 = dsts[0]
+            entries.append((k, (dsts, list(d0.shape), np.dtype(d0.dtype))))
+        bucketed = False
+        for bucket in pack_buckets(
+                entries, bucket_bytes(),
+                nbytes=lambda p: int(np.prod(p[1], dtype=np.int64))
+                * p[2].itemsize,
+                group=lambda p: p[2].str):
+            if len(bucket) == 1:
+                k, (dsts, _, _) = bucket[0]
+                arr = self._rpc("pull", k)
+                if tel:
+                    _KV_BYTES_RX.labels(key=k).inc(
+                        getattr(arr, "nbytes", 0))
+                for d in dsts:
+                    _array(arr, ctx=d.context, dtype=d.dtype).copyto(d)
+                continue
+            bucketed = True
+            bkeys = [k for k, _ in bucket]
+            shapes = [p[1] for _, p in bucket]
+            dt = bucket[0][1][2]
+            flat = np.asarray(self._rpc("pull_bucket", bkeys, shapes, dt.str))
+            total = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+            if flat.ndim != 1 or flat.size != total:
+                # malformed reply: count it as a frame error and refuse
+                self._ps._frame_error(
+                    "pull_bucket reply has %s values, expected %d"
+                    % (getattr(flat, "size", None), total))
+            off = 0
+            for k, (dsts, shape, _) in bucket:
+                n = int(np.prod(shape, dtype=np.int64))
+                seg = flat[off:off + n].reshape(shape)
+                off += n
+                if tel:
+                    _KV_BYTES_RX.labels(key=k).inc(seg.nbytes)
+                for d in dsts:
+                    _array(seg, ctx=d.context, dtype=d.dtype).copyto(d)
         if tel:
+            if keys:
+                from .fused_step import STEP_DISPATCH
+                STEP_DISPATCH.labels(
+                    path="kvstore_bucketed" if bucketed
+                    else "kvstore_perkey").inc()
             _KV_PULL.labels(type=self.kind).inc(len(keys))
             _KV_PULL_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)

@@ -155,6 +155,66 @@ def _frame_error(why):
     raise MXNetError("kvstore wire: %s" % why)
 
 
+# ---- bucketed frames (push_bucket / pull_bucket) --------------------------
+# a bucket coalesces many keys' dense traffic into ONE flat dtype-uniform
+# blob; its metadata is attacker-controlled like any frame, so it gets the
+# same reject-loudly treatment plus a payload cap (a single bucket frame
+# must not be able to ask the server for unbounded memory)
+MAX_BUCKET_BYTES_ENV = "MXNET_KVSTORE_MAX_BUCKET_BYTES"
+DEFAULT_MAX_BUCKET_BYTES = 256 << 20
+MAX_BUCKET_KEYS = 4096
+
+
+def _max_bucket_bytes():
+    try:
+        return int(os.environ.get(MAX_BUCKET_BYTES_ENV,
+                                  DEFAULT_MAX_BUCKET_BYTES))
+    except ValueError:
+        return DEFAULT_MAX_BUCKET_BYTES
+
+
+def _check_bucket_meta(keys, shapes):
+    if not isinstance(keys, (list, tuple)) or not keys or \
+            len(keys) > MAX_BUCKET_KEYS or \
+            not all(isinstance(k, str) for k in keys):
+        _frame_error("bucket keys must be 1..%d strings" % MAX_BUCKET_KEYS)
+    if not isinstance(shapes, (list, tuple)) or len(shapes) != len(keys):
+        _frame_error("bucket has %s shapes for %d keys"
+                     % (len(shapes) if isinstance(shapes, (list, tuple))
+                        else "non-list", len(keys)))
+    for s in shapes:
+        if not isinstance(s, (list, tuple)) or \
+                not all(isinstance(d, int) and d >= 0 for d in s):
+            _frame_error("bucket shape %r malformed" % (s,))
+
+
+def _split_bucket(keys, shapes, flat):
+    """Validate a push_bucket frame and split the flat payload back into
+    per-key views (read-only — callers must copy before storing)."""
+    _check_bucket_meta(keys, shapes)
+    if not isinstance(flat, np.ndarray) or flat.ndim != 1:
+        _frame_error("bucket payload must be one flat array")
+    cap = _max_bucket_bytes()
+    if flat.nbytes > cap:
+        _frame_error("bucket of %d bytes exceeds %s=%d"
+                     % (flat.nbytes, MAX_BUCKET_BYTES_ENV, cap))
+    counts, total = [], 0
+    for s in shapes:
+        n = 1
+        for d in s:
+            n *= int(d)
+        counts.append(n)
+        total += n
+    if total != flat.size:
+        _frame_error("bucket payload has %d values, shapes need %d"
+                     % (flat.size, total))
+    segs, off = [], 0
+    for k, s, n in zip(keys, shapes, counts):
+        segs.append((k, flat[off:off + n].reshape([int(d) for d in s])))
+        off += n
+    return segs
+
+
 # trace-context bounds: ids are "<pid-hex>.<seq-hex>" strings, far under
 # this cap — anything larger/unknown is a malformed frame, not data
 _TC_KEYS = frozenset(("t", "s"))
@@ -359,6 +419,49 @@ class KVStoreServer:
                     if key not in self._store:
                         raise MXNetError("pull before init: %r" % key)
                     return ("ok", self._store[key].copy())
+            if cmd == "push_bucket":
+                # coalesced dense push: several keys' gradients travel as
+                # ONE flat dtype-uniform blob (O(params) -> O(buckets)
+                # messages); semantics per key identical to "push"
+                _, keys, shapes, flat = msg
+                segs = _split_bucket(keys, shapes, np.asarray(flat))
+                for key, seg in segs:
+                    with self._lock_for(key):
+                        if key not in self._store:
+                            raise MXNetError("push before init: %r" % key)
+                        if self._updater is None:
+                            self._store[key] = np.array(seg, copy=True)
+                        else:
+                            self._apply(key, np.asarray(seg))
+                with self._meta_lock:
+                    self.push_count += len(segs)
+                return ("ok",)
+            if cmd == "pull_bucket":
+                # coalesced dense pull: reply is ONE flat array in the
+                # requested dtype, keys' values back-to-back in key order
+                _, keys, shapes, dtstr = msg
+                _check_bucket_meta(keys, shapes)
+                dt = np.dtype(str(dtstr))
+                budget = 0
+                parts = []
+                for key, shape in zip(keys, shapes):
+                    with self._lock_for(key):
+                        if key not in self._store:
+                            raise MXNetError("pull before init: %r" % key)
+                        w = self._store[key]
+                        if list(w.shape) != [int(d) for d in shape]:
+                            _frame_error(
+                                "pull_bucket shape %r does not match "
+                                "stored %r for key %r"
+                                % (list(shape), list(w.shape), key))
+                        part = np.ascontiguousarray(w, dtype=dt).ravel()
+                    budget += part.nbytes
+                    if budget > _max_bucket_bytes():
+                        _frame_error(
+                            "pull_bucket reply exceeds %s=%d"
+                            % (MAX_BUCKET_BYTES_ENV, _max_bucket_bytes()))
+                    parts.append(part)
+                return ("ok", np.concatenate(parts))
             if cmd == "push_rsp":
                 # row-sparse push: only touched (ids, rows) cross the wire
                 # (reference kvstore_dist.h:228-291 RowSparse push)

@@ -8,6 +8,7 @@ optimizer either locally or on the kvstore (``update_on_kvstore``).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -16,6 +17,8 @@ from ..base import MXNetError
 from .. import ndarray as nd
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from .. import fused_step as _fused
+from .. import telemetry as _telemetry
 from ..context import Context, cpu, current_context
 from ..initializer import InitDesc
 from .base_module import BaseModule
@@ -55,6 +58,7 @@ class Module(BaseModule):
         self._update_on_kvstore = False
         self._grad_req = "write"
         self._group2ctxs = group2ctxs
+        self._fused_step = None
 
     # ---- info -----------------------------------------------------------
     @property
@@ -163,13 +167,10 @@ class Module(BaseModule):
             # reference default (module.py init_optimizer): grads are
             # batch-summed, so rescale by 1/batch unless caller overrides
             params.setdefault("rescale_grad", 1.0 / batch_size)
-            # one updater-state slot per (param, device) — reference keys
-            # the updater by i*num_device+k and maps all of them to the name
-            ndev = len(self._context)
-            idx2name = {}
-            for i, n in enumerate(self._param_names):
-                for k in range(ndev):
-                    idx2name[i * ndev + k] = n
+            # one updater-state slot per (param, device); the shared
+            # resolver keeps this layout in lockstep with the update paths
+            idx2name = opt.Optimizer.build_idx2name(
+                self._param_names, len(self._context))
             optimizer = opt.create(optimizer, sym=self._symbol,
                                    param_idx2name=idx2name, **params)
         self._optimizer = optimizer
@@ -200,52 +201,112 @@ class Module(BaseModule):
             with open(preload, "rb") as f:
                 self._updater.set_states(f.read())
             self._preload_opt_states = None
+        self._fused_step = _fused.ModuleFusedStep(self) \
+            if self._updater is not None else None
 
     # ---- step -----------------------------------------------------------
+    def _fused(self):
+        """Fused-step driver, recreated after a force_rebind (the driver's
+        donation pools and cached programs belong to one executor group)."""
+        fs = self._fused_step
+        if fs is not None and fs.stale():
+            fs = self._fused_step = _fused.ModuleFusedStep(self)
+        return fs
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        fs = self._fused()
+        if fs is not None:
+            fs.flush_eager()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        fs = self._fused()
+        if fs is not None:
+            fs.flush_eager()
         self._exec_group.backward(out_grads)
 
     def forward_backward(self, data_batch):
+        fs = self._fused()
+        if fs is not None and fs.eligible():
+            # defer: update() fuses this batch's fwd+bwd with the
+            # optimizer update into one donated XLA program
+            fs.flush_eager()
+            fs.stage(data_batch)
+            return
+        if fs is not None:
+            fs.flush_eager()
         self._exec_group.forward_backward(data_batch)
 
     def update(self):
-        """KVStore reduce + optimizer (ref module.py:643-670 + SURVEY 3.1)."""
+        """KVStore reduce + optimizer (ref module.py:643-670 + SURVEY 3.1).
+
+        With MXNET_TPU_FUSED_STEP (default ON) and a local updater this
+        dispatches the fused whole-step program staged by
+        forward_backward; the per-param loop below is the OFF fallback and
+        parity oracle.  Note the fused path does not materialize gradients
+        in grad_dict (they live only inside the program)."""
         assert self.optimizer_initialized
+        tel = _telemetry.enabled
+        t0 = time.perf_counter() if tel else 0.0
+        fs = self._fused()
+        if fs is not None and fs.pending and fs.eligible() and fs.step():
+            if tel:
+                _fused.STEP_DISPATCH.labels(path="fused").inc()
+                _fused.STEP_TIME.observe(time.perf_counter() - t0)
+            return
+        if fs is not None:
+            fs.flush_eager()
         eg = self._exec_group
         ndev = len(self._context)
         if self._kvstore is not None:
-            for i, (name, grads, weights) in enumerate(
-                    zip(self._param_names, eg.grad_arrays, eg.param_arrays)):
-                if not grads:
-                    continue
-                self._kvstore.push(name, grads)
+            # batched push/pull: one call over all param names lets the
+            # dist_async wire layer coalesce messages into buckets
+            live = [i for i, g in enumerate(eg.grad_arrays) if g]
+            names = [self._param_names[i] for i in live]
+            grads_l = [eg.grad_arrays[i] for i in live]
+            weights_l = [eg.param_arrays[i] for i in live]
+            if names:
+                self._kvstore.push(names, grads_l)
                 if self._update_on_kvstore:
-                    self._kvstore.pull(name, out=weights)
+                    self._kvstore.pull(names, out=weights_l)
                 else:
                     # pull the reduced gradient back into each device grad
-                    self._kvstore.pull(name, out=grads)
+                    self._kvstore.pull(names, out=grads_l)
+            if not self._update_on_kvstore:
+                for i, grads, weights in zip(live, grads_l, weights_l):
                     for k, (w, g) in enumerate(zip(weights, grads)):
-                        # per-device optimizer state, index resolvable
-                        # through idx2name (reference: i*num_device+k)
-                        self._updater(i * ndev + k, g, w)
+                        # per-device optimizer state, slot resolvable
+                        # through idx2name (shared resolver)
+                        self._updater(
+                            opt.Optimizer.slot_index(i, ndev, k), g, w)
         else:
             for i, (name, grads, weights) in enumerate(
                     zip(self._param_names, eg.grad_arrays, eg.param_arrays)):
                 for k, (w, g) in enumerate(zip(weights, grads)):
-                    self._updater(i * ndev + k, g, w)
+                    self._updater(
+                        opt.Optimizer.slot_index(i, ndev, k), g, w)
+        if tel:
+            _fused.STEP_DISPATCH.labels(path="eager").inc()
+            _fused.STEP_TIME.observe(time.perf_counter() - t0)
 
     def get_outputs(self, merge_multi_context=True):
+        fs = self._fused()
+        if fs is not None:
+            fs.flush_eager()
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
+        fs = self._fused()
+        if fs is not None:
+            fs.flush_eager()
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        fs = self._fused()
+        if fs is not None:
+            fs.flush_eager()
         self._exec_group.update_metric(eval_metric, labels)
 
     def get_params(self):

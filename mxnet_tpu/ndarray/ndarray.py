@@ -138,10 +138,20 @@ class NDArray:
         return NDArray(jnp.array(self._data), self._ctx)
 
     def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        # device_put/astype return self._data UNCHANGED when device and
+        # dtype already match — a genuine copy is required here, or the
+        # "copy" aliases a buffer the fused step may later donate (and
+        # XLA deletes donated buffers)
         if isinstance(other, Context):
-            return NDArray(jax.device_put(self._data, other.jax_device), other)
-        other._data = jax.device_put(self._data, other._ctx.jax_device) \
+            data = jax.device_put(self._data, other.jax_device)
+            if data is self._data:
+                data = jnp.array(data)
+            return NDArray(data, other)
+        data = jax.device_put(self._data, other._ctx.jax_device) \
             .astype(other._data.dtype)
+        if data is self._data:
+            data = jnp.array(data)
+        other._data = data
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

@@ -130,6 +130,62 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    # ---- (param, device) slot resolution --------------------------------
+    # The eager updater keys its state (and therefore lr_mult/wd_mult
+    # lookups through ``idx2name``) by a flattened (param, device) slot.
+    # Both the eager call sites and the fused step must agree on this
+    # layout or per-name multipliers silently stop applying on replicas.
+
+    @staticmethod
+    def slot_index(param_idx, num_device=1, device=0):
+        """Flattened updater-state slot for param ``param_idx`` on device
+        ``device`` when weights are replicated over ``num_device`` devices."""
+        return param_idx * num_device + device
+
+    @staticmethod
+    def build_idx2name(param_names, num_device=1):
+        """``idx2name`` covering every (param, device) slot, so
+        ``_get_lr``/``_get_wd`` resolve the same name for all replicas."""
+        idx2name = {}
+        for i, name in enumerate(param_names):
+            for k in range(num_device):
+                idx2name[Optimizer.slot_index(i, num_device, k)] = name
+        return idx2name
+
+    # ---- functional (traceable) core for the fused train step -----------
+    # ``fused_update`` is the jit-traceable twin of ``update``: pure jax
+    # arrays in, (new_weight, new_state_leaves) out, no NDArray wrappers,
+    # no count/lr bookkeeping (the driver resolves lr/wd/t per slot and
+    # passes them in, traced, so one compiled program serves every step).
+
+    def supports_fused(self, weight):
+        """Whether ``update`` has a traceable twin for this weight."""
+        return False
+
+    def fused_state_arity(self):
+        """Number of state leaves ``fused_update`` expects/returns."""
+        return None
+
+    def fused_update(self, weight, grad, state, lr, wd, rescale, t):
+        """Pure update: ``(w, g, state_leaves, lr, wd, rescale, t)`` ->
+        ``(new_w, new_state_leaves)``.  All array args are jax values."""
+        raise MXNetError("%s has no fused update" % type(self).__name__)
+
+    def _fused_dtype_ok(self, weight):
+        # fused restricts to fp32 weights: multi-precision carries a
+        # master-fp32 copy in the state tuple with per-optimizer layout,
+        # and traced f32 scalars (lr/wd/t) would promote fp16 arithmetic
+        # to f32 where eager weak python floats keep it in fp16 — both
+        # stay on the eager oracle
+        return weight.dtype == np.float32
+
+    def _fused_attrs(self, lr, wd, rescale):
+        # clip_gradient must stay a static python float: _prep_grad branches
+        # on ``>= 0`` at trace time (-1.0 is the kernels' "disabled" value)
+        return {"lr": lr, "wd": wd, "rescale_grad": rescale,
+                "clip_gradient": -1.0 if self.clip_gradient is None
+                else float(self.clip_gradient)}
+
     def _update_rows(self, index, weight, grad, state):
         """Lazy update for a row_sparse gradient (reference: the sparse
         FComputeEx optimizer kernels, src/operator/optimizer_op.cc — only
@@ -222,6 +278,21 @@ class SGD(Optimizer):
 
     update_multi_precision = update
 
+    def supports_fused(self, weight):
+        return self._fused_dtype_ok(weight)
+
+    def fused_state_arity(self):
+        return 1 if self.momentum != 0.0 else 0
+
+    def fused_update(self, weight, grad, state, lr, wd, rescale, t):
+        from .ops import optimizer_ops as _ops
+        attrs = self._fused_attrs(lr, wd, rescale)
+        if state:
+            attrs["momentum"] = self.momentum
+            w, m = _ops._sgd_mom_update(attrs, weight, grad, state[0])
+            return w, (m,)
+        return _ops._sgd_update(attrs, weight, grad), ()
+
 
 @register
 class Signum(Optimizer):
@@ -265,6 +336,21 @@ class NAG(Optimizer):
         else:
             nd.sgd_update(weight, grad, out=weight, **kw)
 
+    def supports_fused(self, weight):
+        return self._fused_dtype_ok(weight)
+
+    def fused_state_arity(self):
+        return 1 if self.momentum != 0.0 else 0
+
+    def fused_update(self, weight, grad, state, lr, wd, rescale, t):
+        from .ops import optimizer_ops as _ops
+        attrs = self._fused_attrs(lr, wd, rescale)
+        if state:
+            attrs["momentum"] = self.momentum
+            w, m = _ops._nag_mom_update(attrs, weight, grad, state[0])
+            return w, (m,)
+        return _ops._sgd_update(attrs, weight, grad), ()
+
 
 @register
 class Adam(Optimizer):
@@ -292,6 +378,25 @@ class Adam(Optimizer):
         nd.adam_update(weight, grad, mean, var, out=weight,
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, **kw)
+
+    def supports_fused(self, weight):
+        return self._fused_dtype_ok(weight)
+
+    def fused_state_arity(self):
+        return 2
+
+    def fused_update(self, weight, grad, state, lr, wd, rescale, t):
+        import jax.numpy as jnp
+        from .ops import optimizer_ops as _ops
+        attrs = self._fused_attrs(lr, wd, rescale)
+        attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        # bias correction folded into lr as in eager update; t is traced so
+        # the same program serves every step
+        attrs["lr"] = lr * jnp.sqrt(1.0 - jnp.power(self.beta2, t)) \
+            / (1.0 - jnp.power(self.beta1, t))
+        mean, var = state
+        w, m, v = _ops._adam_update(attrs, weight, grad, mean, var)
+        return w, (m, v)
 
 
 @register
@@ -347,6 +452,28 @@ class RMSProp(Optimizer):
             (n,) = state
             nd.rmsprop_update(weight, grad, n, out=weight, gamma1=self.gamma1,
                               epsilon=self.epsilon, **kw)
+
+    def supports_fused(self, weight):
+        return self._fused_dtype_ok(weight)
+
+    def fused_state_arity(self):
+        return 3 if self.centered else 1
+
+    def fused_update(self, weight, grad, state, lr, wd, rescale, t):
+        from .ops import optimizer_ops as _ops
+        attrs = self._fused_attrs(lr, wd, rescale)
+        attrs.update(gamma1=self.gamma1, epsilon=self.epsilon,
+                     clip_weights=-1.0 if not self.clip_weights
+                     else float(self.clip_weights))
+        if self.centered:
+            attrs["gamma2"] = self.gamma2
+            n, g, delta = state
+            w, nn, ng, ndelta = _ops._rmspropalex_update(
+                attrs, weight, grad, n, g, delta)
+            return w, (nn, ng, ndelta)
+        (n,) = state
+        w, nn = _ops._rmsprop_update(attrs, weight, grad, n)
+        return w, (nn,)
 
 
 @register
@@ -548,6 +675,25 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
         state[:] = weight
+
+
+def fused_state_leaves(state):
+    """Flatten an updater state into a tuple of NDArray leaves for the
+    fused step (``None`` -> ``()``); returns ``None`` when the structure
+    isn't fusable (non-NDArray leaves, e.g. nested multi-precision
+    holders), signalling fallback to the eager oracle."""
+    if state is None:
+        return ()
+    if isinstance(state, NDArray):
+        return (state,)
+    if isinstance(state, (tuple, list)):
+        leaves = []
+        for s in state:
+            if not isinstance(s, NDArray):
+                return None
+            leaves.append(s)
+        return tuple(leaves)
+    return None
 
 
 def create(name, **kwargs):

@@ -1,0 +1,77 @@
+"""dist_async bucketed-push worker: 1 server + 2 workers with a tiny
+MXNET_KVSTORE_BUCKET_BYTES so multi-key traffic actually buckets.
+
+Launched by tests/test_dist_async_kvstore.py via tools/launch.py -s 1.
+Server runs SGD (its per-push updates commute: the final weight is
+w0 - lr * sum of every worker's pushed grads, order-independent), so the
+bucketed result has an analytic expectation AND must agree bit-exactly
+with a per-key pull of the same server state.  Exits nonzero on failure.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+SHAPES = [(64,), (128,), (32, 4), (9,), (10, 10)]
+LR = 0.125          # power of two: SGD arithmetic is exact in f32
+STEPS = 5
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+    assert int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES", "0")) > 0, \
+        "launcher must set a small bucket size for this test"
+
+    keys = list(range(len(SHAPES)))
+    inits = [np.full(s, 1.0, np.float32) for s in SHAPES]
+    for k, w0 in zip(keys, inits):
+        kv.init(k, nd.array(w0))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=LR))
+    kv.barrier()                       # both workers see the optimizer
+
+    # deterministic rank-dependent grads; each step's batched push rides
+    # the bucketed wire (tiny bucket budget -> multi-key frames)
+    grads = [np.full(s, 0.5 * (rank + 1), np.float32) for s in SHAPES]
+    for _ in range(STEPS):
+        kv.push(keys, [nd.array(g) for g in grads])
+    kv.barrier()                       # every push applied server-side
+
+    # bucketed pull vs per-key pull of the SAME server state: bit-exact
+    outs = [nd.zeros(s) for s in SHAPES]
+    kv.pull(keys, out=outs)
+    os.environ["MXNET_KVSTORE_BUCKET_BYTES"] = "0"
+    perkey = [nd.zeros(s) for s in SHAPES]
+    kv.pull(keys, out=perkey)
+    for k, o, p in zip(keys, outs, perkey):
+        if not (o.asnumpy() == p.asnumpy()).all():
+            raise AssertionError("bucketed pull != per-key pull: %r" % k)
+
+    # analytic: w = 1 - lr * steps * (0.5 + 1.0) from the two workers
+    expect = 1.0 - LR * STEPS * (0.5 + 1.0)
+    for k, o, s in zip(keys, outs, SHAPES):
+        want = np.full(s, expect, np.float32)
+        if not (o.asnumpy() == want).all():
+            raise AssertionError(
+                "key %r: got %r want %r" % (k, o.asnumpy().ravel()[:3],
+                                            expect))
+    print("rank %d bucketed async ok (w=%.4f)" % (rank, expect))
+
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()

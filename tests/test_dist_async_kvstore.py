@@ -184,6 +184,160 @@ class TestInProcess:
         kv.close()
 
 
+class TestBucketing:
+    """Gradient bucketing: dense multi-key push/pull coalesces into flat
+    dtype-segregated buckets (O(params) -> O(buckets) wire messages) and
+    must stay BIT-exact with the per-key frames it replaces."""
+
+    SHAPES = [(100,), (200,), (300, 3), (5,), (7, 7)]
+
+    def _init_keys(self, kv):
+        vals = [nd.array(np.random.RandomState(i).randn(*s)
+                         .astype(np.float32))
+                for i, s in enumerate(self.SHAPES)]
+        keys = list(range(len(self.SHAPES)))
+        for k, v in zip(keys, vals):
+            kv.init(k, v)
+        return keys, vals
+
+    def _spy(self, ps):
+        sent = []
+        orig = ps.send_msg
+
+        def spy(sock, obj, **kw):
+            sent.append(obj)
+            return orig(sock, obj, **kw)
+
+        return sent, spy, orig
+
+    def test_bitexact_vs_perkey_and_message_count(self, server,
+                                                  monkeypatch):
+        from mxnet_tpu import kvstore_server as ps
+        monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "4096")
+        kv = mx.kv.create("dist_async")
+        keys, vals = self._init_keys(kv)
+        sent, spy, orig = self._spy(ps)
+        ps.send_msg = spy
+        try:
+            kv.push(keys, [[v] for v in vals])
+            n_push = len([m for m in sent if m[0] == "push_bucket"])
+            assert n_push >= 1 and n_push < len(keys)
+            assert not [m for m in sent if m[0] == "push"]
+            sent.clear()
+            outs = [nd.zeros(s) for s in self.SHAPES]
+            kv.pull(keys, out=outs)
+            n_pull = len([m for m in sent if m[0] == "pull_bucket"])
+            assert n_pull >= 1 and n_pull < len(keys)
+        finally:
+            ps.send_msg = orig
+        # per-key pull (bucketing disabled) must agree BIT-exactly
+        monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "0")
+        perkey = [nd.zeros(s) for s in self.SHAPES]
+        kv.pull(keys, out=perkey)
+        for v, o, o2 in zip(vals, outs, perkey):
+            np.testing.assert_array_equal(o.asnumpy(), o2.asnumpy())
+            np.testing.assert_array_equal(o.asnumpy(), v.asnumpy())
+        kv.close()
+
+    def test_singleton_stays_plain_push(self, server, monkeypatch):
+        from mxnet_tpu import kvstore_server as ps
+        monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "4096")
+        kv = mx.kv.create("dist_async")
+        kv.init("solo", nd.ones((4,)))
+        sent, spy, orig = self._spy(ps)
+        ps.send_msg = spy
+        try:
+            kv.push("solo", nd.ones((4,)) * 3)
+            out = nd.zeros((4,))
+            kv.pull("solo", out=out)
+        finally:
+            ps.send_msg = orig
+        # a single key keeps the unchanged per-key wire format
+        assert [m[0] for m in sent if m[0].startswith("push")] == ["push"]
+        assert [m[0] for m in sent if m[0].startswith("pull")] == ["pull"]
+        np.testing.assert_array_equal(out.asnumpy(), 3.0)
+        kv.close()
+
+    def test_pack_buckets_dtype_segregation(self):
+        from mxnet_tpu.kvstore import pack_buckets
+        entries = [("a", np.zeros(10, np.float32)),
+                   ("b", np.zeros(10, np.float64)),
+                   ("c", np.zeros(10, np.float32)),
+                   ("d", np.zeros(10, np.float64))]
+        buckets = pack_buckets(entries, 1 << 20)
+        assert len(buckets) == 2
+        for b in buckets:
+            assert len({a.dtype.str for _, a in b}) == 1
+        # order preserved within each dtype group
+        assert [k for k, _ in buckets[0]] == ["a", "c"]
+        assert [k for k, _ in buckets[1]] == ["b", "d"]
+        # budget <= 0 disables: all singletons
+        assert all(len(b) == 1 for b in pack_buckets(entries, 0))
+
+    def test_malformed_bucket_frame_rejected(self, server):
+        from mxnet_tpu import telemetry
+        kv = mx.kv.create("dist_async")
+        kv.init("a", nd.ones((4,)))
+        e0 = telemetry.value("kvstore_frame_errors_total")
+        # declared shapes need 999 values, payload has 4
+        with pytest.raises(mx.MXNetError, match="shapes need"):
+            kv._rpc("push_bucket", ["a"], [[999]],
+                    np.zeros(4, np.float32))
+        # frame errors count unconditionally (server thread is in-process)
+        assert telemetry.value("kvstore_frame_errors_total") > e0
+        # and the connection survives the rejected frame
+        out = nd.zeros((4,))
+        kv.pull("a", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), 1.0)
+        kv.close()
+
+    def test_oversized_bucket_rejected(self, server, monkeypatch):
+        from mxnet_tpu import telemetry
+        kv = mx.kv.create("dist_async")
+        kv.init("a", nd.ones((100,)))
+        kv.init("b", nd.ones((100,)))
+        monkeypatch.setenv("MXNET_KVSTORE_MAX_BUCKET_BYTES", "64")
+        e0 = telemetry.value("kvstore_frame_errors_total")
+        with pytest.raises(mx.MXNetError, match="exceeds"):
+            kv._rpc("push_bucket", ["a", "b"], [[100], [100]],
+                    np.zeros(200, np.float32))
+        assert telemetry.value("kvstore_frame_errors_total") > e0
+        kv.close()
+
+    def test_resnet50_param_set_message_count(self):
+        """Acceptance: on ResNet-50's param set the bucketed push sends
+        ~ceil(total_grad_bytes / bucket_bytes) messages instead of one
+        per param."""
+        from mxnet_tpu.kvstore import pack_buckets
+        shapes = [(64, 3, 7, 7), (64,), (64,)]        # conv1 + bn1
+        cin = 64
+        for units, cout in zip([3, 4, 6, 3], [256, 512, 1024, 2048]):
+            mid = cout // 4
+            for u in range(units):
+                for s in [(mid, cin, 1, 1), (mid,), (mid,),
+                          (mid, mid, 3, 3), (mid,), (mid,),
+                          (cout, mid, 1, 1), (cout,), (cout,)]:
+                    shapes.append(s)
+                if u == 0:             # projection shortcut
+                    shapes += [(cout, cin, 1, 1), (cout,), (cout,)]
+                cin = cout
+        shapes += [(1000, 2048), (1000,)]              # fc
+        total = sum(int(np.prod(s)) for s in shapes)
+        assert 23e6 < total < 28e6     # it IS resnet50-sized
+        entries = [("p%d" % i, s) for i, s in enumerate(shapes)]
+        budget = 4 << 20
+        buckets = pack_buckets(
+            entries, budget,
+            nbytes=lambda s: int(np.prod(s)) * 4,
+            group=lambda s: "<f4")
+        floor = -(-total * 4 // budget)                # ceil
+        # greedy never splits a tensor, so every >4MB conv/fc weight is a
+        # bucket of its own and boundaries waste some budget: allow 1.5x
+        # the information-theoretic floor, still ~5x fewer than per-key
+        assert floor <= len(buckets) <= (floor * 3 + 1) // 2
+        assert len(buckets) * 4 < len(shapes)          # >> fewer messages
+
+
 def test_two_workers_async_convergence():
     """1 server + 2 workers forked via the launcher; async SGD converges
     (end-to-end: role dispatch, retry-connect, server optimizer, stop)."""
@@ -196,5 +350,23 @@ def test_two_workers_async_convergence():
     rc = launch.launch_local(
         2, [sys.executable, os.path.join(REPO, "tests",
                                          "dist_async_worker.py")],
+        env_extra=env, num_servers=1)
+    assert rc == 0
+
+
+def test_two_workers_bucketed_push_pull():
+    """1 server + 2 workers with a tiny bucket budget: bucketed push/pull
+    is bit-exact vs per-key against the live server (server-side SGD
+    updates commute, so the final weights have an analytic expectation)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    env = {"JAX_PLATFORMS": "cpu", "MXNET_TEST_PLATFORM": "cpu",
+           "MXNET_KVSTORE_BUCKET_BYTES": "512"}
+    rc = launch.launch_local(
+        2, [sys.executable, os.path.join(REPO, "tests",
+                                         "dist_bucket_worker.py")],
         env_extra=env, num_servers=1)
     assert rc == 0

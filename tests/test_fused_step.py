@@ -1,0 +1,293 @@
+"""Fused whole-step training (MXNET_TPU_FUSED_STEP).
+
+Parity contract: the fused donated-buffer program must produce the SAME
+numbers as the eager per-param oracle — params AND optimizer state — for
+every optimizer with a ``fused_update``, on one device and on a
+multi-device local-kvstore module, across a force_rebind.  Plus the
+mechanics: donation genuinely frees the old buffers, the env flag is part
+of the jit-cache key, and ineligible setups (monitor attached) fall back
+to eager without error.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import telemetry
+from mxnet_tpu import fused_step as fused
+
+
+def _build_module(ctxs=None, batch=8):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, label, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=ctxs or [mx.cpu()])
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mx.random.seed(42)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    return mod
+
+
+class _Batch:
+    def __init__(self, x, y):
+        self.data = [mx.nd.array(x)]
+        self.label = [mx.nd.array(y)]
+
+
+def _batch(i, batch=8):
+    rs = np.random.RandomState(100 + i)
+    return _Batch(rs.randn(batch, 10).astype(np.float32),
+                  rs.randint(0, 4, (batch,)).astype(np.float32))
+
+
+def _run(monkeypatch, flag, opt_name, opt_kwargs, steps=4, ctxs=None,
+         rebind_at=None, rebind_batch=12):
+    monkeypatch.setenv(fused.ENV_FLAG, flag)
+    mod = _build_module(ctxs=ctxs)
+    mod.init_optimizer(optimizer=opt_name,
+                       optimizer_params=dict(opt_kwargs))
+    batch = 8
+    for i in range(steps):
+        if rebind_at is not None and i == rebind_at:
+            args, auxs = mod.get_params()
+            mod.bind(data_shapes=[("data", (rebind_batch, 10))],
+                     label_shapes=[("softmax_label", (rebind_batch,))],
+                     force_rebind=True)
+            mod.set_params(args, auxs)
+            batch = rebind_batch
+        mod.forward_backward(_batch(i, batch))
+        mod.update()
+    args, _ = mod.get_params()
+    states = {}
+    if mod._updater is not None:
+        for slot, st in mod._updater.states.items():
+            leaves = opt.fused_state_leaves(st)
+            states[slot] = [] if leaves is None else \
+                [s.asnumpy() for s in leaves]
+    return args, states
+
+
+def _assert_parity(f, e, rtol=2e-5, atol=1e-6):
+    a_f, s_f = f
+    a_e, s_e = e
+    assert sorted(a_f) == sorted(a_e)
+    for k in a_e:
+        np.testing.assert_allclose(a_f[k].asnumpy(), a_e[k].asnumpy(),
+                                   rtol=rtol, atol=atol, err_msg=k)
+    assert sorted(s_f) == sorted(s_e)
+    for slot in s_e:
+        assert len(s_f[slot]) == len(s_e[slot]), "state arity %r" % slot
+        for j, (x, y) in enumerate(zip(s_f[slot], s_e[slot])):
+            np.testing.assert_allclose(x, y, rtol=rtol, atol=atol,
+                                       err_msg="state %r[%d]" % (slot, j))
+
+
+OPT_CONFIGS = [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("sgd", {"learning_rate": 0.05}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-4}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("name,kwargs", OPT_CONFIGS,
+                             ids=[c[0] + ("_c" if c[1].get("centered")
+                                          else ("_m" if c[1].get("momentum")
+                                                else ""))
+                                  for c in OPT_CONFIGS])
+    def test_single_device(self, monkeypatch, name, kwargs):
+        f = _run(monkeypatch, "1", name, kwargs)
+        e = _run(monkeypatch, "0", name, kwargs)
+        _assert_parity(f, e)
+
+    @pytest.mark.parametrize("name,kwargs",
+                             [("sgd", {"learning_rate": 0.05,
+                                       "momentum": 0.9, "wd": 1e-4}),
+                              ("adam", {"learning_rate": 0.01})])
+    def test_multi_device_local_kvstore(self, monkeypatch, name, kwargs):
+        ctxs = [mx.cpu(0), mx.cpu(1)]
+        f = _run(monkeypatch, "1", name, kwargs, ctxs=ctxs)
+        e = _run(monkeypatch, "0", name, kwargs, ctxs=ctxs)
+        _assert_parity(f, e)
+
+    def test_rebind_after_shape_change(self, monkeypatch):
+        kwargs = {"learning_rate": 0.05, "momentum": 0.9}
+        f = _run(monkeypatch, "1", "sgd", kwargs, steps=5, rebind_at=2)
+        e = _run(monkeypatch, "0", "sgd", kwargs, steps=5, rebind_at=2)
+        _assert_parity(f, e)
+
+
+class TestDispatchMechanics:
+    def test_one_program_per_step_and_counters(self, monkeypatch):
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        telemetry.enable()
+        try:
+            fused0 = telemetry.value("step_dispatch_total", path="fused")
+            eager0 = telemetry.value("step_dispatch_total", path="eager")
+            mod = _build_module()
+            mod.init_optimizer(
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+            for i in range(4):
+                mod.forward_backward(_batch(i))
+                mod.update()
+            assert telemetry.value("step_dispatch_total",
+                                   path="fused") == fused0 + 4
+            assert telemetry.value("step_dispatch_total",
+                                   path="eager") == eager0
+            # exactly ONE compiled step program served all 4 steps
+            ex = mod._exec_group.execs[0]
+            step_keys = [k for k in ex._jitted if k[0] == "step"]
+            assert len(step_keys) == 1
+        finally:
+            telemetry.disable()
+
+    def test_env_flag_in_jit_cache_key(self, monkeypatch):
+        # regression: MXNET_TPU_FUSED_STEP participates in the step-program
+        # cache key via STEP_ENV_KEYS, so a flag flip cannot silently reuse
+        # a stale compiled closure
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        mod.forward_backward(_batch(0))
+        mod.update()
+        ex = mod._exec_group.execs[0]
+        keys1 = {k for k in ex._jitted if k[0] == "step"}
+        assert keys1 and all(fused.ENV_FLAG in str(k) or len(k) > 1
+                             for k in keys1)
+        # a different truthy spelling is a different cache entry
+        monkeypatch.setenv(fused.ENV_FLAG, "yes")
+        mod.forward_backward(_batch(1))
+        mod.update()
+        keys2 = {k for k in ex._jitted if k[0] == "step"}
+        assert len(keys2) == 2 and keys1 < keys2
+        # and "0" disables: no third entry appears
+        monkeypatch.setenv(fused.ENV_FLAG, "0")
+        mod.forward_backward(_batch(2))
+        mod.update()
+        keys3 = {k for k in ex._jitted if k[0] == "step"}
+        assert keys3 == keys2
+
+    def test_donation_frees_old_buffers(self, monkeypatch):
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        mod = _build_module()
+        mod.init_optimizer(
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        ex = mod._exec_group.execs[0]
+        mod.forward_backward(_batch(0))
+        mod.update()
+        old = ex.arg_dict["fc1_weight"]._data
+        mod.forward_backward(_batch(1))
+        mod.update()
+        # the donated input buffer was genuinely consumed by XLA, not
+        # copied: the old jax array is dead
+        assert old.is_deleted()
+        # while the LIVE weight is readable and finite
+        w = ex.arg_dict["fc1_weight"].asnumpy()
+        assert np.isfinite(w).all()
+
+    def test_monitor_falls_back_to_eager(self, monkeypatch):
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        telemetry.enable()
+        try:
+            eager0 = telemetry.value("step_dispatch_total", path="eager")
+            mod = _build_module()
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.05})
+            mod.forward_backward(_batch(0))
+            mod.update()
+            # a monitor holds live references into the executor's buffers:
+            # donation would free what it watches, so the step must fall
+            # back to the eager oracle
+            mod._exec_group.execs[0]._monitor = object()
+            mod.forward_backward(_batch(1))
+            mod.update()
+            assert telemetry.value("step_dispatch_total",
+                                   path="eager") == eager0 + 1
+            w = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+            assert np.isfinite(w).all()
+        finally:
+            telemetry.disable()
+
+
+class TestTrainerFused:
+    def _run(self, monkeypatch, flag, steps=3):
+        from mxnet_tpu import autograd
+        from mxnet_tpu.gluon import nn, Trainer
+        monkeypatch.setenv(fused.ENV_FLAG, flag)
+        mx.random.seed(11)
+        net = nn.Sequential()
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+        net.initialize(ctx=mx.cpu())
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 0.01, "wd": 1e-4})
+        for i in range(steps):
+            rs = np.random.RandomState(i)
+            x = mx.nd.array(rs.randn(8, 10).astype(np.float32))
+            with autograd.record():
+                y = net(x)
+                loss = (y * y).sum()
+            loss.backward()
+            tr.step(8)
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    def test_parity(self, monkeypatch):
+        f = self._run(monkeypatch, "1")
+        e = self._run(monkeypatch, "0")
+        assert len(f) == len(e)
+        for i, (x, y) in enumerate(zip(f, e)):
+            np.testing.assert_allclose(x, y, rtol=2e-5, atol=1e-6,
+                                       err_msg="param %d" % i)
+
+
+class TestResolver:
+    """The shared (param, device) -> slot resolver: lr_mult/wd_mult must
+    resolve identically for every replica of a param (the old per-call
+    ``i*num_device+k`` reimplementations could disagree)."""
+
+    def test_slot_index_math(self):
+        assert opt.Optimizer.slot_index(0, 1, 0) == 0
+        assert opt.Optimizer.slot_index(3, 1, 0) == 3
+        assert opt.Optimizer.slot_index(0, 4, 2) == 2
+        assert opt.Optimizer.slot_index(3, 4, 1) == 13
+
+    def test_build_idx2name_covers_all_replicas(self):
+        names = ["w", "b", "g"]
+        idx2name = opt.Optimizer.build_idx2name(names, 2)
+        assert len(idx2name) == 6
+        for i, name in enumerate(names):
+            for k in range(2):
+                assert idx2name[opt.Optimizer.slot_index(i, 2, k)] == name
+
+    def test_lr_wd_mult_equal_across_replicas(self):
+        names = ["fc_weight", "fc_bias"]
+        ndev = 3
+        o = opt.create("sgd", learning_rate=0.1, wd=0.01,
+                       param_idx2name=opt.Optimizer.build_idx2name(
+                           names, ndev))
+        o.set_lr_mult({"fc_weight": 2.0})
+        o.set_wd_mult({"fc_bias": 0.0})
+        for i, name in enumerate(names):
+            slots = [opt.Optimizer.slot_index(i, ndev, k)
+                     for k in range(ndev)]
+            lrs = {o._get_lr(s) for s in slots}
+            wds = {o._get_wd(s) for s in slots}
+            assert len(lrs) == 1, name
+            assert len(wds) == 1, name
+        assert o._get_lr(opt.Optimizer.slot_index(0, ndev, 1)) == \
+            pytest.approx(0.2)
+        assert o._get_wd(opt.Optimizer.slot_index(1, ndev, 2)) == 0.0
