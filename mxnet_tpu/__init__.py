@@ -68,6 +68,7 @@ from . import fused
 from .fused import FusedTrainer
 from . import predictor
 from .predictor import Predictor
+from . import serving
 
 
 def kvstore_create(name="local"):

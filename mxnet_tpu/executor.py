@@ -38,6 +38,18 @@ _FWDBWD_TIME = _telemetry.histogram(
     "executor_forward_backward_seconds",
     "Fused Executor.forward_backward wall time")
 
+# whole-graph program observability: the executor's jitted forward is one
+# XLA program per (mode, input-shape signature), so its cache lookups join
+# the SAME compile metrics ops/registry.py feeds for per-op entries — a
+# serving bucket set that stays within its declared programs shows exactly
+# len(buckets) misses here and nothing but hits afterwards.
+_PROG_HITS = _telemetry.counter(
+    "op_jit_cache_hits_total",
+    "Operator jit-cache lookups served by an existing entry", ("op",))
+_PROG_MISSES = _telemetry.counter(
+    "op_jit_cache_misses_total",
+    "Operator jit-cache lookups that built a new entry", ("op",))
+
 
 class _Plan:
     """Precomputed execution plan for a symbol graph."""
@@ -492,6 +504,17 @@ class Executor:
         # first_run marks the trace+compile invocation of this (mode,
         # shape-set) so recompiles stand out from steady-state iterations
         first_run = ("fwd", bool(is_train)) not in self._jitted
+        if _telemetry.enabled:
+            # count per input-shape signature, not per _fwd_fn build: the
+            # jitted fn silently recompiles on a new shape, and THAT is
+            # the event a shape-bucketing layer must see
+            skey = ("fwdsig", bool(is_train),
+                    tuple(self.arg_dict[n].shape for n in self.arg_names))
+            if skey in self._jitted:
+                _PROG_HITS.labels(op="Executor::Forward").inc()
+            else:
+                self._jitted[skey] = True
+                _PROG_MISSES.labels(op="Executor::Forward").inc()
         with _profiler.span("Executor::Forward", "executor",
                             histogram=_FWD_TIME,
                             args={"first_run": first_run}):

@@ -75,6 +75,11 @@ class Predictor:
                 self._aux_params[k[4:]] = v
             else:
                 self._arg_params[k] = v
+        self._bind(input_shapes)
+
+    def _bind(self, input_shapes):
+        """Bind the (already parsed) symbol + params for these shapes."""
+        from . import ndarray as nd
 
         input_shapes = dict(input_shapes or {})
         if not input_shapes:
@@ -106,12 +111,26 @@ class Predictor:
 
     # ---- the C predict API surface ---------------------------------------
     def set_input(self, name, value):
-        """MXPredSetInput."""
+        """MXPredSetInput.
+
+        NDArray values already on device are adopted directly (an
+        identity ``astype`` when dtypes match — zero copies); everything
+        else takes the host-upload path.  The old behaviour round-tripped
+        device arrays through ``asnumpy()`` — a device→host→device bounce
+        per request, fatal for a serving hot path.
+        """
         if name not in self._input_names:
             raise MXNetError("unknown input %r (have %s)"
                              % (name, self._input_names))
-        self._executor.arg_dict[name][:] = np.asarray(
-            value.asnumpy() if hasattr(value, "asnumpy") else value)
+        dst = self._executor.arg_dict[name]
+        data = getattr(value, "_data", None)
+        if data is not None:                   # NDArray: stay on device
+            if tuple(data.shape) != dst.shape:
+                raise MXNetError("input %r has shape %s, bound shape is %s"
+                                 % (name, tuple(data.shape), dst.shape))
+            dst._data = data.astype(dst.dtype)
+        else:
+            dst[:] = np.asarray(value)
 
     def forward(self, **inputs):
         """MXPredForward; keyword inputs are a convenience for set_input."""
@@ -127,10 +146,18 @@ class Predictor:
         return self._outputs[index]
 
     def reshape(self, input_shapes):
-        """MXPredReshape: rebind for new input shapes."""
-        return Predictor(self._symbol.tojson(),
-                         {**{"arg:" + k: v for k, v in
-                             self._arg_params.items()},
-                          **{"aux:" + k: v for k, v in
-                             self._aux_params.items()}},
-                         ctx=self._ctx, input_shapes=input_shapes)
+        """MXPredReshape: rebind for new input shapes.
+
+        Shares this predictor's symbol and parameter objects — no
+        ``tojson()``/re-parse round trip, no parameter copies; only the
+        bind (and XLA's per-shape compile on first forward) is new.  This
+        is what makes a per-bucket predictor set cheap for the serving
+        layer.
+        """
+        new = Predictor.__new__(Predictor)
+        new._ctx = self._ctx
+        new._symbol = self._symbol
+        new._arg_params = self._arg_params
+        new._aux_params = self._aux_params
+        new._bind(input_shapes)
+        return new

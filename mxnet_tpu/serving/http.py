@@ -1,0 +1,118 @@
+"""Stdlib JSON inference endpoint over a :class:`ModelServer`.
+
+Same pattern as ``telemetry/export.py``: ``http.server`` on daemon
+threads, loopback bind by default (the wire is unauthenticated JSON —
+exposing it wider is an explicit operator choice via
+``MXNET_SERVING_HOST``).
+
+Routes::
+
+    POST /predict        {"inputs": {name: nested list}, "deadline_ms": n?}
+                         -> 200 {"outputs": [...], "rows": n}
+    GET  /healthz        -> 200 {"status": "serving", ...stats}
+    GET  /stats          -> 200 server stats JSON
+
+Overload maps to status codes a load balancer understands: 503 for
+queue-full rejection and shutdown (retryable elsewhere), 504 for an
+expired deadline, 400 for malformed requests.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from ..base import get_env
+from .batcher import (DeadlineExceededError, QueueFullError,
+                      ServerClosedError, ServingError)
+
+__all__ = ["start_http_server", "stop_http_server"]
+
+_server = None
+_server_thread = None
+_server_lock = threading.Lock()
+
+
+def start_http_server(model_server, port=None, host=None):
+    """Serve the inference endpoint for ``model_server`` on a daemon
+    thread; returns the bound port (``port=0`` picks a free one)."""
+    import http.server
+
+    if port is None:
+        port = get_env("MXNET_SERVING_PORT", 0, int)
+    if host is None:
+        host = get_env("MXNET_SERVING_HOST", "127.0.0.1")
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - stdlib API
+            path = self.path.split("?", 1)[0]
+            if path in ("/healthz", "/stats"):
+                doc = model_server.stats()
+                if path == "/healthz":
+                    doc = {"status": "serving", **doc}
+                self._reply(200, doc)
+            else:
+                self.send_error(404)
+
+        def do_POST(self):  # noqa: N802 - stdlib API
+            path = self.path.split("?", 1)[0]
+            if path != "/predict":
+                self.send_error(404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                inputs = doc["inputs"]
+                if not isinstance(inputs, dict):
+                    raise ValueError("inputs must be an object")
+                deadline_ms = doc.get("deadline_ms")
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": "bad request: %s" % e})
+                return
+            try:
+                outs = model_server.predict(inputs, deadline_ms=deadline_ms)
+            except (QueueFullError, ServerClosedError) as e:
+                self._reply(503, {"error": str(e), "outcome": "rejected"})
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e), "outcome": "deadline"})
+            except ServingError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 - surface, don't kill
+                self._reply(500, {"error": "%s: %s" % (type(e).__name__, e)})
+            else:
+                self._reply(200, {"outputs": [o.tolist() for o in outs],
+                                  "rows": int(outs[0].shape[0]) if outs
+                                  else 0})
+
+        def log_message(self, *args):  # keep request lines out of stderr
+            pass
+
+    global _server, _server_thread
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        srv = http.server.ThreadingHTTPServer((host, int(port)), Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever,
+                             name="mxtpu-serving-http", daemon=True)
+        t.start()
+        _server, _server_thread = srv, t
+        return srv.server_address[1]
+
+
+def stop_http_server():
+    global _server, _server_thread
+    with _server_lock:
+        if _server is None:
+            return
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _server_thread = None

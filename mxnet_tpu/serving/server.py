@@ -1,0 +1,383 @@
+"""ModelServer: dynamic-batching inference over a forward-only program.
+
+The server owns one :class:`~mxnet_tpu.predictor.Predictor` **per declared
+batch bucket**, all sharing the same symbol and parameter objects (cheap
+``Predictor.reshape``).  Each bucket predictor is bound to one fixed input
+shape, so each is exactly one XLA program; ``warmup()`` runs every bucket
+once at startup so all compilation happens before traffic (AOT — a cold
+bucket compiling under load would blow every deadline in the batch).
+
+Request path: ``submit`` validates + admits into the
+:class:`~mxnet_tpu.serving.batcher.DynamicBatcher` (bounded queue —
+explicit :class:`QueueFullError` on overload); a worker thread forms a
+batch, drops expired-deadline requests *before* execution, concatenates
+the survivors' rows, zero-pads to the bucket size, runs the bucket's
+predictor under the swap lock, and slices each request's rows back out.
+``swap_params`` takes the same lock, so every batch executes against
+exactly one weight set — hot-swap is atomic at batch granularity.
+
+Telemetry (gated by ``telemetry.enabled``, same discipline as the rest of
+the runtime): ``serving_requests_total{outcome}``, ``serving_queue_depth``,
+queue-wait / execute / end-to-end latency histograms,
+``serving_batch_rows`` (realized batch size) and
+``serving_padding_rows_total`` (bucket padding waste).  Tracing (gated by
+``tracing.enabled``): a ``Serving::Submit`` span per request whose flow
+event lands on the ``Serving::ExecuteBatch`` span that served it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import get_env
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
+                      Request, ServerClosedError, ServingError, pow2_buckets)
+
+__all__ = ["ServingConfig", "ModelServer"]
+
+_REQS = _telemetry.counter(
+    "serving_requests_total",
+    "Serving requests by final outcome (ok|rejected|deadline|error)",
+    ("outcome",))
+_QUEUE_DEPTH = _telemetry.gauge(
+    "serving_queue_depth", "Requests waiting in the serving queue")
+_QUEUE_WAIT = _telemetry.histogram(
+    "serving_queue_wait_seconds", "Request wait from admit to dequeue")
+_EXEC_TIME = _telemetry.histogram(
+    "serving_execute_seconds", "Batch execution wall time (pad+forward)")
+_E2E_TIME = _telemetry.histogram(
+    "serving_request_seconds", "Request wall time from submit to completion")
+_BATCH_ROWS = _telemetry.histogram(
+    "serving_batch_rows", "Realized rows per executed batch (pre-padding)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_PAD_ROWS = _telemetry.counter(
+    "serving_padding_rows_total",
+    "Zero rows executed to pad batches up to their bucket")
+_SWAPS = _telemetry.counter(
+    "serving_hot_swaps_total", "Atomic weight hot-swaps applied")
+
+
+class ServingConfig:
+    """Server knobs; constructor arguments override ``MXNET_SERVING_*``
+    environment defaults (see docs/serving.md)."""
+
+    def __init__(self, max_batch_size=None, batch_buckets=None,
+                 batch_timeout_ms=None, queue_depth=None,
+                 default_deadline_ms=None, num_workers=None):
+        if max_batch_size is None:
+            max_batch_size = get_env("MXNET_SERVING_MAX_BATCH", 8, int)
+        if batch_timeout_ms is None:
+            batch_timeout_ms = get_env(
+                "MXNET_SERVING_BATCH_TIMEOUT_MS", 2.0, float)
+        if queue_depth is None:
+            queue_depth = get_env("MXNET_SERVING_QUEUE_DEPTH", 256, int)
+        if default_deadline_ms is None:
+            default_deadline_ms = get_env(
+                "MXNET_SERVING_DEADLINE_MS", 0.0, float)
+        if num_workers is None:
+            num_workers = get_env("MXNET_SERVING_WORKERS", 1, int)
+        if batch_buckets is None:
+            env_buckets = get_env("MXNET_SERVING_BUCKETS", None)
+            if env_buckets:
+                batch_buckets = tuple(
+                    int(b) for b in env_buckets.split(",") if b.strip())
+            else:
+                batch_buckets = pow2_buckets(int(max_batch_size))
+        self.max_batch_size = int(max_batch_size)
+        self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.queue_depth = int(queue_depth)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.num_workers = max(1, int(num_workers))
+
+
+class ModelServer:
+    """Dynamic-batching model server over a forward-only Predictor.
+
+    Parameters
+    ----------
+    symbol_json, params, ctx
+        Forwarded to :class:`~mxnet_tpu.predictor.Predictor`.
+    example_shapes : dict of name -> per-example shape (NO batch dim)
+        e.g. ``{"data": (3, 224, 224)}``; the server prepends the bucket
+        batch dimension itself.
+    config : ServingConfig, optional
+        Extra keyword arguments build one (``max_batch_size=...`` etc.).
+    """
+
+    def __init__(self, symbol_json, params, example_shapes,
+                 ctx=None, config: Optional[ServingConfig] = None,
+                 **config_kwargs):
+        from ..predictor import Predictor
+
+        if config is None:
+            config = ServingConfig(**config_kwargs)
+        elif config_kwargs:
+            raise ServingError("pass either config= or config kwargs, "
+                               "not both")
+        self.config = config
+        self._example_shapes = {k: tuple(int(d) for d in s)
+                                for k, s in dict(example_shapes).items()}
+        if not self._example_shapes:
+            raise ServingError("example_shapes must name at least one input")
+        self._batcher = DynamicBatcher(
+            config.batch_buckets, config.max_batch_size,
+            config.batch_timeout_ms, config.queue_depth)
+
+        # one predictor per bucket, sharing symbol/params via reshape
+        buckets = self._batcher.buckets
+        base = Predictor(symbol_json, params, ctx=ctx, input_shapes={
+            k: (buckets[-1],) + s for k, s in self._example_shapes.items()})
+        self._predictors = {buckets[-1]: base}
+        for b in buckets[:-1]:
+            self._predictors[b] = base.reshape(
+                {k: (b,) + s for k, s in self._example_shapes.items()})
+
+        self._swap_lock = threading.Lock()
+        self._workers: List[threading.Thread] = []
+        self._started = False
+        self._stopped = False
+        self._warmed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, warmup: bool = True):
+        """Spawn the worker thread(s); ``warmup`` AOT-compiles every
+        declared bucket first so no request ever waits on XLA."""
+        if self._stopped:
+            raise ServerClosedError("server already stopped")
+        if self._started:
+            return self
+        if warmup:
+            self.warmup()
+        for i in range(self.config.num_workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 name="mxtpu-serving-worker-%d" % i,
+                                 daemon=True)
+            t.start()
+            self._workers.append(t)
+        self._started = True
+        return self
+
+    def warmup(self):
+        """Run every bucket once on zeros: all tracing + XLA compilation
+        happens here, bounded by the declared bucket set."""
+        if self._warmed:
+            return
+        with self._swap_lock:
+            for b, pred in sorted(self._predictors.items()):
+                feed = {k: np.zeros((b,) + s, np.float32)
+                        for k, s in self._example_shapes.items()}
+                pred.forward(**feed)
+        self._warmed = True
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Shut down.  ``drain=True`` (graceful): stop admitting, execute
+        everything already queued, then join the workers.  ``drain=False``:
+        fail queued requests with :class:`ServerClosedError` immediately."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._batcher.close()
+        if not drain:
+            self._batcher.drop_all(
+                lambda: ServerClosedError("server shut down before "
+                                          "this request executed"))
+            if _telemetry.enabled:
+                _QUEUE_DEPTH.set(0)
+        for t in self._workers:
+            t.join(timeout)
+        self._workers = []
+
+    # -- request admission -------------------------------------------------
+    def _validate(self, inputs):
+        """Normalize to {name: (rows, *example)} float arrays; returns
+        (feed, rows)."""
+        feed, rows = {}, None
+        if set(inputs) != set(self._example_shapes):
+            raise ServingError(
+                "inputs %s do not match declared %s"
+                % (sorted(inputs), sorted(self._example_shapes)))
+        for name, value in inputs.items():
+            eshape = self._example_shapes[name]
+            arr = value.asnumpy() if hasattr(value, "asnumpy") \
+                else np.asarray(value)
+            if arr.shape == eshape:            # single example: add row dim
+                arr = arr[None]
+            elif arr.shape[1:] != eshape:
+                raise ServingError(
+                    "input %r has shape %s; want (rows,)+%s or %s"
+                    % (name, arr.shape, eshape, eshape))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise ServingError(
+                    "inputs disagree on rows: %d vs %d for %r"
+                    % (rows, arr.shape[0], name))
+            feed[name] = arr
+        if rows < 1:
+            raise ServingError("request carries zero rows")
+        return feed, rows
+
+    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Request:
+        """Admit one request; returns a :class:`Request` future.
+
+        Raises :class:`QueueFullError` when the bounded queue is full,
+        :class:`ServerClosedError` after shutdown, :class:`ServingError`
+        on malformed inputs.  ``deadline_ms`` (or the configured
+        ``MXNET_SERVING_DEADLINE_MS`` default) bounds end-to-end latency:
+        requests still queued past the deadline are dropped unexecuted.
+        """
+        feed, rows = self._validate(inputs)
+        if deadline_ms is None and self.config.default_deadline_ms > 0:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = Request(feed, rows, deadline)
+        if _tracing.enabled:
+            with _tracing.span("Serving::Submit", "serving",
+                               args={"rows": rows}) as sp:
+                req.flow_id = sp.span_id
+                sp.flow_out("serving_flow")
+        try:
+            self._batcher.put(req)
+        except (QueueFullError, ServerClosedError) as e:
+            req._fail(e, "rejected")
+            if _telemetry.enabled:
+                _REQS.labels(outcome="rejected").inc()
+            raise
+        if _telemetry.enabled:
+            _QUEUE_DEPTH.set(len(self._batcher))
+        return req
+
+    def predict(self, inputs, deadline_ms=None, timeout=30.0):
+        """Synchronous convenience: submit + wait; returns the list of
+        per-output arrays, each ``(rows, *out_shape)``."""
+        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
+
+    # -- hot swap ----------------------------------------------------------
+    def swap_params(self, params, aux_params=None):
+        """Atomically replace the served weights between batches.
+
+        ``params`` is a {name: array} dict (``arg:``/``aux:`` prefixes
+        accepted, checkpoint convention).  Shapes must match the bound
+        graph — a swap never re-binds or recompiles.  The swap lock
+        excludes batch execution, so every request's batch runs against
+        exactly one weight set (old or new, never a mix).
+        """
+        args, auxs = {}, dict(aux_params or {})
+        for k, v in dict(params).items():
+            if k.startswith("arg:"):
+                args[k[4:]] = v
+            elif k.startswith("aux:"):
+                auxs[k[4:]] = v
+            else:
+                args[k] = v
+        with self._swap_lock:
+            for pred in self._predictors.values():
+                pred._executor.copy_params_from(
+                    args, auxs or None, allow_extra_params=True)
+        if _telemetry.enabled:
+            _SWAPS.inc()
+
+    # -- execution ---------------------------------------------------------
+    def _worker_loop(self):
+        while True:
+            reqs = self._batcher.get_batch()
+            if reqs is None:
+                return
+            if _telemetry.enabled:
+                _QUEUE_DEPTH.set(len(self._batcher))
+            now = time.monotonic()
+            live = []
+            for r in reqs:
+                if r.expired(now):
+                    self._finish(r, DeadlineExceededError(
+                        "deadline expired %.1fms before execution"
+                        % ((now - r.deadline) * 1e3)), "deadline")
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            try:
+                self._execute(live)
+            except Exception as e:  # noqa: BLE001 - a batch failure must
+                # fail its requests, never kill the worker loop
+                err = e if isinstance(e, ServingError) else ServingError(
+                    "batch execution failed: %s: %s" % (type(e).__name__, e))
+                for r in live:
+                    self._finish(r, err, "error")
+
+    def _execute(self, live):
+        rows = sum(r.rows for r in live)
+        bucket = self._batcher.bucket_for(rows)
+        t0 = time.monotonic()
+        if _telemetry.enabled:
+            for r in live:
+                _QUEUE_WAIT.observe(r.dequeue_t - r.submit_t)
+            _BATCH_ROWS.observe(rows)
+            _PAD_ROWS.inc(bucket - rows)
+        feed = {}
+        for name, eshape in self._example_shapes.items():
+            mats = [r.inputs[name] for r in live]
+            arr = mats[0] if len(mats) == 1 else np.concatenate(mats, axis=0)
+            if rows < bucket:
+                arr = np.concatenate(
+                    [arr, np.zeros((bucket - rows,) + eshape, arr.dtype)],
+                    axis=0)
+            feed[name] = arr
+        if _tracing.enabled:
+            with _tracing.span("Serving::ExecuteBatch", "serving",
+                               args={"bucket": bucket, "rows": rows,
+                                     "requests": len(live)}):
+                for r in live:
+                    if r.flow_id:
+                        _tracing._emit_flow("f", r.flow_id, "serving_flow",
+                                            "serving", bind_enclosing=True)
+                outs = self._forward(bucket, feed)
+        else:
+            outs = self._forward(bucket, feed)
+        if _telemetry.enabled:
+            _EXEC_TIME.observe(time.monotonic() - t0)
+        offset = 0
+        for r in live:
+            r._outputs = [o[offset:offset + r.rows] for o in outs]
+            offset += r.rows
+            self._finish(r, None, "ok")
+
+    def _forward(self, bucket, feed):
+        """One padded-bucket forward under the swap lock; returns host
+        arrays (sliced per request by the caller)."""
+        pred = self._predictors[bucket]
+        with self._swap_lock:
+            outs = pred.forward(**feed)
+        return [o.asnumpy() for o in outs]
+
+    def _finish(self, req, error, outcome):
+        if _telemetry.enabled:
+            _REQS.labels(outcome=outcome).inc()
+            _E2E_TIME.observe(time.monotonic() - req.submit_t)
+        if error is None:
+            req.outcome = "ok"
+            req._event.set()
+        else:
+            req._fail(error, outcome)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        return {
+            "buckets": list(self._batcher.buckets),
+            "max_batch_size": self.config.max_batch_size,
+            "batch_timeout_ms": self.config.batch_timeout_ms,
+            "queue_depth": len(self._batcher),
+            "queue_capacity": self.config.queue_depth,
+            "rows_queued": self._batcher.rows_queued,
+            "workers": len(self._workers),
+            "started": self._started,
+            "stopped": self._stopped,
+            "warmed": self._warmed,
+        }
