@@ -270,7 +270,7 @@ class Executor:
     # env flags that select a different fused-step program; they join the
     # program cache key so a toggle takes effect without a rebind (same
     # contract as ops/registry.py env_keys)
-    STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP",)
+    STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP", "MXNET_TPU_MESH_STEP")
 
     def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
                  args_grad: Dict[str, Any], grad_req: Dict[str, str],
@@ -405,7 +405,8 @@ class Executor:
         import os
         return tuple(os.environ.get(k) for k in self.STEP_ENV_KEYS)
 
-    def step_program(self, pnames, update_fns):
+    def step_program(self, pnames, update_fns, mesh_sig=None,
+                     param_shardings=None):
         """Whole-step program: forward + vjp-backward + optimizer update in
         ONE ``jax.jit`` with params and opt-state donated — weights update
         in place on device, zero per-param python dispatch.
@@ -417,8 +418,19 @@ class Executor:
         the optimizer binding changes (fused_step.ModuleFusedStep does).
         Per-slot lr/wd/t and rescale_grad arrive as traced scalars: one
         compiled program serves every step.
+
+        ``mesh_sig`` (mesh shape + input sharding signature) joins the
+        cache key for the GSPMD variant: the traced body is identical —
+        partitioning comes entirely from the input shardings — but a mesh
+        or rule change must not reuse a program specialised for the old
+        layout.  ``param_shardings`` (aligned with ``pnames``) pins each
+        updated param and its opt-state to the INPUT's sharding: without
+        the constraint GSPMD may pick a different output layout (e.g.
+        shard a small bias), which would silently break the take/give
+        donation chain on the next step.
         """
-        key = ("step",) + self._step_env()
+        key = ("step",) + ((mesh_sig,) if mesh_sig is not None else ()) \
+            + self._step_env()
         fn = self._jitted.get(key)
         if fn is not None:
             return fn
@@ -446,6 +458,11 @@ class Executor:
             for i, upd in enumerate(update_fns):
                 w, s = upd(pvals[i], grads[i], svals[i],
                            lrs[i], wds[i], rescale, ts[i])
+                if param_shardings is not None:
+                    sh = param_shardings[i]
+                    w = jax.lax.with_sharding_constraint(w, sh)
+                    s = jax.tree_util.tree_map(
+                        lambda a: jax.lax.with_sharding_constraint(a, sh), s)
                 new_p.append(w)
                 new_s.append(s)
             return new_p, new_s, outs, new_aux
@@ -469,15 +486,21 @@ class Executor:
         auxs = [self.aux_dict[n]._data for n in self.aux_names]
         return args, auxs
 
-    def _default_ograds(self):
-        """Ones head-gradients with shapes from (cached) shape inference."""
-        shape_key = tuple(self.arg_dict[n].shape for n in self.arg_names)
+    def _ograds_for(self, shapes):
+        """Ones head-gradients for a {arg_name: shape} dict (cached shape
+        inference).  The mesh step passes full-batch shapes here; the bound
+        per-device shapes come from ``_default_ograds``."""
+        shape_key = tuple(tuple(shapes[n]) for n in self.arg_names)
         cached = self._jitted.get(("oshapes", shape_key))
         if cached is None:
-            _, cached, _ = self._symbol.infer_shape(
-                **{n: self.arg_dict[n].shape for n in self.arg_names})
+            _, cached, _ = self._symbol.infer_shape(**shapes)
             self._jitted[("oshapes", shape_key)] = cached
         return [jnp.ones(s, np.float32) for s in cached]
+
+    def _default_ograds(self):
+        """Ones head-gradients with shapes from (cached) shape inference."""
+        return self._ograds_for(
+            {n: self.arg_dict[n].shape for n in self.arg_names})
 
     def _wrap_outputs(self, outs):
         from .ndarray.ndarray import NDArray

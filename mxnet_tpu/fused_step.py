@@ -33,10 +33,12 @@ import jax.numpy as jnp
 
 from . import telemetry as _telemetry
 
-__all__ = ["enabled", "ModuleFusedStep", "TrainerFusedUpdate",
-           "DonationPool", "STEP_DISPATCH", "STEP_TIME", "ENV_FLAG"]
+__all__ = ["enabled", "mesh_enabled", "ModuleFusedStep",
+           "TrainerFusedUpdate", "TrainerMeshUpdate", "DonationPool",
+           "STEP_DISPATCH", "STEP_TIME", "ENV_FLAG", "MESH_ENV_FLAG"]
 
 ENV_FLAG = "MXNET_TPU_FUSED_STEP"
+MESH_ENV_FLAG = "MXNET_TPU_MESH_STEP"
 
 STEP_DISPATCH = _telemetry.counter(
     "step_dispatch_total",
@@ -52,6 +54,16 @@ STEP_TIME = _telemetry.histogram(
 def enabled():
     """MXNET_TPU_FUSED_STEP gate; default ON."""
     return os.environ.get(ENV_FLAG, "1").lower() not in \
+        ("0", "false", "off", "")
+
+
+def mesh_enabled():
+    """MXNET_TPU_MESH_STEP gate; default ON.  Selects the GSPMD mesh
+    variant of the fused step for local multi-device training: ONE global
+    program over a device ``Mesh`` (XLA inserts the gradient all-reduce
+    from the ``P('dp')`` batch sharding) instead of per-device programs
+    plus a host-side KVStore reduce."""
+    return os.environ.get(MESH_ENV_FLAG, "1").lower() not in \
         ("0", "false", "off", "")
 
 
@@ -80,14 +92,82 @@ class DonationPool:
             cur = jnp.array(cur)
         return cur
 
+    def take_sharded(self, slot, handle, sharding):
+        """Donation-safe buffer for a mesh slot: the handle's array when
+        pool-owned AND already laid out as ``sharding``; otherwise a
+        genuine copy placed onto the mesh.  The copy must be
+        ``jnp.array`` — ``jax.device_put`` may alias its input (even with
+        ``may_alias=False`` on CPU), and donating an alias would delete
+        the caller-held source buffer."""
+        cur = handle._data
+        if self._own.get(slot) is cur and \
+                getattr(cur, "sharding", None) == sharding:
+            return cur
+        return jax.device_put(jnp.array(cur), sharding)
+
     def give(self, slot, handle, new_data):
         self._own[slot] = new_data
         handle._data = new_data
+
+    def disown(self, slot):
+        """Forget a slot (its buffer escaped to non-pool code — e.g. the
+        mesh global was re-placed per device): the next take copies."""
+        self._own.pop(slot, None)
 
 
 def _dense(arr):
     from .ndarray.sparse import BaseSparseNDArray
     return arr is not None and not isinstance(arr, BaseSparseNDArray)
+
+
+def _copy_state_to(st, ctx):
+    """Genuine per-device copy of an optimizer state pytree (None / NDArray
+    / nested tuples-lists), used when de-meshing splits the single mesh
+    state back into the per-device eager layout."""
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return type(st)(_copy_state_to(s, ctx) for s in st)
+    if hasattr(st, "copyto"):
+        return st.copyto(ctx)
+    return st
+
+
+def _as_jax(arr):
+    """Device array of an NDArray/array-like without a host bounce when
+    the value is already device-resident."""
+    data = getattr(arr, "_data", None)
+    if data is not None:
+        return data
+    import numpy as _np
+    return jnp.asarray(_np.asarray(arr))
+
+
+class _StagedBatch:
+    """A staged (deferred) train batch, materialised lazily in whichever
+    layout the consumer needs: ``feeds()`` gives the per-device sliced
+    feeds for the eager replay / per-device programs, ``full()`` the
+    full-batch device arrays the mesh program shards on the ``dp`` axis —
+    the mesh path never pays the per-device slice + placement work."""
+
+    def __init__(self, eg, data_batch):
+        self._eg = eg
+        self._batch = data_batch
+        self._feeds = None
+
+    def feeds(self):
+        if self._feeds is None:
+            self._feeds = self._eg._load_batch(self._batch)
+        return self._feeds
+
+    def full(self):
+        out = {}
+        eg = self._eg
+        for name, arr in zip(eg.data_names, self._batch.data):
+            out[name] = _as_jax(arr)
+        for name, arr in zip(eg.label_names, self._batch.label or []):
+            out[name] = _as_jax(arr)
+        return out
 
 
 class ModuleFusedStep:
@@ -110,6 +190,9 @@ class ModuleFusedStep:
         self._pending = None
         self._unsupported = False
         self._structural_ok = None
+        self._mesh_cache = None      # (key, (mesh, rules, dp_axis)|None)
+        self._meshed = False         # handles currently hold mesh globals
+        self._mesh_outputs = None    # full-batch outputs of the last step
         # program closures capture the optimizer binding; a new driver
         # (new init_optimizer / rebind) must not reuse a predecessor's
         for ex in self._eg.execs:
@@ -133,17 +216,35 @@ class ModuleFusedStep:
         return self._pending is not None
 
     def stage(self, data_batch):
-        self._pending = self._eg._load_batch(data_batch)
+        self._pending = _StagedBatch(self._eg, data_batch)
+        self._mesh_outputs = None
 
     def flush_eager(self):
         """Replay a staged batch through the eager fwdbwd programs so
         outputs/grads/aux become observable exactly as if the batch had
-        never been deferred."""
+        never been deferred.  Always de-meshes first: the per-device
+        programs cannot consume 8-device globals.  Mesh outputs are
+        invalidated unconditionally — the caller is about to run eager
+        programs (e.g. ``score``'s eval forward), after which the last
+        mesh step's outputs would be served stale by ``get_outputs`` /
+        ``update_metric``."""
+        self._mesh_outputs = None
+        self._demesh()
         if self._pending is None:
             return
-        feeds, self._pending = self._pending, None
-        for ex, feed in zip(self._eg.execs, feeds):
+        staged, self._pending = self._pending, None
+        for ex, feed in zip(self._eg.execs, staged.feeds()):
             ex.forward_backward(**feed)
+
+    def mesh_outputs(self):
+        """Full-batch outputs of the last mesh step, or None when a newer
+        batch is pending / the last step was not mesh-dispatched."""
+        return None if self.pending else self._mesh_outputs
+
+    def demesh(self):
+        """Public hook (Module.get_params / set_mesh): restore per-device
+        handle layout without touching a staged batch."""
+        self._demesh()
 
     # -- eligibility ------------------------------------------------------
     def eligible(self):
@@ -181,7 +282,8 @@ class ModuleFusedStep:
 
     # -- dispatch ---------------------------------------------------------
     def step(self):
-        """Consume the staged batch with fused programs.  Returns False
+        """Consume the staged batch with fused programs.  Returns the
+        dispatch path taken ("fused" / "mesh_fused", both truthy) or False
         (after replaying the batch eagerly) when the updater state turns
         out not to be fusable, so Module.update can run the eager loop."""
         m = self._mod
@@ -200,13 +302,16 @@ class ModuleFusedStep:
                 return False
         if ndev == 1:
             self._step_single()
-        else:
-            feeds, self._pending = self._pending, None
-            if feeds is not None:
-                for ex, feed in zip(self._eg.execs, feeds):
-                    ex.forward_backward(**feed)
-            self._update_multi()
-        return True
+            return "fused"
+        if self._mesh_ok():
+            return self._step_mesh()
+        self._demesh()
+        staged, self._pending = self._pending, None
+        if staged is not None:
+            for ex, feed in zip(self._eg.execs, staged.feeds()):
+                ex.forward_backward(**feed)
+        self._update_multi()
+        return "fused"
 
     def _slots_for_device(self, ex, k, ndev):
         """Create-missing-state + count + capture per-slot scalars, in the
@@ -251,7 +356,8 @@ class ModuleFusedStep:
         m = self._mod
         opt_ = m._optimizer
         ex = self._eg.execs[0]
-        feeds, self._pending = self._pending, None
+        staged, self._pending = self._pending, None
+        feeds = staged.feeds() if staged is not None else None
         for kname, v in (feeds[0] if feeds else {}).items():
             dst = ex.arg_dict[kname]
             dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
@@ -324,6 +430,235 @@ class ModuleFusedStep:
         opt_._update_count(slot)
         return [(name, slot, opt_._get_lr(slot), opt_._get_wd(slot),
                  opt_._index_update_count[slot])]
+
+    # -- mesh (GSPMD) path ------------------------------------------------
+    def on_mesh_change(self):
+        """Module.set_mesh hook: drop the cached mesh so the next step
+        re-derives shardings (and a new step-program cache key)."""
+        self._demesh()
+        self._mesh_cache = None
+
+    def _mesh_setup(self):
+        """(mesh, rules, dp_axis) over the module's contexts, or None when
+        the context set cannot host one (duplicate devices, no dp axis,
+        axis sizes not matching the device count)."""
+        from .parallel.mesh import make_mesh
+        m = self._mod
+        axes = getattr(m, "_mesh_axes", None) or \
+            {"dp": len(self._eg.execs)}
+        rules = getattr(m, "_sharding_rules", None)
+        key = (tuple(axes.items()), id(rules))
+        if self._mesh_cache is not None and self._mesh_cache[0] == key:
+            return self._mesh_cache[1]
+        setup = None
+        if "dp" in axes:
+            devices = [c.jax_device for c in self._eg.contexts]
+            if len({d.id for d in devices}) == len(devices):
+                try:
+                    mesh = make_mesh(dict(axes), devices=devices)
+                    setup = (mesh, rules, "dp")
+                except (ValueError, TypeError):
+                    setup = None
+        self._mesh_cache = (key, setup)
+        return setup
+
+    def _mesh_ok(self):
+        """Mesh-path eligibility on top of ``eligible()``: local synced-DP
+        semantics (a local kvstore selected), a buildable mesh, and a
+        batch that shards evenly on axis 0 of every input."""
+        if not mesh_enabled():
+            return False
+        eg = self._eg
+        if len(eg.execs) <= 1 or self._mod._kvstore is None:
+            return False
+        if len({s.stop - s.start for s in eg.slices}) != 1:
+            return False
+        setup = self._mesh_setup()
+        if setup is None:
+            return False
+        mesh, _, dp = setup
+        bs = eg.batch_size
+        if bs % mesh.shape[dp] != 0:
+            return False
+        from .io import DataDesc
+        for d in list(eg.data_shapes) + list(eg.label_shapes or []):
+            if d.shape[0] != bs or \
+                    DataDesc.get_batch_axis(getattr(d, "layout", "NCHW")) != 0:
+                return False
+        return True
+
+    def _slots_for_mesh(self, ex, ndev):
+        """Per-param slot capture for the mesh step: ONE logical state per
+        param, held in the device-0 slot of the eager layout; the sibling
+        slots alias it so checkpoints (`get_states`) and the eager resume
+        path keep seeing the layout they expect.  The count advances once
+        per step — the global program IS the single update."""
+        m = self._mod
+        opt_ = m._optimizer
+        states = m._updater.states
+        out = []
+        for i, name in enumerate(m._param_names):
+            if name not in self._pset:
+                continue
+            base = opt_.slot_index(i, ndev, 0)
+            w = ex.arg_dict[name]
+            if base not in states:
+                states[base] = opt_.create_state_multi_precision(base, w)
+                m._updater.states_synced[base] = True
+            opt_._update_count(base)
+            cnt = opt_._index_update_count[base]
+            for k in range(1, ndev):
+                sib = opt_.slot_index(i, ndev, k)
+                states[sib] = states[base]
+                m._updater.states_synced[sib] = True
+                opt_._index_update_count[sib] = cnt
+            out.append((name, base, opt_._get_lr(base), opt_._get_wd(base),
+                        cnt))
+        return out
+
+    def _take_mesh(self, slot, handles, sharding):
+        """Pool-guarded donate-safe mesh placement of a set of handles that
+        must agree (all execs' views of one param).  Divergent handles —
+        some exec was written externally — disown the slot and copy."""
+        pool = self._pools[0]
+        cur = handles[0]._data
+        if any(h._data is not cur for h in handles[1:]):
+            pool.disown(slot)
+        return pool.take_sharded(slot, handles[0], sharding)
+
+    def _step_mesh(self):
+        from . import optimizer as _opt
+        from . import profiler as _profiler
+        from .ndarray.ndarray import NDArray
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        m = self._mod
+        opt_ = m._optimizer
+        eg = self._eg
+        execs = eg.execs
+        ex = execs[0]
+        ndev = len(execs)
+        mesh, rules, dp = self._mesh_setup()
+        repl = NamedSharding(mesh, P())
+        bsh = NamedSharding(mesh, P(dp))
+
+        def psh(name, shape):
+            if rules is not None:
+                return rules.sharding_for(name, shape)
+            return repl
+
+        staged, self._pending = self._pending, None
+        full = staged.full() if staged is not None else {}
+        states = m._updater.states
+        pool = self._pools[0]
+        slots = self._slots_for_mesh(ex, ndev)
+        pvals, svals = [], []
+        for name, slot, _, _, _ in slots:
+            sh = psh(name, ex.arg_dict[name].shape)
+            pvals.append(self._take_mesh(
+                ("w", name), [e.arg_dict[name] for e in execs], sh))
+            leaves = _opt.fused_state_leaves(states[slot])
+            svals.append(tuple(
+                pool.take_sharded(("s", slot, j), leaf, sh)
+                for j, leaf in enumerate(leaves)))
+        lrs = jnp.asarray([s[2] for s in slots], jnp.float32)
+        wds = jnp.asarray([s[3] for s in slots], jnp.float32)
+        ts = jnp.asarray([s[4] for s in slots], jnp.float32)
+        rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
+        batch_names = set(eg.data_names) | set(eg.label_names)
+        others, full_shapes = [], {}
+        for n in ex.arg_names:
+            if n in self._pset:
+                full_shapes[n] = ex.arg_dict[n].shape
+                continue
+            if n in batch_names:
+                v = full.get(n)
+                if v is None:       # replayed without a staged batch
+                    v = ex.arg_dict[n]._data
+                dt = ex.arg_dict[n].dtype
+                if v.dtype != dt:
+                    v = v.astype(dt)
+                others.append(jax.device_put(v, bsh))
+                full_shapes[n] = tuple(v.shape)
+            else:
+                others.append(jax.device_put(ex.arg_dict[n]._data, repl))
+                full_shapes[n] = ex.arg_dict[n].shape
+        auxs = [jax.device_put(ex.aux_dict[n]._data, repl)
+                for n in ex.aux_names]
+        plan = ex._plan(True)
+        keys = ex._keys(plan)
+        ex._last_keys = keys
+        ogs = ex._ograds_for(full_shapes)
+        pshardings = [psh(s[0], ex.arg_dict[s[0]].shape) for s in slots]
+        mesh_sig = (tuple(sorted(mesh.shape.items())),
+                    tuple(str(sh.spec) for sh in pshardings))
+        update_fns = [opt_.fused_update] * len(slots)
+        key_probe = ("step", mesh_sig) + ex._step_env()
+        first_run = key_probe not in ex._jitted
+        fn = ex.step_program([s[0] for s in slots], update_fns,
+                             mesh_sig=mesh_sig, param_shardings=pshardings)
+        with _profiler.span("Mesh::Step", "executor",
+                            args={"first_run": first_run,
+                                  "mesh": str(dict(mesh.shape))}):
+            new_p, new_s, outs, new_aux = fn(
+                pvals, svals, others, auxs, keys, ogs, lrs, wds, ts, rescale)
+        for (name, slot, _, _, _), w, st in zip(slots, new_p, new_s):
+            pool.give(("w", name), ex.arg_dict[name], w)
+            for e in execs[1:]:
+                e.arg_dict[name]._data = w
+            leaves = _opt.fused_state_leaves(states[slot])
+            for j, (leaf, arr) in enumerate(zip(leaves, st)):
+                pool.give(("s", slot, j), leaf, arr)
+        for n, v in zip(ex.aux_names, new_aux):
+            for e in execs:
+                e.aux_dict[n]._data = v
+        self._mesh_outputs = [NDArray(o, ex._ctx) for o in outs]
+        self._meshed = True
+        return "mesh_fused"
+
+    def _demesh(self):
+        """Point every exec's handles back at per-device arrays (the mesh
+        globals are sliced/re-placed onto each context's device) and split
+        the aliased mesh opt-state into genuine per-device copies, so the
+        eager per-device programs and the local-kvstore reduce can resume
+        seamlessly after any number of mesh steps."""
+        if not self._meshed:
+            return
+        from . import optimizer as _opt
+        m = self._mod
+        execs = self._eg.execs
+        ndev = len(execs)
+        pool = self._pools[0]
+        opt_ = m._optimizer
+        states = m._updater.states if m._updater is not None else {}
+        for i, name in enumerate(m._param_names):
+            if name not in self._pset:
+                continue
+            g = execs[0].arg_dict[name]._data
+            for e in execs:
+                e.arg_dict[name]._data = jax.device_put(
+                    g, e._ctx.jax_device)
+            pool.disown(("w", name))
+            base = opt_.slot_index(i, ndev, 0)
+            st = states.get(base)
+            if st is None:
+                continue
+            leaves = _opt.fused_state_leaves(st) or []
+            for j, leaf in enumerate(leaves):
+                leaf._data = jax.device_put(
+                    leaf._data, execs[0]._ctx.jax_device)
+                pool.disown(("s", base, j))
+            cnt = opt_._index_update_count.get(base)
+            for k in range(1, ndev):
+                sib = opt_.slot_index(i, ndev, k)
+                states[sib] = _copy_state_to(st, execs[k]._ctx)
+                m._updater.states_synced[sib] = True
+                if cnt is not None:
+                    opt_._index_update_count[sib] = cnt
+        for n in self._eg.aux_names:
+            g = execs[0].aux_dict[n]._data
+            for e in execs:
+                e.aux_dict[n]._data = jax.device_put(g, e._ctx.jax_device)
+        self._meshed = False
 
 
 class TrainerFusedUpdate:
@@ -418,3 +753,200 @@ class TrainerFusedUpdate:
                 for j, (leaf, arr) in enumerate(zip(leaves, st)):
                     pool.give((i, j), leaf, arr)
         return True
+
+
+def _adopt(shape, sharding, arrs):
+    """Zero-copy global from per-device committed arrays (the sources stay
+    alive; donating the adopted global deletes them)."""
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, list(arrs))
+
+
+def build_mesh_update_program(update_fns, ndev, out_sharding):
+    """Donated GSPMD update program for the Trainer mesh path.
+
+    Inputs: replicated params/opt-state globals and per-device gradients
+    adopted as ``P('dp')`` shards of a ``(ndev*d0, ...)`` global; the
+    leading-axis reshape+sum below IS the gradient all-reduce — XLA lowers
+    the reduction over the sharded axis to a collective over ICI.  Only
+    opt-state (argument 1) is donated: weights and grads were adopted
+    zero-copy from buffers the autograd tape / user code may still hold.
+    ``out_sharding`` pins outputs replicated so every device holds a full
+    shard for the per-device writeback.
+    """
+    update_fns = tuple(update_fns)
+
+    def fn(pvals, svals, gvals, lrs, wds, ts, rescale):
+        new_p, new_s = [], []
+        for i, upd in enumerate(update_fns):
+            g = gvals[i]
+            g = g.reshape((ndev, g.shape[0] // ndev) + g.shape[1:]).sum(0)
+            w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale, ts[i])
+            w = jax.lax.with_sharding_constraint(w, out_sharding)
+            s = jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(a, out_sharding),
+                s)
+            new_p.append(w)
+            new_s.append(s)
+        return new_p, new_s
+
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+class TrainerMeshUpdate:
+    """Mesh-native reduce+update phase for gluon.Trainer on local
+    multi-device: per-device weight replicas and raw (un-reduced) gradient
+    buffers are adopted zero-copy into globals over a ``dp`` mesh, and ONE
+    GSPMD program does the gradient all-reduce plus every optimizer update
+    — replacing the host-side KVStore push/pull reduce and the per-device
+    update programs entirely.
+
+    Update-count semantics follow the single-device step (one logical
+    update per param per step), unlike the eager multi-device loop whose
+    shared optimizer advances the count once per (param, device) visit.
+    """
+
+    def __init__(self, trainer):
+        self._tr = trainer
+        self._pools = [DonationPool() for _ in trainer._contexts]
+        self._programs = {}
+        self._unsupported = False
+        self._mesh = None          # None = unprobed, False = cannot build
+        self._devids = [c.jax_device.id for c in trainer._contexts]
+
+    def _mesh_setup(self):
+        from .parallel.mesh import make_mesh
+        if self._mesh is None:
+            devices = [c.jax_device for c in self._tr._contexts]
+            if len({d.id for d in devices}) != len(devices):
+                self._mesh = False
+            else:
+                self._mesh = make_mesh({"dp": len(devices)},
+                                       devices=devices)
+        return self._mesh or None
+
+    def eligible(self):
+        if not enabled() or not mesh_enabled() or self._unsupported:
+            return False
+        tr = self._tr
+        if len(tr._contexts) <= 1 or tr._update_on_kvstore:
+            return False
+        kv = tr._kvstore
+        # a local kvstore signals synced-DP semantics (the reduce we fuse
+        # in-program); no kvstore means intentionally unsynced replicas
+        if kv is None or kv.type.startswith("dist") \
+                or getattr(kv, "_updater", None) is not None \
+                or getattr(kv, "_compression", None) is not None:
+            return False
+        opt_ = tr._optimizer
+        if opt_.fused_state_arity() is None:
+            return False
+        for p in tr._params:
+            if p.grad_req == "null":
+                continue
+            if getattr(p, "_stype", "default") != "default" or \
+                    getattr(p, "_grad_stype", "default") != "default":
+                return False
+            w0 = p.list_data()[0]
+            if not opt_.supports_fused(w0) or len(w0.shape) == 0:
+                return False
+        return self._mesh_setup() is not None
+
+    def step(self):
+        from . import optimizer as _opt
+        from . import profiler as _profiler
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tr = self._tr
+        opt_ = tr._optimizer
+        mesh = self._mesh_setup()
+        ndev = len(tr._contexts)
+        live = [(i, p) for i, p in enumerate(tr._params)
+                if p.grad_req != "null"]
+        if not live:
+            return True
+        arity = opt_.fused_state_arity()
+        repl = NamedSharding(mesh, P())
+        gsh = NamedSharding(mesh, P("dp"))
+        # validate/create every state BEFORE any adoption: a donation-bound
+        # program must never launch with half-captured inputs
+        for i, p in live:
+            for k, upd in enumerate(tr._updaters):
+                if i not in upd.states:
+                    upd.states[i] = opt_.create_state_multi_precision(
+                        i, p.list_data()[k])
+                    upd.states_synced[i] = True
+                leaves = _opt.fused_state_leaves(upd.states[i])
+                if leaves is None or len(leaves) != arity:
+                    self._unsupported = True
+                    return False
+        pvals, svals, gvals, lrs, wds, ts = [], [], [], [], [], []
+        try:
+            for i, p in live:
+                datas = [d._data for d in p.list_data()]
+                grads = [g._data for g in p.list_grad()]
+                pvals.append(_adopt(datas[0].shape, repl, datas))
+                per_leaf = []
+                for j in range(arity):
+                    leaves_k = [_opt.fused_state_leaves(
+                        tr._updaters[k].states[i])[j] for k in range(ndev)]
+                    per_leaf.append(self._take_state((i, j), leaves_k, repl))
+                svals.append(tuple(per_leaf))
+                gshape = (ndev * grads[0].shape[0],) + grads[0].shape[1:]
+                gvals.append(_adopt(gshape, gsh, grads))
+        except (ValueError, TypeError):
+            # adoption needs committed per-device buffers of equal shape;
+            # anything else (uncommitted arrays, ragged replicas) falls
+            # back to the per-device fused path for good
+            self._unsupported = True
+            return False
+        for i, p in live:
+            # one LOGICAL update per param per step: the global program IS
+            # the single update (single-device count semantics)
+            opt_._update_count(i)
+            lrs.append(opt_._get_lr(i))
+            wds.append(opt_._get_wd(i))
+            ts.append(opt_._index_update_count[i])
+        env = _env_tuple()
+        key = (env, tuple(sorted(mesh.shape.items())), len(live))
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = build_mesh_update_program(
+                [opt_.fused_update] * len(live), ndev, repl)
+            self._programs[key] = fn
+        with _profiler.span("Mesh::Step", "executor",
+                            args={"path": "trainer",
+                                  "mesh": str(dict(mesh.shape))}):
+            new_p, new_s = fn(
+                pvals, svals, gvals,
+                jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+                jnp.asarray(ts, jnp.float32),
+                jnp.asarray(opt_.rescale_grad, jnp.float32))
+        for (i, p), w, st in zip(live, new_p, new_s):
+            self._scatter(p.list_data(), w)
+            for j in range(arity):
+                leaves_k = [_opt.fused_state_leaves(
+                    tr._updaters[k].states[i])[j] for k in range(ndev)]
+                self._scatter_state((i, j), leaves_k, st[j])
+        return True
+
+    def _take_state(self, slot, leaves_k, sharding):
+        """Opt-state global for donation: zero-copy adoption of the
+        per-device leaves when every pool owns its device's buffer, else a
+        genuine copy of device-0's value (the writeback re-syncs all
+        devices)."""
+        datas = [leaf._data for leaf in leaves_k]
+        if all(self._pools[k]._own.get(slot) is datas[k]
+               for k in range(len(datas))):
+            return _adopt(datas[0].shape, sharding, datas)
+        return jax.device_put(jnp.array(datas[0]), sharding)
+
+    def _scatter(self, handles, global_arr):
+        """Write a replicated program output back as per-device arrays."""
+        shards = {s.device.id: s.data for s in global_arr.addressable_shards}
+        for k, h in enumerate(handles):
+            h._data = shards[self._devids[k]]
+
+    def _scatter_state(self, slot, leaves_k, global_arr):
+        shards = {s.device.id: s.data for s in global_arr.addressable_shards}
+        for k, leaf in enumerate(leaves_k):
+            self._pools[k].give(slot, leaf, shards[self._devids[k]])

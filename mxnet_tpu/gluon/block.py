@@ -521,13 +521,18 @@ class HybridBlock(Block):
         if isinstance(x, NDArray):
             if self._active:
                 return self._call_cached_op(x, *args)
+            # resolve the replica on the INPUT's context (reference
+            # gluon/block.py semantics) — multi-device training runs one
+            # forward per context over the same block
             try:
-                params = {i: j.data() for i, j in self._reg_params.items()}
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
                 for _, i in self.params.items():
                     i._finish_deferred_init()
-                params = {i: j.data() for i, j in self._reg_params.items()}
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
             return self.hybrid_forward(ndarray, x, *args, **params)
         if not isinstance(x, Symbol):
             raise ValueError(

@@ -57,6 +57,7 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kvstore_name = kvstore
         self._fused_update = None
+        self._mesh_update = None
 
     def _check_contexts(self):
         contexts = None
@@ -134,10 +135,28 @@ class Trainer:
         self._optimizer.lr = lr
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Make one optimization step: allreduce grads then update."""
+        """Make one optimization step: allreduce grads then update.
+
+        On local multi-device with MXNET_TPU_MESH_STEP (default ON) the
+        two phases fuse into ONE GSPMD program over a ``dp`` mesh — raw
+        per-device gradients are adopted zero-copy as batch shards and XLA
+        inserts the all-reduce — so the host-side kvstore push/pull never
+        runs; the KVStore remains the cross-host transport only."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._mesh_update is None:
+            self._mesh_update = _fused.TrainerMeshUpdate(self)
+        mu = self._mesh_update
+        if mu.eligible():
+            tel = _telemetry.enabled
+            t0 = time.perf_counter() if tel else 0.0
+            if mu.step():
+                if tel:
+                    _fused.STEP_DISPATCH.labels(path="mesh_fused").inc()
+                    _fused.STEP_TIME.observe(time.perf_counter() - t0)
+                    _STEPS.inc()
+                return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         if _telemetry.enabled:

@@ -63,10 +63,19 @@ class DataBatch:
 
 
 class DataIter:
-    """Base iterator (ref io.py:DataIter)."""
+    """Base iterator (ref io.py:DataIter).
 
-    def __init__(self, batch_size=0):
+    ``sharding`` is the mesh-training hook: a ``jax.sharding.Sharding``
+    for the produced batch (typically ``NamedSharding(mesh, P('dp'))``).
+    Iterators that honor it land batches on device pre-sharded, so the
+    train step never pays a host→device placement on its critical path;
+    see ``parallel.mesh.host_shard_hint`` for the multi-host
+    ``(rank, nranks)`` counterpart.
+    """
+
+    def __init__(self, batch_size=0, sharding=None):
         self.batch_size = batch_size
+        self.sharding = sharding
 
     def __iter__(self):
         return self
@@ -102,6 +111,12 @@ class DataIter:
         return 0
 
 
+def _part_rows(v, rank, nranks):
+    """Contiguous row block of `v` for one of `nranks` loading hosts."""
+    n = v.shape[0]
+    return v[n * rank // nranks: n * (rank + 1) // nranks]
+
+
 def _init_data(data, allow_empty, default_name):
     if data is None:
         data = []
@@ -128,10 +143,24 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
-        super().__init__(batch_size)
+                 label_name="softmax_label", num_parts=1, part_index=0,
+                 sharding=None):
+        super().__init__(batch_size, sharding=sharding)
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
+        # per-host sharded loading (parallel.mesh.host_shard_hint): this
+        # process keeps only its contiguous 1/num_parts row block, so a
+        # multi-host mesh never decodes the full global batch per host
+        if not 0 <= part_index < num_parts:
+            raise MXNetError("part_index %d out of range for num_parts %d"
+                             % (part_index, num_parts))
+        self.num_parts = num_parts
+        self.part_index = part_index
+        if num_parts > 1:
+            self.data = [(k, _part_rows(v, part_index, num_parts))
+                         for k, v in self.data]
+            self.label = [(k, _part_rows(v, part_index, num_parts))
+                          for k, v in self.label]
         self.num_data = self.data[0][1].shape[0]
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
@@ -291,13 +320,13 @@ class PrefetchingIter(DataIter):
     iter_prefetcher.h double buffering)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, sharding=None):
         if not isinstance(iters, list):
             iters = [iters]
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        super().__init__(iters[0].batch_size)
+        super().__init__(iters[0].batch_size, sharding=sharding)
         self.current_batch = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
@@ -336,6 +365,17 @@ class PrefetchingIter(DataIter):
                 continue
         return False
 
+    def _place(self, arr):
+        """Land a batch array against the mesh batch sharding on the
+        producer thread, so the consumer-side step finds it pre-sharded."""
+        import jax
+        data = getattr(arr, "_data", None)
+        if data is None:
+            return arr
+        if getattr(data, "sharding", None) != self.sharding:
+            arr._data = jax.device_put(data, self.sharding)
+        return arr
+
     def _producer(self):
         while not self._stop.is_set():
             try:
@@ -345,6 +385,9 @@ class PrefetchingIter(DataIter):
                 return
             data = sum((b.data for b in batches), [])
             label = sum((b.label for b in batches), [])
+            if self.sharding is not None:
+                data = [self._place(a) for a in data]
+                label = [self._place(a) for a in label]
             if not self._put(DataBatch(data, label, pad=batches[0].pad)):
                 return
 
@@ -379,8 +422,9 @@ class PrefetchingIter(DataIter):
             return self._queue.get()
         t0 = time.perf_counter()
         batch = self._queue.get()
-        _IO_WAIT.labels(iter="PrefetchingIter").observe(
-            time.perf_counter() - t0)
+        label = "PrefetchingIter.mesh" if self.sharding is not None \
+            else "PrefetchingIter"
+        _IO_WAIT.labels(iter=label).observe(time.perf_counter() - t0)
         return batch
 
     def __next__(self):

@@ -32,7 +32,8 @@ class Module(BaseModule):
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 compression_params=None, mesh_axes=None,
+                 sharding_rules=None):
         super().__init__(logger)
         if context is None:
             context = [current_context()]
@@ -59,6 +60,21 @@ class Module(BaseModule):
         self._grad_req = "write"
         self._group2ctxs = group2ctxs
         self._fused_step = None
+        # mesh layout for the GSPMD multi-device fused step: axis sizes
+        # (e.g. {"dp": 4, "tp": 2}; default pure-DP over all contexts) and
+        # optional parallel.mesh.ShardingRules for the params
+        self._mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self._sharding_rules = sharding_rules
+
+    def set_mesh(self, mesh_axes, sharding_rules=None):
+        """Select the device-mesh layout (axis-name → size) and optional
+        parameter ShardingRules for the multi-device fused step.  Takes
+        effect on the next update(); the step program is re-specialised
+        (new jit-cache key) for the new layout."""
+        self._mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self._sharding_rules = sharding_rules
+        if self._fused_step is not None:
+            self._fused_step.on_mesh_change()
 
     # ---- info -----------------------------------------------------------
     @property
@@ -231,8 +247,12 @@ class Module(BaseModule):
         fs = self._fused()
         if fs is not None and fs.eligible():
             # defer: update() fuses this batch's fwd+bwd with the
-            # optimizer update into one donated XLA program
-            fs.flush_eager()
+            # optimizer update into one donated XLA program.  Only an
+            # un-consumed previous batch forces an eager replay — an
+            # unconditional flush would also de-mesh between every pair
+            # of mesh steps, breaking the donation chain
+            if fs.pending:
+                fs.flush_eager()
             fs.stage(data_batch)
             return
         if fs is not None:
@@ -251,11 +271,13 @@ class Module(BaseModule):
         tel = _telemetry.enabled
         t0 = time.perf_counter() if tel else 0.0
         fs = self._fused()
-        if fs is not None and fs.pending and fs.eligible() and fs.step():
-            if tel:
-                _fused.STEP_DISPATCH.labels(path="fused").inc()
-                _fused.STEP_TIME.observe(time.perf_counter() - t0)
-            return
+        if fs is not None and fs.pending and fs.eligible():
+            path = fs.step()
+            if path:
+                if tel:
+                    _fused.STEP_DISPATCH.labels(path=path).inc()
+                    _fused.STEP_TIME.observe(time.perf_counter() - t0)
+                return
         if fs is not None:
             fs.flush_eager()
         eg = self._exec_group
@@ -294,6 +316,12 @@ class Module(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         fs = self._fused()
         if fs is not None:
+            outs = fs.mesh_outputs()
+            if outs is not None:
+                # the mesh step produced full-batch outputs directly — no
+                # per-device concat needed (and the per-exec outputs are
+                # stale, the program never ran per device)
+                return outs if merge_multi_context else [[o] for o in outs]
             fs.flush_eager()
         return self._exec_group.get_outputs(merge_multi_context)
 
@@ -306,11 +334,20 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         fs = self._fused()
         if fs is not None:
+            outs = fs.mesh_outputs()
+            if outs is not None:
+                eval_metric.update(list(labels), outs)
+                return
             fs.flush_eager()
         self._exec_group.update_metric(eval_metric, labels)
 
     def get_params(self):
         assert self.binded and self.params_initialized
+        fs = self._fused()
+        if fs is not None:
+            # mesh globals back to per-device replicas so the averaging
+            # below never mixes 8-device and single-device commitments
+            fs.demesh()
         arg, aux = {}, {}
         self._exec_group.get_params(arg, aux)
         return arg, aux

@@ -101,6 +101,9 @@ class DataParallelTrainer:
         self.moms: Dict[str, Any] = {}
         self.aux: Dict[str, Any] = {}
         self._step = None
+        # the batch sharding never changes for a trainer: build it once
+        # instead of per step
+        self._batch_sharding = NamedSharding(mesh, P(dp_axis))
 
     # -- initialization ---------------------------------------------------
     def init_params(self, initializer=None, **data_shapes):
@@ -178,14 +181,19 @@ class DataParallelTrainer:
 
     def step(self, batch: Dict[str, Any]):
         from .. import random as _random
-        from ..ndarray.ndarray import NDArray
         if self._step is None:
             self._step = self._build_step()
-        bsh = NamedSharding(self.mesh, P(self.dp_axis))
+        bsh = self._batch_sharding
         b = {}
         for k, v in batch.items():
-            data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            b[k] = jax.device_put(data, bsh)
+            # adopt device-resident NDArrays directly — no asnumpy host
+            # bounce; host values upload once here
+            data = getattr(v, "_data", None)
+            if data is None:
+                data = jnp.asarray(v)
+            if getattr(data, "sharding", None) != bsh:
+                data = jax.device_put(data, bsh)
+            b[k] = data
         keys = jnp.stack([_random.next_key()
                           for _ in range(max(1, self._plan.n_rng))])
         self.params, self.moms, self.aux, loss = \

@@ -13,7 +13,21 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding",
-           "ShardingRules", "P"]
+           "ShardingRules", "megatron_rules", "host_shard_hint", "P"]
+
+
+def host_shard_hint(mesh: Optional[Mesh] = None,
+                    axis: str = "dp") -> Tuple[int, int]:
+    """(rank, nranks) hint for per-host sharded data loading.
+
+    Each process of a multi-host mesh should decode only the slice of the
+    global batch that lands on its local devices; feeding this tuple to
+    ``io.NDArrayIter(num_parts=nranks, part_index=rank)`` (or any reader
+    honoring the same contract) does exactly that.  On a single-host mesh
+    this is (0, 1): the host decodes everything and ``jax.device_put``
+    against the batch sharding splits it across local chips.
+    """
+    return int(jax.process_index()), int(jax.process_count())
 
 
 def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
