@@ -25,6 +25,7 @@ from .base import MXNetError, AttrDict
 from .context import Context
 from . import random as _random
 from . import telemetry as _telemetry
+from . import health as _health
 
 __all__ = ["Executor"]
 
@@ -549,8 +550,14 @@ class Executor:
                     monitor=self._monitor)
                 new_aux = [new_aux[n] for n in self.aux_names]
             else:
-                outs, new_aux = self._fwd_fn(bool(is_train))(*self._gather(),
-                                                             keys)
+                fwd = self._fwd_fn(bool(is_train))
+                args, auxs = self._gather()
+                if first_run and _health.enabled:
+                    # lowering-only analysis: the call below still owns
+                    # the one and only compilation
+                    _health.register_program("forward", fwd,
+                                             (args, auxs, keys))
+                outs, new_aux = fwd(args, auxs, keys)
         if is_train:
             self._writeback_aux(new_aux)
         return self._wrap_outputs(outs)
@@ -578,7 +585,11 @@ class Executor:
         with _profiler.span("Executor::Backward", "executor",
                             histogram=_BWD_TIME,
                             args={"first_run": first_run}):
-            outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+            fb = self._fwd_bwd_fn()
+            if first_run and _health.enabled:
+                _health.register_program("fwdbwd", fb,
+                                         (args, auxs, keys, ogs))
+            outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._apply_grads(grads)
         return
 
@@ -604,7 +615,11 @@ class Executor:
         with _profiler.span("Executor::ForwardBackward", "executor",
                             histogram=_FWDBWD_TIME,
                             args={"first_run": first_run}):
-            outs, new_aux, grads = self._fwd_bwd_fn()(args, auxs, keys, ogs)
+            fb = self._fwd_bwd_fn()
+            if first_run and _health.enabled:
+                _health.register_program("fwdbwd", fb,
+                                         (args, auxs, keys, ogs))
+            outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._writeback_aux(new_aux)
             self._apply_grads(grads)
         return self._wrap_outputs(outs)

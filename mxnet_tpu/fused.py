@@ -182,9 +182,28 @@ class FusedTrainer:
                               for _ in range(self._plan.n_rng)])
         else:
             keys = self._keys
+        from . import health as _health
+        first_health = (_health.enabled
+                        and not getattr(self, "_health_registered", False))
+        donated_in = None
+        if first_health:
+            # lowering-only analysis: no compile, the dispatch below still
+            # owns the one and only compilation of this program
+            self._health_registered = True
+            _health.register_program(
+                "fused_trainer_step", self._jstep,
+                (args, auxs, moms, d, l, jnp.float32(self._lr), keys),
+                donated=True)
+            donated_in = (args, auxs, moms)
         args, auxs, moms, loss = self._jstep(
             args, auxs, moms, d, l, jnp.float32(self._lr), keys)
+        if donated_in is not None:
+            # runtime donation audit: the old state buffers must now be
+            # invalidated, or the in-place chain silently broke
+            _health.audit_donation("fused_trainer_step", donated_in)
         self._state = (args, auxs, moms)
+        if _health.enabled:
+            _health.monitor.on_step("fused_trainer_step")
         ctx = data.context if isinstance(data, NDArray) else None
         return NDArray(loss, ctx)
 

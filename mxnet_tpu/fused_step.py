@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from . import telemetry as _telemetry
+from . import health as _health
 
 __all__ = ["enabled", "mesh_enabled", "ModuleFusedStep",
            "TrainerFusedUpdate", "TrainerMeshUpdate", "DonationPool",
@@ -375,10 +376,18 @@ class ModuleFusedStep:
         update_fns = [opt_.fused_update] * len(slots)
         first_run = ("step",) + ex._step_env() not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns)
+        if first_run and _health.enabled:
+            # lowering-only analysis — the dispatch below still owns the
+            # one and only compilation of this program
+            _health.register_program(
+                "step", fn, (pvals, svals, others, auxs, keys, ogs, lrs,
+                             wds, ts, rescale), donated=True)
         with _profiler.span("Executor::FusedStep", "executor",
                             args={"first_run": first_run}):
             new_p, new_s, outs, new_aux = fn(
                 pvals, svals, others, auxs, keys, ogs, lrs, wds, ts, rescale)
+        if first_run and _health.enabled:
+            _health.audit_donation("step", (pvals, svals))
         self._writeback(ex, 0, slots, new_p, new_s)
         ex._writeback_aux(new_aux)
         ex._wrap_outputs(outs)
@@ -411,9 +420,16 @@ class ModuleFusedStep:
                 else:
                     gvals.append([ex.grad_dict[name]._data])
             rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
+            first_run = ("update",) + ex._step_env() not in ex._jitted
             fn = ex.update_program([opt_.fused_update] * len(slots))
+            if first_run and k == 0 and _health.enabled:
+                _health.register_program(
+                    "update", fn, (pvals, svals, gvals, lrs, wds, ts,
+                                   rescale), donated=True)
             with _profiler.span("Executor::FusedUpdate", "executor"):
                 new_p, new_s = fn(pvals, svals, gvals, lrs, wds, ts, rescale)
+            if first_run and k == 0 and _health.enabled:
+                _health.audit_donation("update", (pvals, svals))
             self._writeback(ex, k, slots, new_p, new_s)
 
     def _slots_for_device_one(self, ex, i, k, ndev):
@@ -596,11 +612,17 @@ class ModuleFusedStep:
         first_run = key_probe not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns,
                              mesh_sig=mesh_sig, param_shardings=pshardings)
+        if first_run and _health.enabled:
+            _health.register_program(
+                "mesh_step", fn, (pvals, svals, others, auxs, keys, ogs,
+                                  lrs, wds, ts, rescale), donated=True)
         with _profiler.span("Mesh::Step", "executor",
                             args={"first_run": first_run,
                                   "mesh": str(dict(mesh.shape))}):
             new_p, new_s, outs, new_aux = fn(
                 pvals, svals, others, auxs, keys, ogs, lrs, wds, ts, rescale)
+        if first_run and _health.enabled:
+            _health.audit_donation("mesh_step", (pvals, svals))
         for (name, slot, _, _, _), w, st in zip(slots, new_p, new_s):
             pool.give(("w", name), ex.arg_dict[name], w)
             for e in execs[1:]:
@@ -733,11 +755,20 @@ class TrainerFusedUpdate:
         rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
         env = _env_tuple()
         fn = self._programs.get(env)
+        first_run = fn is None
         if fn is None:
             from .executor import build_update_program
             fn = build_update_program([opt_.fused_update] * len(live),
                                       donate_params=False)
             self._programs[env] = fn
+        if first_run and _health.enabled and per_dev:
+            d0 = per_dev[0]
+            _health.register_program(
+                "trainer_update", fn,
+                (d0["p"], d0["s"], d0["g"],
+                 jnp.asarray(d0["lr"], jnp.float32),
+                 jnp.asarray(d0["wd"], jnp.float32),
+                 jnp.asarray(d0["t"], jnp.float32), rescale), donated=True)
         for k in range(ncty):
             d = per_dev[k]
             with _profiler.span("Trainer::FusedUpdate", "executor"):
@@ -746,6 +777,9 @@ class TrainerFusedUpdate:
                     jnp.asarray(d["lr"], jnp.float32),
                     jnp.asarray(d["wd"], jnp.float32),
                     jnp.asarray(d["t"], jnp.float32), rescale)
+            if first_run and k == 0 and _health.enabled:
+                # only opt-state is donated here (donate_params=False)
+                _health.audit_donation("trainer_update", d["s"])
             pool = self._pools[k]
             for (i, p), w, st in zip(live, new_p, new_s):
                 p.list_data()[k]._data = w
@@ -909,10 +943,18 @@ class TrainerMeshUpdate:
         env = _env_tuple()
         key = (env, tuple(sorted(mesh.shape.items())), len(live))
         fn = self._programs.get(key)
+        first_run = fn is None
         if fn is None:
             fn = build_mesh_update_program(
                 [opt_.fused_update] * len(live), ndev, repl)
             self._programs[key] = fn
+        if first_run and _health.enabled:
+            _health.register_program(
+                "trainer_mesh_update", fn,
+                (pvals, svals, gvals,
+                 jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+                 jnp.asarray(ts, jnp.float32),
+                 jnp.asarray(opt_.rescale_grad, jnp.float32)), donated=True)
         with _profiler.span("Mesh::Step", "executor",
                             args={"path": "trainer",
                                   "mesh": str(dict(mesh.shape))}):
@@ -921,6 +963,10 @@ class TrainerMeshUpdate:
                 jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
                 jnp.asarray(ts, jnp.float32),
                 jnp.asarray(opt_.rescale_grad, jnp.float32))
+        if first_run and _health.enabled:
+            # only opt-state is donated here (weights/grads were adopted
+            # zero-copy from buffers user code may still hold)
+            _health.audit_donation("trainer_mesh_update", svals)
         for (i, p), w, st in zip(live, new_p, new_s):
             self._scatter(p.list_data(), w)
             for j in range(arity):

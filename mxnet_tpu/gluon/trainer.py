@@ -18,6 +18,7 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from .. import telemetry as _telemetry
 from .. import fused_step as _fused
+from .. import health as _health
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
@@ -156,11 +157,15 @@ class Trainer:
                     _fused.STEP_DISPATCH.labels(path="mesh_fused").inc()
                     _fused.STEP_TIME.observe(time.perf_counter() - t0)
                     _STEPS.inc()
+                if _health.enabled:
+                    _health.monitor.on_step("trainer_mesh_update")
                 return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         if _telemetry.enabled:
             _STEPS.inc()
+        if _health.enabled:
+            _health.monitor.on_step("trainer_update")
 
     def allreduce_grads(self):
         """Reduce gradients over devices only (then call update())."""
@@ -189,6 +194,9 @@ class Trainer:
                 self._kvstore.pull(live, out=grads)
         if tel:
             _SYNC_LAT.observe(time.perf_counter() - t0)
+            if _health.enabled:
+                _health.monitor.note_phase(
+                    "sync", time.perf_counter() - t0)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Update parameters only (after allreduce_grads)."""

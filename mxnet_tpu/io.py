@@ -24,6 +24,7 @@ import numpy as np
 from .base import MXNetError
 from . import ndarray as nd
 from . import telemetry as _telemetry
+from . import health as _health
 from .ndarray.ndarray import NDArray
 
 _IO_BATCHES = _telemetry.counter(
@@ -424,7 +425,10 @@ class PrefetchingIter(DataIter):
         batch = self._queue.get()
         label = "PrefetchingIter.mesh" if self.sharding is not None \
             else "PrefetchingIter"
-        _IO_WAIT.labels(iter=label).observe(time.perf_counter() - t0)
+        wait = time.perf_counter() - t0
+        _IO_WAIT.labels(iter=label).observe(wait)
+        if _health.enabled:
+            _health.monitor.note_phase("input", wait)
         return batch
 
     def __next__(self):
@@ -793,8 +797,10 @@ class ImageRecordIter(DataIter):
         if tel:
             t0 = time.perf_counter()
             batch = self._queue.get()
-            _IO_WAIT.labels(iter="ImageRecordIter").observe(
-                time.perf_counter() - t0)
+            wait = time.perf_counter() - t0
+            _IO_WAIT.labels(iter="ImageRecordIter").observe(wait)
+            if _health.enabled:
+                _health.monitor.note_phase("input", wait)
         else:
             batch = self._queue.get()
         if batch is None:

@@ -32,6 +32,7 @@ from .ndarray.ndarray import NDArray
 from . import optimizer as opt
 from . import telemetry as _telemetry
 from . import tracing as _tracing
+from . import health as _health
 
 __all__ = ["KVStore", "create"]
 
@@ -159,6 +160,9 @@ class KVStore:
             _KV_PUSH.labels(type=self.kind).inc(len(keys))
             _KV_PUSH_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)
+            if _health.enabled:
+                _health.monitor.note_phase(
+                    "sync", _time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         tel = _telemetry.enabled
@@ -175,6 +179,9 @@ class KVStore:
             _KV_PULL.labels(type=self.kind).inc(len(keys))
             _KV_PULL_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)
+            if _health.enabled:
+                _health.monitor.note_phase(
+                    "sync", _time.perf_counter() - t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore_local.h:109-247);
@@ -441,11 +448,19 @@ class DistAsyncKVStore(KVStore):
         return reply[1] if len(reply) > 1 else None
 
     def _roundtrip(self, msg, trace_ctx):
+        health_ctx = None
+        if _health.enabled:
+            # piggyback this worker's latest step time on the wire header
+            # (trace-context pattern) for the server's straggler table
+            st = _health.monitor.last_step_seconds()
+            if st is not None:
+                health_ctx = {"r": str(self._rank), "st": float(st)}
         with self._lock:
             # positional-compatible call when untraced: tests (and any
             # wrapper) may substitute a two-argument send_msg
-            if trace_ctx:
-                self._ps.send_msg(self._sock, msg, trace_ctx=trace_ctx)
+            if trace_ctx or health_ctx:
+                self._ps.send_msg(self._sock, msg, trace_ctx=trace_ctx,
+                                  health_ctx=health_ctx)
             else:
                 self._ps.send_msg(self._sock, msg)
             return self._ps.recv_msg(self._sock)
@@ -522,6 +537,9 @@ class DistAsyncKVStore(KVStore):
             _KV_PUSH.labels(type=self.kind).inc(len(keys))
             _KV_PUSH_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)
+            if _health.enabled:
+                _health.monitor.note_phase(
+                    "sync", _time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         tel = _telemetry.enabled
@@ -579,6 +597,9 @@ class DistAsyncKVStore(KVStore):
             _KV_PULL.labels(type=self.kind).inc(len(keys))
             _KV_PULL_LAT.labels(type=self.kind).observe(
                 _time.perf_counter() - t0)
+            if _health.enabled:
+                _health.monitor.note_phase(
+                    "sync", _time.perf_counter() - t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Fetch only the requested rows from the server (reference

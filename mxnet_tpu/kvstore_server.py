@@ -126,18 +126,26 @@ def _decode(node, blobs):
     return node
 
 
-def send_msg(sock: socket.socket, obj: Any, trace_ctx: Optional[dict] = None):
+def send_msg(sock: socket.socket, obj: Any, trace_ctx: Optional[dict] = None,
+             health_ctx: Optional[dict] = None):
     """Frame: <Q total><I header_len><header json><I nblobs>(<Q len><raw>)*
 
-    Without ``trace_ctx`` the header is the encoded message list — the
-    original wire format, byte-identical.  With one, the header becomes
-    ``{"m": <encoded list>, "tc": {"t": trace_id, "s": span_id}}`` so the
-    receiving handler span can adopt the sender's trace (Dapper-style
-    propagation); old receivers never see it unless tracing is on."""
+    Without ``trace_ctx``/``health_ctx`` the header is the encoded message
+    list — the original wire format, byte-identical.  With a trace context
+    the header becomes ``{"m": <encoded list>, "tc": {"t": trace_id,
+    "s": span_id}}`` so the receiving handler span can adopt the sender's
+    trace (Dapper-style propagation); ``health_ctx`` rides the same wrapper
+    as ``"h": {"r": rank, "st": step_seconds}`` feeding the server's
+    per-worker straggler table.  Old receivers never see the wrapper unless
+    tracing or health is on."""
     blobs: list = []
     node: Any = _encode(list(obj), blobs)
-    if trace_ctx:
-        node = {"m": node, "tc": dict(trace_ctx)}
+    if trace_ctx or health_ctx:
+        node = {"m": node}
+        if trace_ctx:
+            node["tc"] = dict(trace_ctx)
+        if health_ctx:
+            node["h"] = dict(health_ctx)
     header = json.dumps(node).encode()
     parts = [struct.pack("<I", len(header)), header,
              struct.pack("<I", len(blobs))]
@@ -235,12 +243,41 @@ def _check_trace_ctx(tc):
     return tc
 
 
-def recv_msg_tc(sock: socket.socket):
-    """Receive one message plus its optional trace context.
+# health-context bounds: rank is a small decimal string, step time a
+# non-negative finite number — anything else is a malformed frame
+_HC_KEYS = frozenset(("r", "st"))
+_HC_MAX_RANK_LEN = 16
+_HC_MAX_STEP_SECONDS = 1e6
 
-    Returns ``(msg, tc)`` where ``tc`` is ``{"t":..., "s":...}`` or None
-    (old-format frames, whose header is the bare message list, keep
-    parsing unchanged), or None on clean EOF."""
+
+def _check_health_ctx(hc):
+    """Validate an incoming wire health context (loud-reject, like the
+    trace context and bucket metadata above)."""
+    if not isinstance(hc, dict):
+        _frame_error("health context is not an object")
+    unknown = set(hc) - _HC_KEYS
+    if unknown:
+        _frame_error("unknown health-context keys %s" % sorted(unknown))
+    if set(hc) != _HC_KEYS:
+        _frame_error("health context missing fields")
+    r = hc["r"]
+    if not isinstance(r, str) or not r or len(r) > _HC_MAX_RANK_LEN \
+            or not r.isdigit():
+        _frame_error("health-context rank %r malformed" % (r,))
+    st = hc["st"]
+    if not isinstance(st, (int, float)) or isinstance(st, bool) \
+            or not (0.0 <= float(st) < _HC_MAX_STEP_SECONDS):
+        _frame_error("health-context step time %r out of bounds" % (st,))
+    return {"r": r, "st": float(st)}
+
+
+def recv_msg_full(sock: socket.socket):
+    """Receive one message plus its optional trace and health contexts.
+
+    Returns ``(msg, tc, hc)`` where ``tc`` is ``{"t":..., "s":...}`` or
+    None and ``hc`` is ``{"r":..., "st":...}`` or None (old-format frames,
+    whose header is the bare message list, keep parsing unchanged), or
+    None on clean EOF."""
     header = _recv_exact(sock, 8)
     if header is None:
         return None
@@ -255,18 +292,20 @@ def recv_msg_tc(sock: socket.socket):
         _frame_error("header length %d overruns %d-byte frame"
                      % (hlen, len(payload)))
     hdr = json.loads(payload[4:4 + hlen].decode())
-    tc = None
+    tc = hc = None
     if isinstance(hdr, dict):
-        # traced framing: {"m": message, "tc": {...}} — the message list
-        # itself is always a JSON array at top level, so a dict here can
-        # only be the trace wrapper
-        unknown = set(hdr) - {"m", "tc"}
+        # wrapped framing: {"m": message, "tc": {...}, "h": {...}} — the
+        # message list itself is always a JSON array at top level, so a
+        # dict here can only be the context wrapper
+        unknown = set(hdr) - {"m", "tc", "h"}
         if unknown:
             _frame_error("unknown header keys %s" % sorted(unknown))
         if "m" not in hdr:
             _frame_error("traced header missing message body")
         if hdr.get("tc") is not None:
             tc = _check_trace_ctx(hdr["tc"])
+        if hdr.get("h") is not None:
+            hc = _check_health_ctx(hdr["h"])
         hdr = hdr["m"]
     off = 4 + hlen
     (nblobs,) = struct.unpack_from("<I", payload, off)
@@ -286,12 +325,20 @@ def recv_msg_tc(sock: socket.socket):
     if off != len(payload):
         _frame_error("%d trailing bytes after last blob"
                      % (len(payload) - off))
-    return _decode(hdr, blobs), tc
+    return _decode(hdr, blobs), tc, hc
+
+
+def recv_msg_tc(sock: socket.socket):
+    """Receive one message plus its optional trace context — the original
+    2-tuple API (existing callers and tests rely on its shape); any health
+    context on the frame is validated then dropped."""
+    got = recv_msg_full(sock)
+    return None if got is None else (got[0], got[1])
 
 
 def recv_msg(sock: socket.socket):
     """Receive one message, dropping any trace context (original API)."""
-    got = recv_msg_tc(sock)
+    got = recv_msg_full(sock)
     return None if got is None else got[0]
 
 
@@ -335,7 +382,7 @@ class KVStoreServer:
             def handle(self):
                 while True:
                     try:
-                        got = recv_msg_tc(self.request)
+                        got = recv_msg_full(self.request)
                     except Exception as e:
                         # a malformed frame (old wire format, framing bug,
                         # bad blob index) answers with a diagnostic instead
@@ -349,7 +396,13 @@ class KVStoreServer:
                         return
                     if got is None:
                         return
-                    msg, tc = got
+                    msg, tc, hc = got
+                    if hc is not None:
+                        # worker-reported step time -> straggler table
+                        # (the worker only attaches it when ITS health
+                        # monitor is on, so no server-side gate needed)
+                        from . import health as _health
+                        _health.workers.update(hc["r"], hc["st"])
                     if _tracing.enabled:
                         # adopt the worker's trace context: the handler
                         # span joins the pushing span's trace and ends
@@ -604,5 +657,16 @@ def run_server():
     server = KVStoreServer(host=bind_host, port=port,
                            num_workers=num_workers)
     server.serve_forever()
+    snap_path = os.environ.get("MXNET_HEALTH_SNAPSHOT_PATH")
+    if snap_path:
+        # shutdown evidence for the launcher/tests: the aggregated
+        # per-worker step table with straggler verdicts (same pattern as
+        # the trace dump below)
+        from . import health as _health
+        try:
+            with open(snap_path, "w") as f:
+                json.dump({"workers": _health.workers.snapshot()}, f)
+        except OSError:
+            pass
     if _tracing.enabled:
         _tracing.dump_process_trace(role="server")

@@ -19,6 +19,7 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from .. import fused_step as _fused
 from .. import telemetry as _telemetry
+from .. import health as _health
 from ..context import Context, cpu, current_context
 from ..initializer import InitDesc
 from .base_module import BaseModule
@@ -277,6 +278,11 @@ class Module(BaseModule):
                 if tel:
                     _fused.STEP_DISPATCH.labels(path=path).inc()
                     _fused.STEP_TIME.observe(time.perf_counter() - t0)
+                if _health.enabled:
+                    _health.monitor.on_step(
+                        "mesh_step" if path == "mesh_fused" else
+                        ("step" if len(self._context) == 1
+                         else ("fwdbwd", "update")))
                 return
         if fs is not None:
             fs.flush_eager()
@@ -312,6 +318,8 @@ class Module(BaseModule):
         if tel:
             _fused.STEP_DISPATCH.labels(path="eager").inc()
             _fused.STEP_TIME.observe(time.perf_counter() - t0)
+        if _health.enabled:
+            _health.monitor.on_step(("fwdbwd",))
 
     def get_outputs(self, merge_multi_context=True):
         fs = self._fused()

@@ -9,7 +9,10 @@ Routes::
 
     POST /predict        {"inputs": {name: nested list}, "deadline_ms": n?}
                          -> 200 {"outputs": [...], "rows": n}
-    GET  /healthz        -> 200 {"status": "serving", ...stats}
+    GET  /healthz        -> 200 {"status": "serving", ...verdict} when
+                         healthy; 503 {"status": "degraded",
+                         "causes": [...]} on queue saturation, post-warmup
+                         compiles, or a high deadline-miss rate
     GET  /stats          -> 200 server stats JSON
 
 Overload maps to status codes a load balancer understands: 503 for
@@ -53,11 +56,12 @@ def start_http_server(model_server, port=None, host=None):
 
         def do_GET(self):  # noqa: N802 - stdlib API
             path = self.path.split("?", 1)[0]
-            if path in ("/healthz", "/stats"):
-                doc = model_server.stats()
-                if path == "/healthz":
-                    doc = {"status": "serving", **doc}
-                self._reply(200, doc)
+            if path == "/healthz":
+                doc = model_server.health()
+                self._reply(
+                    503 if doc.get("status") == "degraded" else 200, doc)
+            elif path == "/stats":
+                self._reply(200, model_server.stats())
             else:
                 self.send_error(404)
 

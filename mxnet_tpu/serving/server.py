@@ -26,6 +26,7 @@ event lands on the ``Serving::ExecuteBatch`` span that served it.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -143,6 +144,12 @@ class ModelServer:
         self._started = False
         self._stopped = False
         self._warmed = False
+        # health inputs: recent request outcomes (deque append/iteration
+        # are thread-safe) + the per-predictor compile-count snapshot
+        # taken at the end of warmup
+        self._recent_outcomes: collections.deque = collections.deque(
+            maxlen=256)
+        self._warm_compile_counts: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, warmup: bool = True):
@@ -173,6 +180,10 @@ class ModelServer:
                 feed = {k: np.zeros((b,) + s, np.float32)
                         for k, s in self._example_shapes.items()}
                 pred.forward(**feed)
+        # per-server baseline, not the global op_jit_cache counters (other
+        # executors in the process would pollute a global delta): anything
+        # beyond this after warmup is a silent recompile
+        self._warm_compile_counts = self._compile_count()
         self._warmed = True
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
@@ -358,6 +369,7 @@ class ModelServer:
         return [o.asnumpy() for o in outs]
 
     def _finish(self, req, error, outcome):
+        self._recent_outcomes.append(outcome)
         if _telemetry.enabled:
             _REQS.labels(outcome=outcome).inc()
             _E2E_TIME.observe(time.monotonic() - req.submit_t)
@@ -368,6 +380,49 @@ class ModelServer:
             req._fail(error, outcome)
 
     # -- introspection -----------------------------------------------------
+    def _compile_count(self) -> int:
+        """Per-input-shape forward programs across this server's
+        predictors (the executor records one ("fwdsig", ...) key per
+        compiled shape signature when telemetry is on)."""
+        total = 0
+        for pred in set(self._predictors.values()):
+            ex = getattr(pred, "_executor", None)
+            if ex is not None:
+                total += sum(1 for k in ex._jitted
+                             if isinstance(k, tuple) and k
+                             and k[0] == "fwdsig")
+        return total
+
+    def health(self) -> Dict[str, object]:
+        """Health verdict for /healthz: degraded on queue saturation,
+        post-warmup compiles, or a high deadline-miss rate."""
+        causes = []
+        qcap = self.config.queue_depth
+        saturation = (len(self._batcher) / float(qcap)) if qcap else 0.0
+        if saturation >= 0.9:
+            causes.append("queue_saturated")
+        compiles = None
+        if self._warmed and self._warm_compile_counts is not None:
+            compiles = self._compile_count() - self._warm_compile_counts
+            if compiles > 0:
+                causes.append("post_warmup_compiles")
+        recent = list(self._recent_outcomes)
+        misses = sum(1 for o in recent if o == "deadline")
+        miss_rate = (misses / float(len(recent))) if recent else 0.0
+        if len(recent) >= 20 and miss_rate > 0.5:
+            causes.append("deadline_misses")
+        if self._stopped:
+            causes.append("stopped")
+        return {
+            "status": "degraded" if causes else "serving",
+            "causes": causes,
+            "queue_saturation": saturation,
+            "post_warmup_compiles": compiles,
+            "deadline_miss_rate": miss_rate,
+            "recent_requests": len(recent),
+            **self.stats(),
+        }
+
     def stats(self) -> Dict[str, object]:
         return {
             "buckets": list(self._batcher.buckets),

@@ -104,10 +104,11 @@ _server_lock = threading.Lock()
 
 def start_http_server(port: int, registry: MetricRegistry,
                       host: str = "127.0.0.1"):
-    """Serve ``/metrics`` (text exposition) and ``/metrics.json`` on a
-    daemon thread.  Binds loopback by default — the wire is unauthenticated,
-    so exposing it wider is an explicit operator choice
-    (``MXNET_TELEMETRY_HOST``).  Returns the bound port."""
+    """Serve ``/metrics`` (text exposition), ``/metrics.json`` and
+    ``/statusz`` (health snapshot) on a daemon thread.  Binds loopback by
+    default — the wire is unauthenticated, so exposing it wider is an
+    explicit operator choice (``MXNET_TELEMETRY_HOST``).  Returns the
+    bound port."""
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -118,6 +119,12 @@ def start_http_server(port: int, registry: MetricRegistry,
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = snapshot_json(registry).encode()
+                ctype = "application/json"
+            elif path == "/statusz":
+                # lazy import: health pulls in the telemetry package, so a
+                # top-level import here would be circular
+                from .. import health as _health
+                body = json.dumps(_health.statusz()).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)

@@ -1,0 +1,56 @@
+"""dist_async worker for the health straggler test: each rank seeds a
+synthetic step time (rank 1 is 20x slower — well past the 1.75x straggler
+band), then a few push/pull round-trips piggyback ``{rank, step_seconds}``
+on the KVStore wire header for the server's :class:`WorkerTable`.
+
+Launched by tests/test_health.py via tools/launch.py with MXNET_HEALTH=1
+and MXNET_HEALTH_SNAPSHOT_PATH set; the server process (same env) writes
+the aggregated worker table when the stop command shuts it down.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import health, nd
+
+
+def main():
+    assert health.enabled, "worker must run with MXNET_HEALTH=1"
+    # create() first: in a DMLC_ROLE=server process this enters the server
+    # loop and never returns
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+
+    step_s = 0.01 if rank == 0 else 0.2
+    kv.init("w", nd.zeros((4, 2)))
+    kv.barrier()
+    for step in range(5):
+        # synthetic closed window: what on_step() would record at the
+        # trainer dispatch site, without sleeping 0.2s per step
+        health.monitor.observe_step(step_s)
+        kv.push("w", nd.array(np.full((4, 2), rank + step, np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+    print("rank %d reported step_seconds=%s" % (rank, step_s))
+    if rank == 0:
+        # keep the launcher's worker-liveness window open so the server
+        # finishes its snapshot dump before cleanup kills it
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()
