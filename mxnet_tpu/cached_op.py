@@ -49,10 +49,17 @@ class CachedOp:
             return jnp.zeros((0, 2), np.uint32)
         return jnp.stack([_random.next_key() for _ in range(plan.n_rng)])
 
+    @staticmethod
+    def _plan_env(plan: _Plan):
+        # op env flags are baked into the whole-graph trace (same contract
+        # as executor.Executor._plan_env_of): join them to the program key
+        import os
+        return tuple(os.environ.get(k) for k in plan.env_keys)
+
     def _fwd(self, train: bool):
-        key = ("fwd", train)
+        plan = self._plan(train)
+        key = ("fwd", train) + self._plan_env(plan)
         if key not in self._jitted:
-            plan = self._plan(train)
             arg_names, aux_names = plan.arg_names, plan.aux_names
 
             def fn(arg_list, aux_list, keys):
@@ -66,9 +73,9 @@ class CachedOp:
 
     def _bwd(self, train: bool, diff_idx: Tuple[int, ...]):
         """Fused recompute-forward + vjp program for the given diff inputs."""
-        key = ("bwd", train, diff_idx)
+        plan = self._plan(train)
+        key = ("bwd", train, diff_idx) + self._plan_env(plan)
         if key not in self._jitted:
-            plan = self._plan(train)
             arg_names, aux_names = plan.arg_names, plan.aux_names
             diff_names = [arg_names[i] for i in diff_idx]
 

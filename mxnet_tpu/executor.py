@@ -89,6 +89,14 @@ class _Plan:
                         if id(src) in aux_ids:
                             wb[oi] = aux_ids[id(src)]
             self.steps.append((node, attrs, rng_slot, wb))
+        # trace-time formulation flags of every op in the graph: whole-graph
+        # programs call node.op.fn directly (bypassing the per-op cache in
+        # ops/registry.py compiled()), so the values of these flags are baked
+        # into the traced program and must join the PROGRAM's cache key
+        env_union = set()
+        for node, _a, _r, _w in self.steps:
+            env_union.update(node.op.env_keys)
+        self.env_keys = tuple(sorted(env_union))
 
     def execute(self, arg_vals: Dict[str, Any], aux_vals: Dict[str, Any],
                 keys, monitor=None, placements=None):
@@ -329,14 +337,14 @@ class Executor:
 
     def _segments(self, plan, placements):
         """Cached bulked segments for a placed plan (engine bulking)."""
-        key = ("segs", id(plan))
+        key = ("segs", id(plan)) + self._plan_env_of(plan)
         if key not in self._jitted:
             self._jitted[key] = plan.build_segments(
                 placements, self._ctx.jax_device)
         return self._jitted[key]
 
     def _fwd_fn(self, train: bool):
-        key = ("fwd", train)
+        key = ("fwd", train) + self._plan_env(train)
         if key not in self._jitted:
             plan = self._plan(train)
             arg_names, aux_names = plan.arg_names, plan.aux_names
@@ -367,7 +375,8 @@ class Executor:
 
     def _fwd_bwd_fn(self):
         """Single compiled program: forward + vjp-backward (+aux update)."""
-        if ("fwdbwd",) not in self._jitted:
+        key = ("fwdbwd",) + self._plan_env(True)
+        if key not in self._jitted:
             plan = self._plan(True)
             arg_names, aux_names = plan.arg_names, plan.aux_names
             grad_args = self._grad_args
@@ -399,12 +408,36 @@ class Executor:
                 grads = vjp(cots)
                 return outs, new_aux, list(grads)
 
-            self._jitted[("fwdbwd",)] = fn if placements else jax.jit(fn)
-        return self._jitted[("fwdbwd",)]
+            self._jitted[key] = fn if placements else jax.jit(fn)
+        return self._jitted[key]
 
     def _step_env(self):
         import os
         return tuple(os.environ.get(k) for k in self.STEP_ENV_KEYS)
+
+    @staticmethod
+    def _plan_env_of(plan: "_Plan"):
+        """Current values of the plan's op env flags (``_Plan.env_keys``);
+        joins every whole-graph program cache key so toggling e.g.
+        MXNET_TPU_PALLAS_CONV after the first forward rebuilds the program
+        instead of serving one with the old formulation baked in."""
+        import os
+        return tuple(os.environ.get(k) for k in plan.env_keys)
+
+    def _plan_env(self, train: bool = True):
+        return self._plan_env_of(self._plan(train))
+
+    def _step_key(self, mesh_sig=None):
+        """Cache key of the fused whole-step program — also the first_run
+        probe used by fused_step drivers, so key shape changes stay in ONE
+        place."""
+        return ("step",) + ((mesh_sig,) if mesh_sig is not None else ()) \
+            + self._step_env() + self._plan_env(True)
+
+    def _update_key(self):
+        """Cache key of the update-only program (optimizer update_fns only —
+        no graph ops, so no plan env component)."""
+        return ("update",) + self._step_env()
 
     def step_program(self, pnames, update_fns, mesh_sig=None,
                      param_shardings=None):
@@ -430,8 +463,7 @@ class Executor:
         shard a small bias), which would silently break the take/give
         donation chain on the next step.
         """
-        key = ("step",) + ((mesh_sig,) if mesh_sig is not None else ()) \
-            + self._step_env()
+        key = self._step_key(mesh_sig)
         fn = self._jitted.get(key)
         if fn is not None:
             return fn
@@ -475,7 +507,7 @@ class Executor:
     def update_program(self, update_fns):
         """Cached donated update-only program (multi-device local path:
         fwdbwd stays per-device, the update fuses into one launch)."""
-        key = ("update",) + self._step_env()
+        key = self._update_key()
         fn = self._jitted.get(key)
         if fn is None:
             fn = build_update_program(update_fns)
@@ -527,13 +559,16 @@ class Executor:
         self._last_keys = keys
         # first_run marks the trace+compile invocation of this (mode,
         # shape-set) so recompiles stand out from steady-state iterations
-        first_run = ("fwd", bool(is_train)) not in self._jitted
+        plan_env = self._plan_env_of(plan)
+        first_run = ("fwd", bool(is_train)) + plan_env not in self._jitted
         if _telemetry.enabled:
             # count per input-shape signature, not per _fwd_fn build: the
             # jitted fn silently recompiles on a new shape, and THAT is
-            # the event a shape-bucketing layer must see
+            # the event a shape-bucketing layer must see (an env-flag
+            # toggle recompiles too — plan_env keeps the counter truthful)
             skey = ("fwdsig", bool(is_train),
-                    tuple(self.arg_dict[n].shape for n in self.arg_names))
+                    tuple(self.arg_dict[n].shape
+                          for n in self.arg_names)) + plan_env
             if skey in self._jitted:
                 _PROG_HITS.labels(op="Executor::Forward").inc()
             else:
@@ -581,7 +616,7 @@ class Executor:
             else self._keys(plan)
         args, auxs = self._gather()
         from . import profiler as _profiler
-        first_run = ("fwdbwd",) not in self._jitted
+        first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
         with _profiler.span("Executor::Backward", "executor",
                             histogram=_BWD_TIME,
                             args={"first_run": first_run}):
@@ -611,7 +646,7 @@ class Executor:
             ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
         from . import profiler as _profiler
-        first_run = ("fwdbwd",) not in self._jitted
+        first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
         with _profiler.span("Executor::ForwardBackward", "executor",
                             histogram=_FWDBWD_TIME,
                             args={"first_run": first_run}):

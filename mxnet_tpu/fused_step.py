@@ -374,7 +374,7 @@ class ModuleFusedStep:
         ex._last_keys = keys
         ogs = ex._default_ograds()
         update_fns = [opt_.fused_update] * len(slots)
-        first_run = ("step",) + ex._step_env() not in ex._jitted
+        first_run = ex._step_key() not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns)
         if first_run and _health.enabled:
             # lowering-only analysis — the dispatch below still owns the
@@ -420,7 +420,7 @@ class ModuleFusedStep:
                 else:
                     gvals.append([ex.grad_dict[name]._data])
             rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
-            first_run = ("update",) + ex._step_env() not in ex._jitted
+            first_run = ex._update_key() not in ex._jitted
             fn = ex.update_program([opt_.fused_update] * len(slots))
             if first_run and k == 0 and _health.enabled:
                 _health.register_program(
@@ -608,8 +608,7 @@ class ModuleFusedStep:
         mesh_sig = (tuple(sorted(mesh.shape.items())),
                     tuple(str(sh.spec) for sh in pshardings))
         update_fns = [opt_.fused_update] * len(slots)
-        key_probe = ("step", mesh_sig) + ex._step_env()
-        first_run = key_probe not in ex._jitted
+        first_run = ex._step_key(mesh_sig) not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns,
                              mesh_sig=mesh_sig, param_shardings=pshardings)
         if first_run and _health.enabled:

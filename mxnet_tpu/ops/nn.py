@@ -307,6 +307,9 @@ def _convolution(attrs, data, weight, *maybe_bias):
             dimension_numbers=_conv_dnums(nd),
             feature_group_count=attrs["num_group"])
     if _telemetry.enabled:
+        # the dispatch path is a compile-time choice, so this bump fires
+        # once per compiled conv variant — that IS the intended signal
+        # graftlint: disable=GL002 -- counts compiled variants, not calls
         _CONV_DISPATCH.labels(path=path).inc()
     # NOTE: no preferred_element_type here — the MXU accumulates bf16 convs
     # in f32 natively, and an explicit f32 preference breaks the conv

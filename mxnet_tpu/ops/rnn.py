@@ -124,6 +124,7 @@ def _cell_scan(mode, xproj, h0, c0, R, bR):
 
 @register("RNN", nin=-1, aliases=("rnn",), nout=3, needs_rng=True,
           train_aware=True,
+          env_keys=("MXNET_TPU_PALLAS_RNN",),
           visible=lambda a: (3 if a["mode"] == "lstm" else 2)
           if a["state_outputs"] else 1,
           params={"state_size": param(int, required=True),

@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError, AttrDict
+from .. import health as _health
 from .mesh import ShardingRules
 
 __all__ = ["dp_train_step", "DataParallelTrainer"]
@@ -47,13 +48,30 @@ def dp_train_step(loss_fn: Callable, mesh: Mesh,
         return rules.sharding_for(name, x.shape)
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, moms, batch):
+    def _step(params, moms, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         new_p, new_m = {}, {}
         for k in params:
             new_p[k], new_m[k] = _sgd_mom(params[k], grads[k], moms[k],
                                           lr, momentum, wd)
         return new_p, new_m, loss
+
+    first = {"run": True}
+
+    def step(params, moms, batch):
+        # donated-buffer health accounting on the first execution only:
+        # params/moms here are the OLD donated inputs — handing them to
+        # audit_donation right after dispatch surfaces an alias XLA
+        # silently dropped (program_donation_leaks_total)
+        first_run = first["run"]
+        first["run"] = False
+        if first_run and _health.enabled:
+            _health.register_program("dp_train_step", _step,
+                                     (params, moms, batch), donated=True)
+        out = _step(params, moms, batch)
+        if first_run and _health.enabled:
+            _health.audit_donation("dp_train_step", (params, moms))
+        return out
 
     def place(params, moms, batch_example=None):
         p = {k: jax.device_put(v, shard_param(k, v)) for k, v in params.items()}
@@ -181,7 +199,8 @@ class DataParallelTrainer:
 
     def step(self, batch: Dict[str, Any]):
         from .. import random as _random
-        if self._step is None:
+        first_run = self._step is None
+        if first_run:
             self._step = self._build_step()
         bsh = self._batch_sharding
         b = {}
@@ -196,8 +215,16 @@ class DataParallelTrainer:
             b[k] = data
         keys = jnp.stack([_random.next_key()
                           for _ in range(max(1, self._plan.n_rng))])
+        # keep refs to the donated inputs across the first dispatch so the
+        # health layer can verify XLA actually aliased them
+        donated = (self.params, self.moms, self.aux)
+        if first_run and _health.enabled:
+            _health.register_program("dp_step", self._step,
+                                     donated + (b, keys), donated=True)
         self.params, self.moms, self.aux, loss = \
             self._step(self.params, self.moms, self.aux, b, keys)
+        if first_run and _health.enabled:
+            _health.audit_donation("dp_step", donated)
         return loss
 
     def get_params(self):

@@ -1,0 +1,14 @@
+import jax
+
+
+@jax.jit
+def suppressed_ok(x):
+    # graftlint: disable=GL002 -- trace-time banner is intentional
+    print("banner")
+    return x
+
+
+@jax.jit
+def suppressed_noreason(x):
+    print("banner")  # graftlint: disable=GL002
+    return x

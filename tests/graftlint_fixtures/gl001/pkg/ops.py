@@ -1,0 +1,31 @@
+import os
+
+from .registry import register
+
+
+@register("GoodOp", env_keys=("MXNET_TPU_GOOD",))
+def good_op(x):
+    if os.environ.get("MXNET_TPU_GOOD"):
+        return x + 1
+    return x
+
+
+@register("LeakyOp")
+def leaky_op(x):
+    # read on the trace path with no env_keys declaration
+    if os.environ.get("MXNET_TPU_LEAK"):
+        return x * 2
+    return x
+
+
+@register("StaleOp", env_keys=("MXNET_TPU_STALE",))
+def stale_op(x):
+    return x
+
+
+@register("DynOp")
+def dyn_op(x):
+    key = "MXNET_TPU_" + "DYN"
+    if os.environ.get(key):
+        return x
+    return x

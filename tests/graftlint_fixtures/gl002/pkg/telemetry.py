@@ -1,0 +1,18 @@
+class _Metric:
+    def inc(self, n=1):
+        pass
+
+    def labels(self, **kw):
+        return self
+
+
+def counter(name, doc, labels=()):
+    return _Metric()
+
+
+def gauge(name, doc, labels=()):
+    return _Metric()
+
+
+def histogram(name, doc, labels=()):
+    return _Metric()

@@ -1,0 +1,47 @@
+import os
+import time
+
+import jax
+import numpy as np
+
+from . import telemetry
+
+STEPS = telemetry.counter("steps_total", "steps taken")
+
+
+@jax.jit
+def bad_step(x):
+    STEPS.inc()
+    t = time.time()
+    r = np.random.rand()
+    print("tracing")
+    if os.environ.get("MXNET_TPU_FLAG"):
+        x = x + 1
+    return x + t + r
+
+
+@jax.jit
+def syncing(x):
+    y = x.asnumpy()
+    return y
+
+
+@jax.jit
+def good_step(x):
+    return helper(x)
+
+
+def helper(x):
+    return x * 2
+
+
+def host_path(x):
+    # runs on the HOST through the callback below: must never be flagged
+    print("host side")
+    return x
+
+
+@jax.jit
+def with_callback(x):
+    jax.debug.callback(host_path, x)
+    return x

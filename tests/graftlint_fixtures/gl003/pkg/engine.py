@@ -1,0 +1,33 @@
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def ab(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def ba(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass
+
+    def slow(self, sock):
+        with self._lock_a:
+            data = sock.recv(1024)
+        return data
+
+
+class CondEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def waiter(self, q):
+        with self._cv:
+            item = q.get()
+        return item

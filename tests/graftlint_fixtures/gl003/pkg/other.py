@@ -1,0 +1,22 @@
+import threading
+
+
+class Safe:
+    def __init__(self):
+        self._m1 = threading.Lock()
+        self._m2 = threading.Lock()
+
+    def one(self):
+        with self._m1:
+            with self._m2:
+                pass
+
+    def two(self):
+        with self._m1:
+            with self._m2:
+                pass
+
+    def fetch(self, sock):
+        # blocking under a lock, but NOT in the hot-path module scope
+        with self._m1:
+            return sock.recv(64)

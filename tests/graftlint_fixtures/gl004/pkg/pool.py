@@ -1,0 +1,6 @@
+class DonationPool:
+    def take(self, key):
+        pass
+
+    def give(self, key, handle, value):
+        pass

@@ -1,0 +1,45 @@
+from functools import partial
+
+import jax
+
+from .health import audit_donation
+
+
+def build_good():
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(p, g):
+        return p - g
+    return step
+
+
+def run_good(p, g):
+    fn = build_good()
+    out = fn(p, g)
+    audit_donation("good", (p,))
+    return out
+
+
+class Trainer:
+    def build(self):
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(p, g):
+            return p - g
+        self._fn = step
+
+    def step(self, p, g):
+        out = self._fn(p, g)
+        audit_donation("trainer", (p,))
+        return out
+
+
+def build_bad():
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(p, g):
+        return p - g
+    return step
+
+
+def build_call_site():
+    def step(p, g):
+        return p - g
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,4 @@
+from . import telemetry
+
+GOOD = telemetry.counter("documented_total", "in the docs")
+BAD = telemetry.gauge("undocumented_gauge", "missing from the docs")

@@ -1,0 +1,10 @@
+class _Metric:
+    pass
+
+
+def counter(name, doc, labels=()):
+    return _Metric()
+
+
+def gauge(name, doc, labels=()):
+    return _Metric()

@@ -1,0 +1,238 @@
+"""graftlint fixture + regression tests (tools/graftlint, docs/lint.md).
+
+Each fixture under ``graftlint_fixtures/<case>/pkg`` is a miniature
+package holding a known-good and a known-bad variant of ONE contract;
+the assertions are mutation-style: the bad code MUST be caught by its
+exact finding detail, the good code MUST stay silent.  The final class
+runs the analyzer over the real tree and pins it at zero non-baselined
+findings — the tier-1 gate the CLI also enforces.
+"""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "graftlint_fixtures"
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint import DEFAULT_BASELINE, Project, run_checks  # noqa: E402
+from tools.graftlint.__main__ import main as cli_main  # noqa: E402
+from tools.graftlint.core import load_baseline  # noqa: E402
+
+
+def lint(case, checks, config=None, baseline=None):
+    project = Project(FIXTURES / case, packages=("pkg",), config=config)
+    assert not project.parse_errors
+    return run_checks(project, checks=checks, baseline=baseline)
+
+
+def details(findings):
+    return {f.detail for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# GL001: env reads on trace paths must join the jit cache key
+# ---------------------------------------------------------------------------
+class TestGL001:
+    def test_registered_op_directions(self):
+        d = details(lint("gl001", ["GL001"]).findings)
+        assert "undeclared:MXNET_TPU_LEAK:op:LeakyOp" in d
+        assert "stale:MXNET_TPU_STALE:op:StaleOp" in d
+        assert "dynamic:pkg.ops.dyn_op:op:DynOp" in d
+        # declared AND read: silent
+        assert not any("GoodOp" in x for x in d)
+
+    def test_step_env_keys(self):
+        d = details(lint("gl001", ["GL001"]).findings)
+        assert "stale-step:MXNET_TPU_STEP_DEAD" in d
+        assert any(x.startswith("undeclared-step:MXNET_TPU_ROGUE:")
+                   for x in d)
+        assert not any("MXNET_TPU_STEP_OK" in x for x in d)
+
+
+# ---------------------------------------------------------------------------
+# GL002: tracer purity
+# ---------------------------------------------------------------------------
+class TestGL002:
+    def test_every_host_effect_flagged(self):
+        d = details(lint("gl002", ["GL002"]).findings)
+        assert "bump:pkg.traced.bad_step:steps_total" in d
+        assert "time:pkg.traced.bad_step" in d
+        assert "np.random:pkg.traced.bad_step" in d
+        assert "print:pkg.traced.bad_step" in d
+        assert "env:pkg.traced.bad_step:MXNET_TPU_FLAG" in d
+        assert "asnumpy:pkg.traced.syncing" in d
+
+    def test_clean_root_silent(self):
+        d = details(lint("gl002", ["GL002"]).findings)
+        assert not any("good_step" in x or "helper" in x for x in d)
+
+    def test_host_callback_is_a_barrier(self):
+        # host_path runs on the host through jax.debug.callback: the
+        # reachability walk must not cross into it
+        d = details(lint("gl002", ["GL002"]).findings)
+        assert not any("host_path" in x for x in d)
+
+
+# ---------------------------------------------------------------------------
+# GL003: lock discipline
+# ---------------------------------------------------------------------------
+class TestGL003:
+    def test_abba_inversion(self):
+        d = details(lint("gl003", ["GL003"]).findings)
+        assert ("order:pkg.engine.Engine._lock_a<->pkg.engine.Engine._lock_b"
+                in d)
+        # consistent order in the other module: no inversion reported
+        assert not any(x.startswith("order:") and "pkg.other" in x
+                       for x in d)
+
+    def test_blocking_under_hot_lock(self):
+        d = details(lint("gl003", ["GL003"]).findings)
+        assert ("blocking:socket:pkg.engine.Engine.slow:"
+                "pkg.engine.Engine._lock_a") in d
+
+    def test_condition_aliases_wrapped_lock(self):
+        d = details(lint("gl003", ["GL003"]).findings)
+        assert ("blocking:queue.get():pkg.engine.CondEngine.waiter:"
+                "pkg.engine.CondEngine._lock") in d
+
+    def test_scope_is_configurable(self):
+        d = details(lint("gl003", ["GL003"]).findings)
+        assert not any("pkg.other.Safe" in x and x.startswith("blocking:")
+                       for x in d)
+        d2 = details(lint("gl003", ["GL003"],
+                          config={"lock_scope_modules": ("other",)}).findings)
+        assert any(x.startswith("blocking:socket:pkg.other.Safe.fetch")
+                   for x in d2)
+
+
+# ---------------------------------------------------------------------------
+# GL004: donation contract
+# ---------------------------------------------------------------------------
+class TestGL004:
+    def test_unpaired_sites_flagged(self):
+        d = details(lint("gl004", ["GL004"]).findings)
+        assert "donate:pkg.train.build_bad" in d
+        assert "donate:pkg.train.build_call_site" in d
+
+    def test_paired_sites_silent(self):
+        d = details(lint("gl004", ["GL004"]).findings)
+        # paired through a transitive caller (run_good) ...
+        assert "donate:pkg.train.build_good" not in d
+        # ... and through a sibling method of the enclosing class
+        assert not any("Trainer" in x for x in d)
+
+
+# ---------------------------------------------------------------------------
+# GL005: metric registry vs docs
+# ---------------------------------------------------------------------------
+class TestGL005:
+    CFG = {"observability_md": str(FIXTURES / "gl005" / "docs.md")}
+
+    def test_both_directions(self):
+        d = details(lint("gl005", ["GL005"], config=self.CFG).findings)
+        assert "undocumented:undocumented_gauge" in d
+        assert "ghost:ghost_metric_total" in d
+        assert not any("documented_total" in x for x in d)
+
+    def test_missing_docs(self, tmp_path):
+        cfg = {"observability_md": str(tmp_path / "nope.md")}
+        d = details(lint("gl005", ["GL005"], config=cfg).findings)
+        assert "missing-docs" in d
+
+
+# ---------------------------------------------------------------------------
+# suppression directives
+# ---------------------------------------------------------------------------
+class TestSuppressions:
+    def test_reasoned_suppression_hides_finding(self):
+        res = lint("gl000", ["GL002"])
+        assert any(f.detail.startswith("print:pkg.sup.suppressed_ok")
+                   for f in res.suppressed)
+        assert not any("suppressed_ok" in f.detail for f in res.findings)
+
+    def test_reasonless_suppression_is_gl000(self):
+        res = lint("gl000", ["GL002"])
+        # the GL002 finding itself is suppressed ...
+        assert any(f.detail.startswith("print:pkg.sup.suppressed_noreason")
+                   for f in res.suppressed)
+        # ... but the reasonless directive becomes its own finding
+        assert any(f.code == "GL000" and f.detail == "no-reason:GL002"
+                   for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+class TestBaseline:
+    CFG = {"observability_md": str(FIXTURES / "gl005" / "docs.md")}
+
+    def test_baselined_findings_move_aside(self):
+        live = lint("gl005", ["GL005"], config=self.CFG)
+        fp = next(f.fingerprint for f in live.findings
+                  if f.detail == "undocumented:undocumented_gauge")
+        res = lint("gl005", ["GL005"], config=self.CFG, baseline=[fp])
+        assert fp in {f.fingerprint for f in res.baselined}
+        assert fp not in {f.fingerprint for f in res.findings}
+        # non-baselined findings still fire
+        assert "ghost:ghost_metric_total" in details(res.findings)
+
+    def test_stale_baseline_entry_reported(self):
+        gone = "GL005|pkg/gone.py|undocumented:gone_total"
+        res = lint("gl005", ["GL005"], config=self.CFG, baseline=[gone])
+        assert res.stale_baseline == [gone]
+
+    def test_fingerprint_ignores_line_numbers(self):
+        live = lint("gl005", ["GL005"], config=self.CFG)
+        for f in live.findings:
+            assert str(f.line) not in f.fingerprint.split("|")[2:]
+            assert f.fingerprint == "%s|%s|%s" % (f.code, f.path, f.detail)
+
+
+# ---------------------------------------------------------------------------
+# the real tree: zero non-baselined findings (tier-1 gate)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def repo_project():
+    # ONE shared parse of the tree for every real-tree assertion: keeps
+    # the whole file inside the tier-1 time budget
+    return Project(REPO)
+
+
+class TestRealTree:
+    def test_zero_nonbaselined_findings(self, repo_project):
+        res = run_checks(repo_project,
+                         baseline=load_baseline(DEFAULT_BASELINE))
+        assert not res.findings, "\n".join(
+            "%s:%d %s %s" % (f.path, f.line, f.code, f.message)
+            for f in res.findings)
+        assert not res.stale_baseline, res.stale_baseline
+
+    def test_unknown_check_rejected(self, repo_project):
+        with pytest.raises(ValueError):
+            run_checks(repo_project, checks=["GL999"])
+
+
+class TestCLI:
+    def test_json_schema(self, capsys):
+        rc = cli_main(["--format", "json", "--root", str(REPO)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        for key in ("version", "root", "checks", "findings", "baselined",
+                    "suppressed", "stale_baseline", "summary"):
+            assert key in out
+        assert out["checks"] == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+        assert out["summary"]["findings"] == 0
+        assert out["summary"]["stale_baseline"] == 0
+        for f in out["baselined"] + out["findings"]:
+            assert {"code", "path", "line", "message",
+                    "fingerprint"} <= set(f)
+
+    def test_smoke(self, capsys):
+        rc = cli_main(["--smoke", "--root", str(REPO)])
+        out = capsys.readouterr().out.strip()
+        assert rc == 0
+        assert out.startswith("graftlint:")

@@ -10,7 +10,6 @@ docs/observability.md, and the 2-worker dist straggler acceptance run.
 """
 import json
 import os
-import re
 import sys
 import time
 
@@ -524,52 +523,9 @@ class TestServingHealth:
             serving.stop_http_server()
 
 
-# ---------------------------------------------------------------------------
-# metric-name lint: docs/observability.md tables <-> live registry
-# ---------------------------------------------------------------------------
-class TestMetricLint:
-    @staticmethod
-    def _documented():
-        path = os.path.join(REPO, "docs", "observability.md")
-        names = set()
-        for line in open(path):
-            if not line.startswith("| `"):
-                continue
-            first_cell = line.split("|")[1]
-            names.update(re.findall(r"`([a-z][a-z0-9_]+)`", first_cell))
-        # switch/knob tables list env vars in the first cell too; keep
-        # only metric-shaped names (the registry never holds env names)
-        return {n for n in names if not n.isupper()}
-
-    @staticmethod
-    def _registered():
-        # import every module that registers instruments at import time
-        import mxnet_tpu.engine       # noqa: F401
-        import mxnet_tpu.executor     # noqa: F401
-        import mxnet_tpu.fused_step   # noqa: F401
-        import mxnet_tpu.gluon.trainer  # noqa: F401
-        import mxnet_tpu.health       # noqa: F401
-        import mxnet_tpu.io           # noqa: F401
-        import mxnet_tpu.kvstore      # noqa: F401
-        import mxnet_tpu.kvstore_server  # noqa: F401
-        import mxnet_tpu.ops.nn       # noqa: F401
-        import mxnet_tpu.ops.registry  # noqa: F401
-        import mxnet_tpu.profiler     # noqa: F401
-        import mxnet_tpu.serving.server  # noqa: F401
-        import mxnet_tpu.tracing      # noqa: F401
-        return {fam.name for fam in telemetry.registry().collect()}
-
-    def test_every_metric_documented(self):
-        undocumented = self._registered() - self._documented()
-        assert not undocumented, (
-            "metrics missing from docs/observability.md tables: %s"
-            % sorted(undocumented))
-
-    def test_every_documented_metric_exists(self):
-        stale = self._documented() - self._registered()
-        assert not stale, (
-            "docs/observability.md documents metrics no module registers: "
-            "%s" % sorted(stale))
+# metric-name lint moved to graftlint GL005 (tools/graftlint, exercised by
+# tests/test_graftlint.py): the static scan covers EVERY telemetry
+# instrument in the tree, not just the modules an import list remembers.
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,82 @@
+"""graftlint: whole-program static analyzer for mxnet_tpu's contracts.
+
+Checks (see docs/lint.md):
+  GL001  env reads on trace paths must join the jit cache key
+  GL002  tracer purity: no host side effects in traced code
+  GL003  lock discipline: consistent order, no blocking under hot locks
+  GL004  donation contract: donate_argnums pairs with pool/audit
+  GL005  metric registry: telemetry names match docs/observability.md
+
+Run: ``python -m tools.graftlint`` (see --help).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .core import (Finding, Project, load_baseline, save_baseline,
+                   split_by_baseline)
+from .checks import ALL_CHECKS
+
+__all__ = ["Project", "Finding", "run_checks", "LintResult",
+           "ALL_CHECKS", "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # actionable
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    checks_run: List[str] = field(default_factory=list)
+
+    @property
+    def all_raw(self) -> List[Finding]:
+        return self.findings + self.baselined
+
+
+def run_checks(project: Project, checks: Optional[Sequence[str]] = None,
+               baseline: Optional[Sequence[str]] = None) -> LintResult:
+    """Run the selected checks and fold in suppressions + baseline."""
+    selected = [c.upper() for c in (checks or sorted(ALL_CHECKS))]
+    unknown = [c for c in selected if c not in ALL_CHECKS]
+    if unknown:
+        raise ValueError("unknown checks: %s (known: %s)"
+                         % (", ".join(unknown), ", ".join(sorted(ALL_CHECKS))))
+    raw: List[Finding] = list(project.parse_errors)
+    for code in selected:
+        raw.extend(ALL_CHECKS[code].run(project))
+
+    result = LintResult(checks_run=selected)
+    mods_by_rel: Dict[str, object] = {m.rel: m
+                                      for m in project.modules.values()}
+    kept: List[Finding] = []
+    used_suppressions = set()
+    for f in raw:
+        mod = mods_by_rel.get(f.path)
+        sup = mod.suppression_for(f.line, f.code) if mod else None
+        if sup is not None:
+            used_suppressions.add((sup.path, sup.line))
+            result.suppressed.append(f)
+        else:
+            kept.append(f)
+
+    # a suppression without a reason is itself a finding (GL000)
+    for mod in project.modules.values():
+        for line, sup in sorted(mod.suppressions().items()):
+            if not sup.reason:
+                kept.append(Finding(
+                    "GL000", sup.path, line,
+                    "graftlint suppression without a reason — write "
+                    "`# graftlint: disable=%s -- <why this is safe>`"
+                    % ",".join(sorted(sup.codes)),
+                    "no-reason:%s" % ",".join(sorted(sup.codes))))
+
+    new, old, stale = split_by_baseline(kept, baseline or [])
+    result.findings = sorted(new, key=lambda f: (f.path, f.line, f.code))
+    result.baselined = sorted(old, key=lambda f: (f.path, f.line, f.code))
+    result.stale_baseline = stale
+    return result

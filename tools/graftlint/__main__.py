@@ -1,0 +1,126 @@
+"""CLI: ``python -m tools.graftlint [options]``.
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings,
+2 = usage or internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import (ALL_CHECKS, DEFAULT_BASELINE, Project, run_checks)
+from .checks import DESCRIPTIONS
+from .core import load_baseline, save_baseline
+
+
+def _find_root(start: Path) -> Path:
+    cur = start.resolve()
+    for cand in (cur,) + tuple(cur.parents):
+        if (cand / "mxnet_tpu").is_dir():
+            return cand
+    return cur
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="whole-program static analyzer for mxnet_tpu's "
+                    "jit-cache, tracer-purity, lock, donation and metric "
+                    "contracts (docs/lint.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detect)")
+    ap.add_argument("--checks", default=None,
+                    help="comma-separated subset, e.g. GL001,GL003 "
+                         "(default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as live findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--smoke", action="store_true",
+                    help="one-line summary only (for the verify recipe)")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for code in sorted(ALL_CHECKS):
+            print("%s  %s" % (code, DESCRIPTIONS[code]))
+        return 0
+
+    t0 = time.time()
+    root = Path(args.root) if args.root else _find_root(Path.cwd())
+    if not (root / "mxnet_tpu").is_dir():
+        print("graftlint: no mxnet_tpu package under %s" % root,
+              file=sys.stderr)
+        return 2
+
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+
+    baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+
+    try:
+        project = Project(root)
+        result = run_checks(project, checks=checks, baseline=baseline)
+    except ValueError as exc:
+        print("graftlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        save_baseline(baseline_path,
+                      [f.fingerprint for f in result.all_raw])
+        print("graftlint: wrote %d fingerprints to %s"
+              % (len(result.all_raw), baseline_path))
+        return 0
+
+    elapsed = time.time() - t0
+    summary = ("graftlint: %d finding(s), %d baselined, %d suppressed, "
+               "%d stale baseline entr%s — %d modules in %.2fs"
+               % (len(result.findings), len(result.baselined),
+                  len(result.suppressed), len(result.stale_baseline),
+                  "y" if len(result.stale_baseline) == 1 else "ies",
+                  len(project.modules), elapsed))
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "root": str(root),
+            "checks": result.checks_run,
+            "findings": [f.as_dict() for f in result.findings],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "suppressed": [f.as_dict() for f in result.suppressed],
+            "stale_baseline": result.stale_baseline,
+            "summary": {
+                "findings": len(result.findings),
+                "baselined": len(result.baselined),
+                "suppressed": len(result.suppressed),
+                "stale_baseline": len(result.stale_baseline),
+                "modules": len(project.modules),
+                "seconds": round(elapsed, 3),
+            },
+        }, indent=2))
+    elif args.smoke:
+        print(summary)
+    else:
+        for f in result.findings:
+            print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+        if result.stale_baseline:
+            print("stale baseline entries (fix landed — remove them):")
+            for fp in result.stale_baseline:
+                print("  %s" % fp)
+        print(summary)
+
+    return 1 if (result.findings or result.stale_baseline) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

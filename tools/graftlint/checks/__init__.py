@@ -1,0 +1,14 @@
+"""Check registry: maps GLnnn codes to check modules."""
+from __future__ import annotations
+
+from . import (gl001_env_cache_key, gl002_tracer_purity,
+               gl003_lock_discipline, gl004_donation, gl005_metric_registry)
+
+ALL_CHECKS = {
+    mod.CODE: mod
+    for mod in (gl001_env_cache_key, gl002_tracer_purity,
+                gl003_lock_discipline, gl004_donation,
+                gl005_metric_registry)
+}
+
+DESCRIPTIONS = {mod.CODE: mod.TITLE for mod in ALL_CHECKS.values()}

@@ -1,0 +1,117 @@
+"""GL001: environment reads on a trace path must join the jit cache key.
+
+An ``os.environ``/``os.getenv``/``get_env`` read reachable from a traced
+program builder is baked into the XLA program at trace time.  If the key is
+not part of the program's cache key (``env_keys`` on a registered op,
+``STEP_ENV_KEYS`` on the executor step programs), toggling the flag later
+silently serves a stale program.  Both directions are checked: undeclared
+reachable reads, and declared keys with no reachable read (a stale
+declaration widens every cache key for nothing).
+"""
+from __future__ import annotations
+
+from ..core import Finding, Project, fn_qual
+
+CODE = "GL001"
+TITLE = "env-cache-key: traced env reads must be declared in the cache key"
+
+
+def _collect_reads(project: Project, root):
+    """{key: (rel, line)} + [(rel, line, qual)] dynamic reads reachable
+    from ``root``."""
+    reads = {}
+    dynamic = []
+    for g in project.reachable([root]):
+        scope = getattr(g, "_gl", None)
+        if scope is None:
+            continue
+        for er in project.facts(g).env_reads:
+            if er.key is None:
+                dynamic.append((scope.mod.rel, er.line, fn_qual(g)))
+            else:
+                reads.setdefault(er.key, (scope.mod.rel, er.line))
+    return reads, dynamic
+
+
+def run(project: Project):
+    findings = []
+
+    # -- A) registered ops: env_keys vs reachable reads -------------------
+    for mod, op_name, env_keys, fn, line in project.registered_ops():
+        reads, dynamic = _collect_reads(project, fn)
+        declared = set(env_keys)
+        for key in sorted(set(reads) - declared):
+            rel, rline = reads[key]
+            findings.append(Finding(
+                CODE, rel, rline,
+                "env var %r is read on the trace path of op %r but is not "
+                "in its env_keys — the op's jit cache will serve a stale "
+                "program after the flag changes" % (key, op_name),
+                "undeclared:%s:op:%s" % (key, op_name)))
+        for key in sorted(declared - set(reads)):
+            findings.append(Finding(
+                CODE, mod.rel, line,
+                "op %r declares env_keys entry %r but no read of it is "
+                "reachable from the op function — stale declaration"
+                % (op_name, key),
+                "stale:%s:op:%s" % (key, op_name)))
+        for rel, rline, qual in dynamic:
+            findings.append(Finding(
+                CODE, rel, rline,
+                "dynamic (non-literal) environment read in %s is on the "
+                "trace path of op %r and cannot join the jit cache key"
+                % (qual, op_name),
+                "dynamic:%s:op:%s" % (qual, op_name)))
+
+    # -- B) step programs: STEP_ENV_KEYS ----------------------------------
+    step_keys = {}
+    for mod in project.modules.values():
+        for (cls, name), val in mod.class_consts.items():
+            if name == "STEP_ENV_KEYS" and isinstance(val, tuple):
+                for k in val:
+                    step_keys.setdefault(k, (mod, cls))
+        val = mod.consts.get("STEP_ENV_KEYS")
+        if isinstance(val, tuple):
+            for k in val:
+                step_keys.setdefault(k, (mod, None))
+
+    if step_keys:
+        # every declared step key must be read (as a literal, possibly via
+        # a module constant) somewhere in the tree
+        read_anywhere = set()
+        for mod in project.modules.values():
+            for fn in mod.functions.values():
+                for er in project.facts(fn).env_reads:
+                    if er.key is not None:
+                        read_anywhere.add(er.key)
+        for key in sorted(step_keys):
+            if key not in read_anywhere:
+                mod, cls = step_keys[key]
+                findings.append(Finding(
+                    CODE, mod.rel, 1,
+                    "STEP_ENV_KEYS entry %r is never read anywhere in the "
+                    "tree — stale declaration widens the step program "
+                    "cache key for nothing" % key,
+                    "stale-step:%s" % key))
+
+        # jit roots in modules that participate in the step-key contract:
+        # reachable MXNET_* env reads must be covered by STEP_ENV_KEYS
+        step_mods = {mod.name for mod in project.modules.values()
+                     if any("STEP_ENV_KEYS" in ln for ln in mod.lines)}
+        for kind, mod, fnode, line in project.jit_roots():
+            if mod.name not in step_mods or kind != "jit":
+                continue
+            reads, _ = _collect_reads(project, fnode)
+            for key in sorted(reads):
+                if not key.startswith("MXNET_"):
+                    continue
+                if key in step_keys:
+                    continue
+                rel, rline = reads[key]
+                findings.append(Finding(
+                    CODE, rel, rline,
+                    "env var %r is read inside a step-program trace (%s) "
+                    "but is not in STEP_ENV_KEYS — the cached step program "
+                    "goes stale when it changes" % (key, fn_qual(fnode)),
+                    "undeclared-step:%s:%s" % (key, fn_qual(fnode))))
+    return findings

@@ -1,0 +1,102 @@
+"""GL002: no host side effects inside traced functions.
+
+Functions handed to ``jax.jit`` / ``custom_vjp`` / ``pallas_call`` /
+``shard_map`` (and everything they call) execute ONCE at trace time, then
+never again: a ``time.*`` stamp, ``np.random`` draw, telemetry bump,
+``print`` or environ read there records a constant into the program and
+silently stops firing per step.  ``.asnumpy()`` inside a trace either
+fails on tracers or forces a device sync at trace time.
+
+Environ reads are exempted for roots that have a declaration mechanism
+(registered ops with ``env_keys``, step-program modules using
+``STEP_ENV_KEYS``) — those are GL001's domain.
+"""
+from __future__ import annotations
+
+from ..core import Finding, Project, fn_qual
+
+CODE = "GL002"
+TITLE = "tracer purity: no host side effects reachable from traced code"
+
+_TIME_OK = ()  # every time.* call is trace-hostile
+
+
+def run(project: Project):
+    findings = []
+    seen = set()
+
+    roots = []
+    env_exempt_ids = set()
+    step_mods = {mod.name for mod in project.modules.values()
+                 if any("STEP_ENV_KEYS" in ln for ln in mod.lines)}
+
+    for kind, mod, fnode, line in project.jit_roots():
+        roots.append((kind, mod, fnode))
+        if mod.name in step_mods:
+            env_exempt_ids.add(id(fnode))
+    for mod, op_name, env_keys, fn, line in project.registered_ops():
+        roots.append(("op:%s" % op_name, mod, fn))
+        env_exempt_ids.add(id(fn))
+
+    def emit(f: Finding, root_desc: str):
+        if f.fingerprint in seen:
+            return
+        seen.add(f.fingerprint)
+        findings.append(f)
+
+    for kind, mod, root in roots:
+        root_desc = "%s root %s" % (kind, fn_qual(root))
+        for g in project.reachable([root]):
+            scope = getattr(g, "_gl", None)
+            if scope is None:
+                continue
+            gmod = scope.mod
+            gq = fn_qual(g)
+            facts = project.facts(g)
+            for b in facts.bumps:
+                emit(Finding(
+                    CODE, gmod.rel, b.line,
+                    "telemetry bump %s.%s fires at trace time, not per "
+                    "call (reached from %s) — the metric silently freezes "
+                    "after the first trace" % (b.instrument,
+                                               b.metric or "?", root_desc),
+                    "bump:%s:%s" % (gq, b.metric or b.instrument)),
+                    root_desc)
+            if id(root) not in env_exempt_ids:
+                for er in facts.env_reads:
+                    emit(Finding(
+                        CODE, gmod.rel, er.line,
+                        "environ read %s inside traced code (reached from "
+                        "%s) is baked in at trace time and has no cache-key "
+                        "declaration mechanism here"
+                        % (repr(er.key) if er.key else "(dynamic)",
+                           root_desc),
+                        "env:%s:%s" % (gq, er.key or "dynamic")),
+                        root_desc)
+            for site in facts.calls:
+                if site.is_ref or not site.chain:
+                    continue
+                canon = site.canon or ""
+                last = site.chain[-1]
+                bad = None
+                if last == "asnumpy":
+                    bad = ("asnumpy", ".asnumpy() forces a host sync and "
+                           "fails on tracers")
+                elif canon == "time" or canon.startswith("time."):
+                    bad = ("time", "time.* reads the host clock once at "
+                           "trace time")
+                elif canon.startswith("numpy.random") or \
+                        site.chain[:2] == ("np", "random"):
+                    bad = ("np.random", "np.random draws once at trace "
+                           "time — use the op's jax PRNG key")
+                elif site.chain == ("print",):
+                    bad = ("print", "print fires at trace time only — use "
+                           "jax.debug.print for per-call output")
+                if bad is not None:
+                    kind_, why = bad
+                    emit(Finding(
+                        CODE, gmod.rel, site.line,
+                        "%s in %s (reached from %s)" % (why, gq, root_desc),
+                        "%s:%s" % (kind_, gq)),
+                        root_desc)
+    return findings

@@ -1,0 +1,149 @@
+"""GL004: every donated jit program must pair with a donation audit.
+
+``donate_argnums`` hands input buffers to XLA; if the caller keeps using
+the old arrays the program silently aliases freed memory (or, on CPU
+backends that ignore donation, leaks a full copy of the model per step).
+The tree's contract (PR 5): every donate site either routes buffers
+through a ``DonationPool`` take/give ledger or hands the old inputs to
+``health.audit_donation`` after the first execution so the leak shows up
+in ``program_donation_leaks_total``.
+
+A donate site is paired when ``audit_donation`` or ``DonationPool``
+appears in the enclosing top-level function, anywhere in the enclosing
+class, or in a transitive caller (by name, up to 3 hops).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, Project, _dotted, fn_qual
+
+CODE = "GL004"
+TITLE = "donation contract: donate_argnums pairs with pool/audit handback"
+
+_MARKERS = {"audit_donation", "DonationPool"}
+
+
+def _identifiers(fn) -> Set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def _outermost(fn):
+    scope = fn._gl
+    while scope.owner is not None:
+        fn = scope.owner
+        scope = fn._gl
+    return fn
+
+
+def _donate_sites(project: Project):
+    """Yield (module, program_fn_or_enclosing_fn, line)."""
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            # decorators: @partial(jax.jit, donate_argnums=...) or
+            # @jax.jit(..., donate_argnums=...)
+            for dec in getattr(fn, "decorator_list", ()):
+                if not isinstance(dec, ast.Call):
+                    continue
+                canon = project.canonical(mod, _dotted(dec.func)) or ""
+                kws = {kw.arg for kw in dec.keywords}
+                if canon.endswith(".partial") and dec.args:
+                    inner = project.canonical(
+                        mod, _dotted(dec.args[0])) or ""
+                    if inner.endswith(".jit") and "donate_argnums" in kws:
+                        yield mod, fn, dec.lineno
+                elif canon.endswith(".jit") and "donate_argnums" in kws:
+                    yield mod, fn, dec.lineno
+            # call sites: jax.jit(fn, donate_argnums=...)
+            for site in project.facts(fn).calls:
+                if site.is_ref or not site.chain:
+                    continue
+                canon = site.canon or ""
+                if not (canon.endswith(".jit") and canon.startswith("jax")
+                        or site.chain[-1] == "jit"):
+                    continue
+                call = site.node
+                if any(kw.arg == "donate_argnums" for kw in call.keywords):
+                    yield mod, fn, call.lineno
+
+
+def run(project: Project):
+    # reverse call index: callee last-name -> calling functions
+    callers: Dict[str, List] = {}
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for site in project.facts(fn).calls:
+                if site.chain:
+                    callers.setdefault(site.chain[-1], []).append(fn)
+
+    ident_cache: Dict[int, Set[str]] = {}
+
+    def idents(fn) -> Set[str]:
+        got = ident_cache.get(id(fn))
+        if got is None:
+            got = _identifiers(fn)
+            ident_cache[id(fn)] = got
+        return got
+
+    findings = []
+    seen = set()
+    for mod, fn, line in _donate_sites(project):
+        outer = _outermost(fn)
+        scope = outer._gl
+        detail = "donate:%s" % fn_qual(outer)
+        if detail in seen:
+            continue
+        seen.add(detail)
+
+        candidates = [outer]
+        if scope.cls is not None:
+            prefix = scope.cls + "."
+            candidates.extend(
+                f for q, f in mod.functions.items()
+                if q.startswith(prefix) and f is not outer)
+        # transitive callers by name, up to 3 hops
+        frontier = [outer]
+        visited = {id(outer)}
+        for _ in range(3):
+            names = set()
+            for f in frontier:
+                names.add(getattr(f, "name", ""))
+                fsc = f._gl
+                if fsc.cls is not None:
+                    names.add(getattr(f, "name", ""))
+            nxt = []
+            for name in names:
+                for caller in callers.get(name, ()):
+                    if id(caller) not in visited:
+                        visited.add(id(caller))
+                        nxt.append(caller)
+                        candidates.append(caller)
+                        csc = caller._gl
+                        if csc.cls is not None:
+                            cmod = csc.mod
+                            prefix = csc.cls + "."
+                            for q, f2 in cmod.functions.items():
+                                if q.startswith(prefix) and \
+                                        id(f2) not in visited:
+                                    visited.add(id(f2))
+                                    candidates.append(f2)
+            frontier = nxt
+            if not frontier:
+                break
+
+        paired = any(idents(c) & _MARKERS for c in candidates)
+        if not paired:
+            findings.append(Finding(
+                CODE, mod.rel, line,
+                "donated program built in %s has no DonationPool take/give "
+                "or health.audit_donation handback on any caller path — "
+                "donation leaks will go unnoticed" % fn_qual(outer),
+                detail))
+    return findings

@@ -1,0 +1,98 @@
+"""GL005: every telemetry metric name matches docs/observability.md.
+
+Generalizes the old ``tests/test_health.py`` import-based metric lint:
+instead of importing a hand-maintained module list and reading the live
+registry, this statically scans EVERY ``telemetry.counter / gauge /
+histogram`` registration with a literal name across the tree and diffs
+against the metric tables in ``docs/observability.md`` — both directions.
+An undocumented metric is invisible to operators; a documented-but-gone
+metric breaks their dashboards silently.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from ..core import Finding, Project, _INSTRUMENT_CTORS, _dotted
+
+CODE = "GL005"
+TITLE = "metric registry: code metric names == docs/observability.md"
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _code_metrics(project: Project):
+    """{metric_name: (rel, line)} for literal-name registrations."""
+    out = {}
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain or chain[-1] not in _INSTRUMENT_CTORS:
+                continue
+            telem = False
+            if len(chain) == 1:
+                src = mod.from_imports.get(chain[0])
+                telem = bool(src) and "telemetry" in (src[0] + src[1])
+            else:
+                telem = "telemetry" in chain[0].lower()
+                if not telem:
+                    canon = project.canonical(mod, chain) or ""
+                    telem = "telemetry" in canon
+            if not telem:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                if _NAME_RE.match(name) and "_" in name:
+                    out.setdefault(name, (mod.rel, node.lineno))
+    return out
+
+
+def _doc_metrics(path: Path):
+    """{metric_name: line} from markdown table rows (first cell)."""
+    out = {}
+    if not path.exists():
+        return None
+    for i, line in enumerate(path.read_text(encoding="utf-8").splitlines(),
+                             start=1):
+        stripped = line.strip()
+        if not stripped.startswith("| `"):
+            continue
+        first_cell = stripped.split("|")[1]
+        for name in re.findall(r"`([^`]+)`", first_cell):
+            if _NAME_RE.match(name):
+                out.setdefault(name, i)
+    return out
+
+
+def run(project: Project):
+    docs_path = Path(project.config.get(
+        "observability_md", project.root / "docs" / "observability.md"))
+    code = _code_metrics(project)
+    docs = _doc_metrics(docs_path)
+    findings = []
+    if docs is None:
+        findings.append(Finding(
+            CODE, str(docs_path), 1,
+            "metrics doc %s does not exist" % docs_path, "missing-docs"))
+        return findings
+    rel_docs = docs_path
+    try:
+        rel_docs = docs_path.relative_to(project.root)
+    except ValueError:
+        pass
+    for name in sorted(set(code) - set(docs)):
+        rel, line = code[name]
+        findings.append(Finding(
+            CODE, rel, line,
+            "metric %r is registered here but not documented in %s"
+            % (name, rel_docs), "undocumented:%s" % name))
+    for name in sorted(set(docs) - set(code)):
+        findings.append(Finding(
+            CODE, str(rel_docs), docs[name],
+            "metric %r is documented but no registration with that name "
+            "exists in the tree" % name, "ghost:%s" % name))
+    return findings

@@ -1,0 +1,712 @@
+"""graftlint core: project model, shared AST cache, call graph, findings.
+
+The analyzer parses every file once into a :class:`Project` and shares the
+ASTs (plus per-function fact caches) across all checks — that is what keeps
+the tier-1 run under the 10 s budget.  Resolution is deliberately
+conservative: a call we cannot resolve statically is skipped, never
+guessed, so every finding corresponds to a concrete code path.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "ModuleInfo", "Project", "Suppression",
+    "load_baseline", "save_baseline", "split_by_baseline",
+]
+
+# inline suppression:  # graftlint: disable=GL001[,GL002] -- reason
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--\s*(\S.*?))?\s*$")
+
+_BUMP_ATTRS = ("inc", "dec", "set", "observe")
+_INSTRUMENT_CTORS = ("counter", "gauge", "histogram")
+
+# jax host-callback APIs: functions handed to these run on the HOST per
+# call, not at trace time — reachability walks must not cross into them
+_HOST_CALLBACKS = ("io_callback", "pure_callback", "callback",
+                   "debug_callback")
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str           # "GL001" .. "GL005", "GL000" for bad suppressions
+    path: str           # repo-relative posix path
+    line: int
+    message: str
+    detail: str         # stable (line-free) identity used for baselining
+
+    @property
+    def fingerprint(self) -> str:
+        return "%s|%s|%s" % (self.code, self.path, self.detail)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+
+@dataclass
+class Suppression:
+    path: str
+    line: int
+    codes: Set[str]
+    reason: Optional[str]
+
+
+class _Scope:
+    """Static scope info attached to every function/lambda node."""
+
+    __slots__ = ("mod", "cls", "qual", "locals", "owner")
+
+    def __init__(self, mod, cls, qual, owner):
+        self.mod = mod          # ModuleInfo
+        self.cls = cls          # enclosing class name or None
+        self.qual = qual        # dotted qualname within the module
+        self.locals = {}        # name -> nested FunctionDef
+        self.owner = owner      # enclosing function node or None
+
+
+@dataclass
+class CallSite:
+    node: ast.AST
+    line: int
+    chain: Optional[Tuple[str, ...]]   # dotted name parts, None if dynamic
+    canon: Optional[str]               # canonical external name if importable
+    targets: List[ast.AST]             # resolved in-project function nodes
+    is_ref: bool = False               # function passed as an argument
+
+
+@dataclass
+class EnvRead:
+    key: Optional[str]                 # None = dynamic (non-literal) key
+    line: int
+
+
+@dataclass
+class Bump:
+    instrument: str                    # module-global instrument name
+    metric: Optional[str]              # metric name literal if known
+    line: int
+
+
+@dataclass
+class FunctionFacts:
+    calls: List[CallSite] = field(default_factory=list)
+    env_reads: List[EnvRead] = field(default_factory=list)
+    bumps: List[Bump] = field(default_factory=list)
+
+
+class ModuleInfo:
+    def __init__(self, path: Path, rel: str, name: str, tree: ast.Module,
+                 source: str):
+        self.path = path
+        self.rel = rel
+        self.name = name
+        self.tree = tree
+        self.lines = source.splitlines()
+        if path.name == "__init__.py":
+            self.package = name
+        else:
+            self.package = name.rsplit(".", 1)[0] if "." in name else ""
+        self.functions: Dict[str, ast.AST] = {}      # qual -> def node
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.imports: Dict[str, str] = {}            # alias -> module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.consts: Dict[str, Any] = {}             # module-level literals
+        self.class_consts: Dict[Tuple[str, str], Any] = {}
+        # module globals assigned from telemetry counter/gauge/histogram()
+        self.instruments: Dict[str, Tuple[str, Optional[str], int]] = {}
+        self._suppressions: Optional[Dict[int, Suppression]] = None
+
+    # -- suppressions -----------------------------------------------------
+    def suppressions(self) -> Dict[int, Suppression]:
+        if self._suppressions is None:
+            out = {}
+            for i, text in enumerate(self.lines, start=1):
+                m = SUPPRESS_RE.search(text)
+                if not m:
+                    continue
+                codes = {c.strip().upper()
+                         for c in m.group(1).split(",") if c.strip()}
+                out[i] = Suppression(self.rel, i, codes, m.group(2))
+            self._suppressions = out
+        return self._suppressions
+
+    def suppression_for(self, line: int, code: str) -> Optional[Suppression]:
+        sup = self.suppressions()
+        for cand in (line, line - 1):
+            s = sup.get(cand)
+            if s is None or code not in s.codes:
+                continue
+            if cand == line:
+                return s
+            # directive on the previous line counts only if that line is
+            # a pure comment (a trailing directive binds to its own line)
+            text = self.lines[cand - 1].strip()
+            if text.startswith("#"):
+                return s
+        return None
+
+
+def _dotted(node) -> Optional[Tuple[str, ...]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _literal_strings(node) -> Optional[Tuple[str, ...]]:
+    """Tuple/list of string constants, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+class Project:
+    """Parsed view of one or more packages under a root directory."""
+
+    def __init__(self, root, packages: Sequence[str] = ("mxnet_tpu",),
+                 config: Optional[Dict[str, Any]] = None):
+        self.root = Path(root)
+        self.packages = tuple(packages)
+        self.config: Dict[str, Any] = dict(config or {})
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.parse_errors: List[Finding] = []
+        self._facts: Dict[int, FunctionFacts] = {}
+        self._load()
+
+    # -- loading / indexing ----------------------------------------------
+    def _load(self) -> None:
+        for pkg in self.packages:
+            base = self.root / pkg.replace(".", "/")
+            for path in sorted(base.rglob("*.py")):
+                rel = path.relative_to(self.root).as_posix()
+                stem = rel[:-3].replace("/", ".")
+                name = stem[:-len(".__init__")] \
+                    if stem.endswith(".__init__") else stem
+                try:
+                    source = path.read_text(encoding="utf-8")
+                    tree = ast.parse(source, filename=rel)
+                except (SyntaxError, UnicodeDecodeError) as exc:
+                    self.parse_errors.append(Finding(
+                        "GL000", rel, getattr(exc, "lineno", 1) or 1,
+                        "file does not parse: %s" % exc, "parse-error"))
+                    continue
+                mod = ModuleInfo(path, rel, name, tree, source)
+                self.modules[name] = mod
+                self._index(mod)
+
+    def _index(self, mod: ModuleInfo) -> None:
+        def add_import(node):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        mod.imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                src = node.module or ""
+                if node.level:
+                    parts = mod.package.split(".") if mod.package else []
+                    if node.level > 1:
+                        parts = parts[:len(parts) - (node.level - 1)]
+                    if src:
+                        parts = parts + src.split(".")
+                    src = ".".join(parts)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mod.from_imports[alias.asname or alias.name] = \
+                        (src, alias.name)
+
+        def record_const(target, value, cls):
+            if not isinstance(target, ast.Name):
+                return
+            lit: Any = None
+            if isinstance(value, ast.Constant) and \
+                    isinstance(value.value, str):
+                lit = value.value
+            else:
+                lit = _literal_strings(value)
+            if lit is None:
+                return
+            if cls is None:
+                mod.consts[target.id] = lit
+            else:
+                mod.class_consts[(cls, target.id)] = lit
+
+        def record_instrument(target, value):
+            if not (isinstance(target, ast.Name) and
+                    isinstance(value, ast.Call)):
+                return
+            chain = _dotted(value.func)
+            if not chain or chain[-1] not in _INSTRUMENT_CTORS:
+                return
+            base_ok = len(chain) == 1 or "telemetry" in chain[0].lower()
+            if not base_ok:
+                canon = self.canonical(mod, chain)
+                base_ok = bool(canon) and "telemetry" in canon
+            if not base_ok:
+                return
+            metric = None
+            if value.args and isinstance(value.args[0], ast.Constant) \
+                    and isinstance(value.args[0].value, str):
+                metric = value.args[0].value
+            mod.instruments[target.id] = \
+                (chain[-1], metric, value.lineno)
+
+        def rec(node, cls, qual_parts, owner):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    add_import(child)
+                    continue
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(qual_parts + [child.name])
+                    child._gl = _Scope(mod, cls, qual, owner)  # type: ignore
+                    mod.functions[qual] = child
+                    if owner is not None:
+                        owner._gl.locals[child.name] = child
+                    rec(child, cls, qual_parts + [child.name], child)
+                elif isinstance(child, ast.Lambda):
+                    qual = ".".join(qual_parts + ["<lambda>"])
+                    child._gl = _Scope(mod, cls, qual, owner)  # type: ignore
+                    rec(child, cls, qual_parts, owner)
+                elif isinstance(child, ast.ClassDef):
+                    mod.classes[child.name] = child
+                    bases = []
+                    for base in child.bases:
+                        d = _dotted(base)
+                        if d:
+                            bases.append(".".join(d))
+                    mod.class_bases[child.name] = bases
+                    rec(child, child.name, [child.name], None)
+                else:
+                    if isinstance(child, ast.Assign) and owner is None:
+                        for tgt in child.targets:
+                            record_const(tgt, child.value, cls)
+                            if cls is None:
+                                record_instrument(tgt, child.value)
+                    rec(child, cls, qual_parts, owner)
+
+        rec(mod.tree, None, [], None)
+
+    # -- name resolution --------------------------------------------------
+    def canonical(self, mod: ModuleInfo,
+                  chain: Optional[Tuple[str, ...]]) -> Optional[str]:
+        """Absolute dotted name for an imported chain ('jax.jit',
+        'os.environ.get'), or None for local/unresolvable names."""
+        if not chain:
+            return None
+        head = chain[0]
+        if head in mod.imports:
+            return ".".join((mod.imports[head],) + chain[1:])
+        if head in mod.from_imports:
+            src, attr = mod.from_imports[head]
+            base = src + "." + attr if src else attr
+            return ".".join((base,) + chain[1:])
+        return None
+
+    def _lookup_method(self, mod: ModuleInfo, cls: str,
+                       attr: str, depth: int = 0) -> Optional[ast.AST]:
+        fn = mod.functions.get(cls + "." + attr)
+        if fn is not None:
+            return fn
+        if depth >= 2:
+            return None
+        for base in mod.class_bases.get(cls, ()):
+            parts = base.split(".")
+            if len(parts) == 1:
+                if parts[0] in mod.classes:
+                    got = self._lookup_method(mod, parts[0], attr, depth + 1)
+                    if got is not None:
+                        return got
+                elif parts[0] in mod.from_imports:
+                    src, name = mod.from_imports[parts[0]]
+                    bmod = self.modules.get(src)
+                    if bmod is not None and name in bmod.classes:
+                        got = self._lookup_method(bmod, name, attr, depth + 1)
+                        if got is not None:
+                            return got
+        return None
+
+    def _module_attr(self, modname: str, attr: str) -> Optional[ast.AST]:
+        tm = self.modules.get(modname)
+        if tm is None:
+            return None
+        return tm.functions.get(attr)
+
+    def resolve_chain(self, mod: ModuleInfo, scope: Optional[_Scope],
+                      chain: Tuple[str, ...]) -> List[ast.AST]:
+        """In-project function nodes a dotted call name may refer to."""
+        head = chain[0]
+        if len(chain) == 1:
+            cur = scope
+            while cur is not None:
+                if head in cur.locals:
+                    return [cur.locals[head]]
+                cur = cur.owner._gl if cur.owner is not None else None
+            if head in mod.functions:
+                return [mod.functions[head]]
+            if head in mod.from_imports:
+                src, attr = mod.from_imports[head]
+                got = self._module_attr(src, attr)
+                if got is not None:
+                    return [got]
+            return []
+        if head == "self" and scope is not None and scope.cls:
+            got = self._lookup_method(mod, scope.cls, chain[1])
+            if got is not None and len(chain) == 2:
+                return [got]
+            return []
+        if head in mod.classes and len(chain) == 2:
+            got = self._lookup_method(mod, head, chain[1])
+            return [got] if got is not None else []
+        if head in mod.imports:
+            target = ".".join([mod.imports[head]] + list(chain[1:-1]))
+            got = self._module_attr(target, chain[-1])
+            return [got] if got is not None else []
+        if head in mod.from_imports:
+            src, attr = mod.from_imports[head]
+            base = src + "." + attr if src else attr
+            # `from . import sibling` -> sibling.fn(...)
+            target = ".".join([base] + list(chain[1:-1]))
+            got = self._module_attr(target, chain[-1])
+            if got is not None:
+                return [got]
+            # `from .mod import Cls` -> Cls.static(...)
+            smod = self.modules.get(src)
+            if smod is not None and attr in smod.classes and len(chain) == 2:
+                got = self._lookup_method(smod, attr, chain[1])
+                if got is not None:
+                    return [got]
+        return []
+
+    def const_str(self, mod: ModuleInfo, scope: Optional[_Scope],
+                  node) -> Optional[str]:
+        """String value of a Constant or a Name bound to a module/class
+        level string constant."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if scope is not None and scope.cls is not None:
+                got = mod.class_consts.get((scope.cls, node.id))
+                if isinstance(got, str):
+                    return got
+            got = mod.consts.get(node.id)
+            if isinstance(got, str):
+                return got
+        return None
+
+    # -- per-function facts ----------------------------------------------
+    def facts(self, fn: ast.AST) -> FunctionFacts:
+        cached = self._facts.get(id(fn))
+        if cached is not None:
+            return cached
+        facts = self._extract_facts(fn)
+        self._facts[id(fn)] = facts
+        return facts
+
+    def _extract_facts(self, fn: ast.AST) -> FunctionFacts:
+        scope: _Scope = fn._gl  # type: ignore[attr-defined]
+        mod = scope.mod
+        facts = FunctionFacts()
+
+        skip_keys: Set[str] = set()
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in list(args.posonlyargs) + list(args.args) + \
+                    list(args.kwonlyargs):
+                skip_keys.add(a.arg)
+            if args.vararg:
+                skip_keys.add(args.vararg.arg)
+            if args.kwarg:
+                skip_keys.add(args.kwarg.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                    ast.GeneratorExp)):
+                    for gen in sub.generators:
+                        for t in ast.walk(gen.target):
+                            if isinstance(t, ast.Name):
+                                skip_keys.add(t.id)
+                elif isinstance(sub, ast.For):
+                    for t in ast.walk(sub.target):
+                        if isinstance(t, ast.Name):
+                            skip_keys.add(t.id)
+
+        def env_key(call, kind):
+            # kind: "get" (key is args[0]) / "getenv" / "get_env"
+            if not call.args:
+                return
+            key = self.const_str(mod, scope, call.args[0])
+            if key is not None:
+                facts.env_reads.append(EnvRead(key, call.lineno))
+                return
+            node = call.args[0]
+            if isinstance(node, ast.Name) and node.id in skip_keys:
+                return  # keyed accessor pattern (get_env/_step_env style)
+            facts.env_reads.append(EnvRead(None, call.lineno))
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested defs are separate analysis units
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                canon = self.canonical(mod, chain) if chain else None
+                targets = self.resolve_chain(mod, scope, chain) \
+                    if chain else []
+                facts.calls.append(CallSite(
+                    node, node.lineno, chain, canon, targets))
+                # env reads
+                if canon in ("os.environ.get", "os.getenv"):
+                    env_key(node, "get")
+                elif chain and chain[-1] == "get_env" and \
+                        fn_name(fn) != "get_env":
+                    env_key(node, "get_env")
+                elif chain and len(chain) >= 2 and \
+                        chain[-2:] == ("environ", "get") and \
+                        (chain[0] == "os" or canon is None and
+                         chain[0] == "environ"):
+                    env_key(node, "get")
+                # telemetry bump: G.inc() / G.labels(...).inc()
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _BUMP_ATTRS:
+                    base = node.func.value
+                    name = None
+                    if isinstance(base, ast.Call):
+                        inner = _dotted(base.func)
+                        if inner and inner[-1] == "labels" and \
+                                len(inner) == 2:
+                            name = inner[0]
+                    elif isinstance(base, ast.Name):
+                        name = base.id
+                    if name is not None and name in mod.instruments:
+                        kind, metric, _ = mod.instruments[name]
+                        facts.bumps.append(Bump(name, metric, node.lineno))
+                # function-valued arguments become edges (traced
+                # callbacks) — except through jax host-callback APIs,
+                # whose targets run on the host per call
+                if not (chain and chain[-1] in _HOST_CALLBACKS):
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            refs = self.resolve_chain(mod, scope, (arg.id,))
+                            if refs:
+                                facts.calls.append(CallSite(
+                                    arg, arg.lineno, (arg.id,), None,
+                                    refs, is_ref=True))
+            elif isinstance(node, ast.Subscript):
+                chain = _dotted(node.value)
+                canon = self.canonical(mod, chain) if chain else None
+                if canon == "os.environ" or \
+                        (chain and chain[-2:] == ("os", "environ")):
+                    key = self.const_str(mod, scope, node.slice)
+                    if key is not None:
+                        facts.env_reads.append(EnvRead(key, node.lineno))
+                    elif not (isinstance(node.slice, ast.Name) and
+                              node.slice.id in skip_keys):
+                        facts.env_reads.append(EnvRead(None, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+        return facts
+
+    # -- reachability ------------------------------------------------------
+    def reachable(self, roots: Iterable[ast.AST],
+                  max_nodes: int = 5000) -> List[ast.AST]:
+        """Functions reachable from ``roots`` through resolvable calls
+        (lambdas are transparent: their bodies belong to the enclosing
+        function's facts)."""
+        seen_ids: Set[int] = set()
+        out: List[ast.AST] = []
+        stack = list(roots)
+        while stack and len(out) < max_nodes:
+            fn = stack.pop()
+            if id(fn) in seen_ids:
+                continue
+            seen_ids.add(id(fn))
+            out.append(fn)
+            for site in self.facts(fn).calls:
+                for tgt in site.targets:
+                    if id(tgt) not in seen_ids:
+                        stack.append(tgt)
+        return out
+
+    # -- traced-root discovery (shared by GL001/GL002/GL004) --------------
+    def jit_roots(self) -> List[Tuple[str, ModuleInfo, ast.AST, int]]:
+        """(kind, module, function-node, line) for every function that is
+        handed to a tracer: jax.jit / custom_vjp(+defvjp) / pallas_call /
+        shard_map / platform_dependent."""
+        out = []
+        seen: Set[int] = set()
+
+        def add(kind, mod, fnode, line):
+            if fnode is None or id(fnode) in seen:
+                return
+            seen.add(id(fnode))
+            out.append((kind, mod, fnode, line))
+
+        def callable_arg(mod, scope, node):
+            if isinstance(node, ast.Lambda):
+                return node
+            if isinstance(node, ast.Name):
+                got = self.resolve_chain(mod, scope, (node.id,))
+                return got[0] if got else None
+            chain = _dotted(node)
+            if chain:
+                got = self.resolve_chain(mod, scope, chain)
+                return got[0] if got else None
+            return None
+
+        for mod in self.modules.values():
+            for fn in list(mod.functions.values()):
+                scope: _Scope = fn._gl  # type: ignore[attr-defined]
+                # decorators
+                for dec in getattr(fn, "decorator_list", ()):
+                    canon = None
+                    call = None
+                    if isinstance(dec, ast.Call):
+                        call = dec
+                        canon = self.canonical(mod, _dotted(dec.func))
+                        if canon and canon.endswith("functools.partial") or \
+                                canon == "functools.partial" or \
+                                (canon or "").endswith(".partial"):
+                            if call.args:
+                                inner = self.canonical(
+                                    mod, _dotted(call.args[0]))
+                                if inner and (
+                                        inner.endswith(".jit") or
+                                        inner.endswith("custom_vjp")):
+                                    add("jit" if inner.endswith(".jit")
+                                        else "custom_vjp",
+                                        mod, fn, dec.lineno)
+                            continue
+                    else:
+                        canon = self.canonical(mod, _dotted(dec))
+                    if canon is None:
+                        continue
+                    if canon.endswith(".jit") and canon.startswith("jax"):
+                        add("jit", mod, fn, dec.lineno)
+                    elif canon.endswith("custom_vjp"):
+                        add("custom_vjp", mod, fn, dec.lineno)
+                # call sites inside this function
+                for site in self.facts(fn).calls:
+                    if site.is_ref or not site.chain:
+                        continue
+                    canon = site.canon or ""
+                    last = site.chain[-1]
+                    call = site.node
+                    if (canon.startswith("jax") and canon.endswith(".jit")) \
+                            or last == "jit":
+                        if call.args:
+                            add("jit", mod, callable_arg(
+                                mod, scope, call.args[0]), call.lineno)
+                    elif last == "pallas_call" or \
+                            canon.endswith("pallas_call"):
+                        if call.args:
+                            add("pallas", mod, callable_arg(
+                                mod, scope, call.args[0]), call.lineno)
+                    elif last == "shard_map" or canon.endswith("shard_map"):
+                        if call.args:
+                            add("shard_map", mod, callable_arg(
+                                mod, scope, call.args[0]), call.lineno)
+                    elif last == "defvjp":
+                        for arg in call.args:
+                            add("custom_vjp", mod, callable_arg(
+                                mod, scope, arg), call.lineno)
+                    elif last == "platform_dependent" or \
+                            canon.endswith("platform_dependent"):
+                        for kw in call.keywords:
+                            add("platform_dependent", mod, callable_arg(
+                                mod, scope, kw.value), call.lineno)
+        return out
+
+    def registered_ops(self):
+        """(module, op_name, env_keys, fn_node, line) for every function
+        decorated with the op registry's ``@register(...)``."""
+        out = []
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                for dec in getattr(fn, "decorator_list", ()):
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    chain = _dotted(dec.func)
+                    if not chain or chain[-1] != "register":
+                        continue
+                    canon = self.canonical(mod, chain) or ""
+                    if not (canon.endswith("registry.register") or
+                            chain == ("register",)):
+                        continue
+                    op_name = fn_name(fn)
+                    if dec.args and isinstance(dec.args[0], ast.Constant) \
+                            and isinstance(dec.args[0].value, str):
+                        op_name = dec.args[0].value
+                    env_keys: Tuple[str, ...] = ()
+                    for kw in dec.keywords:
+                        if kw.arg == "env_keys":
+                            env_keys = _literal_strings(kw.value) or ()
+                    out.append((mod, op_name, env_keys, fn, dec.lineno))
+        return out
+
+
+def fn_name(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+def fn_qual(fn: ast.AST) -> str:
+    scope = getattr(fn, "_gl", None)
+    if scope is None:
+        return fn_name(fn)
+    return "%s.%s" % (scope.mod.name, scope.qual)
+
+
+# -- baseline --------------------------------------------------------------
+
+def load_baseline(path) -> List[str]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text(encoding="utf-8"))
+    if isinstance(data, dict):
+        return list(data.get("fingerprints", []))
+    return list(data)
+
+
+def save_baseline(path, fingerprints: Iterable[str]) -> None:
+    payload = {"version": 1, "fingerprints": sorted(set(fingerprints))}
+    Path(path).write_text(
+        json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def split_by_baseline(findings: Sequence[Finding], baseline: Sequence[str]):
+    """-> (new, baselined, stale_fingerprints)"""
+    base = set(baseline)
+    new, old = [], []
+    seen = set()
+    for f in findings:
+        fp = f.fingerprint
+        seen.add(fp)
+        (old if fp in base else new).append(f)
+    stale = sorted(base - seen)
+    return new, old, stale
