@@ -579,6 +579,16 @@ def main():
                                      if p.donation_leak),
         }
 
+    # per-layer attribution (satellite, round 10): which scopes own the
+    # MFU gap — top-10 flops/bytes shares per analyzed program, next to
+    # the health aggregates above.  Never fails the primary metric.
+    if "--atlas" in sys.argv or os.environ.get("BENCH_ATLAS", "0") != "0":
+        try:
+            from mxnet_tpu import atlas as _atlas
+            result["atlas"] = _atlas.snapshot(top_k=10)
+        except Exception as e:
+            result["atlas"] = {"error": repr(e)[:200]}
+
     # per-phase breakdown (satellite, round 7): where does a step's time
     # go — never fails the primary metric
     if os.environ.get("BENCH_PHASES", "1") != "0":

@@ -23,6 +23,7 @@ import numpy as np
 
 from .base import MXNetError, AttrDict
 from .context import Context
+from . import atlas as _atlas
 from . import random as _random
 from . import telemetry as _telemetry
 from . import health as _health
@@ -30,14 +31,20 @@ from . import health as _health
 __all__ = ["Executor"]
 
 # wall-time histograms fed through profiler.span so the Chrome trace and
-# the metrics registry share one measurement per call
+# the metrics registry share one measurement per call.  These measure the
+# python DISPATCH of the (async) jitted program — on the fused/mesh paths
+# the device executes long after the span closes — hence the _dispatch_
+# names; device-side attribution lives in atlas.py / health.py
 _FWD_TIME = _telemetry.histogram(
-    "executor_forward_seconds", "Executor.forward wall time")
+    "executor_forward_dispatch_seconds",
+    "Executor.forward dispatch wall time (async: excludes device execution)")
 _BWD_TIME = _telemetry.histogram(
-    "executor_backward_seconds", "Executor.backward wall time")
+    "executor_backward_dispatch_seconds",
+    "Executor.backward dispatch wall time (async: excludes device execution)")
 _FWDBWD_TIME = _telemetry.histogram(
-    "executor_forward_backward_seconds",
-    "Fused Executor.forward_backward wall time")
+    "executor_forward_backward_dispatch_seconds",
+    "Fused Executor.forward_backward dispatch wall time (async: excludes "
+    "device execution)")
 
 # whole-graph program observability: the executor's jitted forward is one
 # XLA program per (mode, input-shape signature), so its cache lookups join
@@ -129,7 +136,12 @@ class _Plan:
                 ins = [_jax.device_put(x, dev) for x in ins]
             if rng_slot is not None:
                 ins = [keys[rng_slot]] + ins
-            res = node.op.fn(attrs, *ins)
+            # atlas scope: the node's identity survives into the lowered
+            # module's debug locations (and through vjp as jvp/transpose
+            # wrappers), so fused-program instructions attribute per layer
+            with _jax.named_scope(
+                    _atlas.scope_name(node.op.name, node.name)):
+                res = node.op.fn(attrs, *ins)
             outs = res if isinstance(res, tuple) else (res,)
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
@@ -236,7 +248,9 @@ class _Segment:
                 vals = [env[(id(p), i)] for p, i in node.inputs]
                 if rng_slot is not None:
                     vals = [keys[rng_slot]] + vals
-                res = node.op.fn(attrs, *vals)
+                with _jax.named_scope(
+                        _atlas.scope_name(node.op.name, node.name)):
+                    res = node.op.fn(attrs, *vals)
                 outs = res if isinstance(res, tuple) else (res,)
                 for i, o in enumerate(outs):
                     env[(id(node), i)] = o
@@ -262,10 +276,13 @@ def build_update_program(update_fns, donate_params=True):
     def fn(pvals, svals, gvals, lrs, wds, ts, rescale):
         new_p, new_s = [], []
         for i, upd in enumerate(update_fns):
-            g = gvals[i][0]
-            for extra in gvals[i][1:]:
-                g = g + extra
-            w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale, ts[i])
+            with jax.named_scope(_atlas.GRAD_SYNC):
+                g = gvals[i][0]
+                for extra in gvals[i][1:]:
+                    g = g + extra
+            with jax.named_scope(_atlas.optimizer_scope(upd)):
+                w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale,
+                           ts[i])
             new_p.append(w)
             new_s.append(s)
         return new_p, new_s
@@ -427,6 +444,16 @@ class Executor:
     def _plan_env(self, train: bool = True):
         return self._plan_env_of(self._plan(train))
 
+    def _program_env(self, plan: Optional["_Plan"] = None):
+        """{env key: current value} snapshot of everything in a program's
+        cache key — recorded with health registrations so flight-recorder
+        dumps can tie a crash back to the formulation flags that built the
+        live programs."""
+        keys = self.STEP_ENV_KEYS + (plan.env_keys if plan is not None
+                                     else ())
+        import os
+        return {k: os.environ.get(k) for k in keys}
+
     def _step_key(self, mesh_sig=None):
         """Cache key of the fused whole-step program — also the first_run
         probe used by fused_step drivers, so key shape changes stay in ONE
@@ -489,8 +516,9 @@ class Executor:
             grads = vjp((list(ograds), [jnp.zeros_like(a) for a in new_aux]))
             new_p, new_s = [], []
             for i, upd in enumerate(update_fns):
-                w, s = upd(pvals[i], grads[i], svals[i],
-                           lrs[i], wds[i], rescale, ts[i])
+                with jax.named_scope(_atlas.optimizer_scope(upd)):
+                    w, s = upd(pvals[i], grads[i], svals[i],
+                               lrs[i], wds[i], rescale, ts[i])
                 if param_shardings is not None:
                     sh = param_shardings[i]
                     w = jax.lax.with_sharding_constraint(w, sh)
@@ -574,7 +602,9 @@ class Executor:
             else:
                 self._jitted[skey] = True
                 _PROG_MISSES.labels(op="Executor::Forward").inc()
-        with _profiler.span("Executor::Forward", "executor",
+        # dispatch-only span: the jitted call returns before the device
+        # finishes (async dispatch), so this is NOT an execution timing
+        with _profiler.span("Executor::ForwardDispatch", "executor",
                             histogram=_FWD_TIME,
                             args={"first_run": first_run}):
             if self._monitor is not None:
@@ -591,7 +621,8 @@ class Executor:
                     # lowering-only analysis: the call below still owns
                     # the one and only compilation
                     _health.register_program("forward", fwd,
-                                             (args, auxs, keys))
+                                             (args, auxs, keys),
+                                             env=self._program_env(plan))
                 outs, new_aux = fwd(args, auxs, keys)
         if is_train:
             self._writeback_aux(new_aux)
@@ -617,13 +648,14 @@ class Executor:
         args, auxs = self._gather()
         from . import profiler as _profiler
         first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
-        with _profiler.span("Executor::Backward", "executor",
+        with _profiler.span("Executor::BackwardDispatch", "executor",
                             histogram=_BWD_TIME,
                             args={"first_run": first_run}):
             fb = self._fwd_bwd_fn()
             if first_run and _health.enabled:
                 _health.register_program("fwdbwd", fb,
-                                         (args, auxs, keys, ogs))
+                                         (args, auxs, keys, ogs),
+                                         env=self._program_env(plan))
             outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._apply_grads(grads)
         return
@@ -647,13 +679,14 @@ class Executor:
                    for g in out_grads]
         from . import profiler as _profiler
         first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
-        with _profiler.span("Executor::ForwardBackward", "executor",
+        with _profiler.span("Executor::ForwardBackwardDispatch", "executor",
                             histogram=_FWDBWD_TIME,
                             args={"first_run": first_run}):
             fb = self._fwd_bwd_fn()
             if first_run and _health.enabled:
                 _health.register_program("fwdbwd", fb,
-                                         (args, auxs, keys, ogs))
+                                         (args, auxs, keys, ogs),
+                                         env=self._program_env(plan))
             outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._writeback_aux(new_aux)
             self._apply_grads(grads)

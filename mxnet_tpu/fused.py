@@ -145,16 +145,19 @@ class FusedTrainer:
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(args)
             new_args, new_moms = {}, {}
-            for k in args:
-                g = grads[k].astype(args[k].dtype)
-                if wd:
-                    g = g + (wd * wd_mult[k]) * args[k]
-                if momentum != 0.0:
-                    m2 = momentum * moms[k] - lr * g
-                    new_args[k] = args[k] + m2
-                    new_moms[k] = m2
-                else:
-                    new_args[k] = args[k] - lr * g
+            # inline SGD below carries the same atlas scope the Optimizer
+            # classes get, so /programz ranks it alongside fused_step paths
+            with jax.named_scope("Optimizer::SGD"):
+                for k in args:
+                    g = grads[k].astype(args[k].dtype)
+                    if wd:
+                        g = g + (wd * wd_mult[k]) * args[k]
+                    if momentum != 0.0:
+                        m2 = momentum * moms[k] - lr * g
+                        new_args[k] = args[k] + m2
+                        new_moms[k] = m2
+                    else:
+                        new_args[k] = args[k] - lr * g
             return new_args, new_aux, new_moms, loss
 
         self._jstep = _step
@@ -190,10 +193,13 @@ class FusedTrainer:
             # lowering-only analysis: no compile, the dispatch below still
             # owns the one and only compilation of this program
             self._health_registered = True
+            import os as _os
             _health.register_program(
                 "fused_trainer_step", self._jstep,
                 (args, auxs, moms, d, l, jnp.float32(self._lr), keys),
-                donated=True)
+                donated=True,
+                env={k: _os.environ.get(k)
+                     for k in self._plan.env_keys})
             donated_in = (args, auxs, moms)
         args, auxs, moms, loss = self._jstep(
             args, auxs, moms, d, l, jnp.float32(self._lr), keys)

@@ -31,6 +31,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from . import atlas as _atlas
 from . import telemetry as _telemetry
 from . import health as _health
 
@@ -71,6 +72,12 @@ def mesh_enabled():
 def _env_tuple():
     from .executor import Executor
     return tuple(os.environ.get(k) for k in Executor.STEP_ENV_KEYS)
+
+
+def _env_dict():
+    """_env_tuple as {key: value} — the health/flight-dump snapshot form."""
+    from .executor import Executor
+    return {k: os.environ.get(k) for k in Executor.STEP_ENV_KEYS}
 
 
 class DonationPool:
@@ -381,7 +388,8 @@ class ModuleFusedStep:
             # one and only compilation of this program
             _health.register_program(
                 "step", fn, (pvals, svals, others, auxs, keys, ogs, lrs,
-                             wds, ts, rescale), donated=True)
+                             wds, ts, rescale), donated=True,
+                env=ex._program_env(plan))
         with _profiler.span("Executor::FusedStep", "executor",
                             args={"first_run": first_run}):
             new_p, new_s, outs, new_aux = fn(
@@ -425,7 +433,8 @@ class ModuleFusedStep:
             if first_run and k == 0 and _health.enabled:
                 _health.register_program(
                     "update", fn, (pvals, svals, gvals, lrs, wds, ts,
-                                   rescale), donated=True)
+                                   rescale), donated=True,
+                    env=ex._program_env())
             with _profiler.span("Executor::FusedUpdate", "executor"):
                 new_p, new_s = fn(pvals, svals, gvals, lrs, wds, ts, rescale)
             if first_run and k == 0 and _health.enabled:
@@ -614,7 +623,8 @@ class ModuleFusedStep:
         if first_run and _health.enabled:
             _health.register_program(
                 "mesh_step", fn, (pvals, svals, others, auxs, keys, ogs,
-                                  lrs, wds, ts, rescale), donated=True)
+                                  lrs, wds, ts, rescale), donated=True,
+                env=ex._program_env(plan))
         with _profiler.span("Mesh::Step", "executor",
                             args={"first_run": first_run,
                                   "mesh": str(dict(mesh.shape))}):
@@ -767,7 +777,8 @@ class TrainerFusedUpdate:
                 (d0["p"], d0["s"], d0["g"],
                  jnp.asarray(d0["lr"], jnp.float32),
                  jnp.asarray(d0["wd"], jnp.float32),
-                 jnp.asarray(d0["t"], jnp.float32), rescale), donated=True)
+                 jnp.asarray(d0["t"], jnp.float32), rescale), donated=True,
+                env=_env_dict())
         for k in range(ncty):
             d = per_dev[k]
             with _profiler.span("Trainer::FusedUpdate", "executor"):
@@ -812,9 +823,13 @@ def build_mesh_update_program(update_fns, ndev, out_sharding):
     def fn(pvals, svals, gvals, lrs, wds, ts, rescale):
         new_p, new_s = [], []
         for i, upd in enumerate(update_fns):
-            g = gvals[i]
-            g = g.reshape((ndev, g.shape[0] // ndev) + g.shape[1:]).sum(0)
-            w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale, ts[i])
+            with jax.named_scope(_atlas.GRAD_SYNC):
+                g = gvals[i]
+                g = g.reshape((ndev, g.shape[0] // ndev) + g.shape[1:]) \
+                     .sum(0)
+            with jax.named_scope(_atlas.optimizer_scope(upd)):
+                w, s = upd(pvals[i], g, svals[i], lrs[i], wds[i], rescale,
+                           ts[i])
             w = jax.lax.with_sharding_constraint(w, out_sharding)
             s = jax.tree_util.tree_map(
                 lambda a: jax.lax.with_sharding_constraint(a, out_sharding),
@@ -953,7 +968,8 @@ class TrainerMeshUpdate:
                 (pvals, svals, gvals,
                  jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
                  jnp.asarray(ts, jnp.float32),
-                 jnp.asarray(opt_.rescale_grad, jnp.float32)), donated=True)
+                 jnp.asarray(opt_.rescale_grad, jnp.float32)), donated=True,
+                env=_env_dict())
         with _profiler.span("Mesh::Step", "executor",
                             args={"path": "trainer",
                                   "mesh": str(dict(mesh.shape))}):

@@ -165,13 +165,17 @@ class ProgramCost(object):
 
     ``temp_bytes`` is None unless deep mode compiled the program;
     ``donated_bytes`` / ``donation_leak`` are filled in by
-    :func:`audit_donation` after the first execution."""
+    :func:`audit_donation` after the first execution.  ``env`` is the
+    registering site's snapshot of the env flags in the program's cache
+    key ({key: value-at-build}), so post-mortem dumps can tie a cached
+    program back to the formulation flags that built it."""
 
     __slots__ = ("name", "flops", "arg_bytes", "out_bytes", "temp_bytes",
-                 "donated_bytes", "donation_requested", "donation_leak")
+                 "donated_bytes", "donation_requested", "donation_leak",
+                 "env")
 
     def __init__(self, name, flops, arg_bytes, out_bytes, temp_bytes,
-                 donation_requested):
+                 donation_requested, env=None):
         self.name = name
         self.flops = flops
         self.arg_bytes = arg_bytes
@@ -180,13 +184,15 @@ class ProgramCost(object):
         self.donated_bytes = None
         self.donation_requested = donation_requested
         self.donation_leak = False
+        self.env = dict(env or {})
 
     def as_dict(self):
         return {"flops": self.flops, "arg_bytes": self.arg_bytes,
                 "out_bytes": self.out_bytes, "temp_bytes": self.temp_bytes,
                 "donated_bytes": self.donated_bytes,
                 "donation_requested": self.donation_requested,
-                "donation_leak": self.donation_leak}
+                "donation_leak": self.donation_leak,
+                "env": self.env}
 
 
 _programs = {}
@@ -213,7 +219,7 @@ def _tree_bytes(tree):
     return sum(_leaf_bytes(leaf) for leaf in jax.tree_util.tree_leaves(tree))
 
 
-def register_program(name, fn, args, kwargs=None, donated=False):
+def register_program(name, fn, args, kwargs=None, donated=False, env=None):
     """Analyze a jitted callable right before its first invocation.
 
     Lowering only (trace, no XLA compile — on this jax an AOT
@@ -222,9 +228,10 @@ def register_program(name, fn, args, kwargs=None, donated=False):
     ``Lowered.cost_analysis()``, argument/output bytes from the avals.
     With ``MXNET_HEALTH_DEEP=1`` the program IS additionally AOT-compiled
     for ``memory_analysis()`` temp bytes — one extra XLA compile each,
-    opt-in.  Returns the :class:`ProgramCost` or None (disabled,
-    non-jitted fn, or any analysis failure — health must never break the
-    training step).
+    opt-in.  ``env`` (a {cache-key env var: value} snapshot from the
+    registering site) is stored on the cost record for post-mortem dumps.
+    Returns the :class:`ProgramCost` or None (disabled, non-jitted fn, or
+    any analysis failure — health must never break the training step).
     """
     if not enabled or not hasattr(fn, "lower"):
         return None
@@ -242,9 +249,14 @@ def register_program(name, fn, args, kwargs=None, donated=False):
             tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
     except Exception:
         return None
-    pc = ProgramCost(name, flops, arg_b, out_b, tmp_b, donated)
+    pc = ProgramCost(name, flops, arg_b, out_b, tmp_b, donated, env=env)
     with _programs_lock:
         _programs[name] = pc
+    try:
+        from . import atlas as _atlas
+        _atlas.analyze(name, lowered, cost_flops=flops)
+    except Exception:
+        pass
     _PROG_FLOPS.labels(program=name).set(flops)
     _PROG_HBM.labels(program=name, kind="args").set(arg_b)
     _PROG_HBM.labels(program=name, kind="output").set(out_b)

@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from ..base import AttrDict, MXNetError
+from .. import atlas as _atlas
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
 
@@ -218,7 +219,16 @@ class Operator:
         if _telemetry.enabled:
             _JIT_MISSES.labels(op=self.name).inc()
         fn = self.fn
-        jfn = jax.jit(lambda *arrays: fn(attrs, *arrays))
+        # Scope choke point: per-op jitted programs carry an anonymous
+        # atlas scope ("<OpType>:~" — no graph node here) so single-op
+        # lowerings attribute the same way fused plans do.
+        scope = _atlas.scope_name(self.name)
+
+        def _scoped(*arrays):
+            with jax.named_scope(scope):
+                return fn(attrs, *arrays)
+
+        jfn = jax.jit(_scoped)
         name, cache = self.name, self._jit_cache
 
         def _first_call(*arrays):

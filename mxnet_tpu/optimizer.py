@@ -171,6 +171,12 @@ class Optimizer:
         ``(new_w, new_state_leaves)``.  All array args are jax values."""
         raise MXNetError("%s has no fused update" % type(self).__name__)
 
+    def atlas_scope_name(self):
+        """Name the atlas uses for this optimizer's update stage inside
+        fused programs (``Optimizer::<name>``).  Override to disambiguate
+        wrappers/subclasses that share a class name."""
+        return type(self).__name__
+
     def _fused_dtype_ok(self, weight):
         # fused restricts to fp32 weights: multi-precision carries a
         # master-fp32 copy in the state tuple with per-optimizer layout,

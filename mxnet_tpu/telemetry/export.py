@@ -104,8 +104,10 @@ _server_lock = threading.Lock()
 
 def start_http_server(port: int, registry: MetricRegistry,
                       host: str = "127.0.0.1"):
-    """Serve ``/metrics`` (text exposition), ``/metrics.json`` and
-    ``/statusz`` (health snapshot) on a daemon thread.  Binds loopback by
+    """Serve ``/metrics`` (text exposition), ``/metrics.json``,
+    ``/statusz`` (health snapshot) and ``/programz`` (registered XLA
+    programs with their atlas per-scope tables) on a daemon thread.
+    ``/programz?top_k=N`` bounds each program's scope table.  Binds loopback by
     default — the wire is unauthenticated, so exposing it wider is an
     explicit operator choice (``MXNET_TELEMETRY_HOST``).  Returns the
     bound port."""
@@ -113,7 +115,7 @@ def start_http_server(port: int, registry: MetricRegistry,
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path in ("/", "/metrics"):
                 body = prometheus_text(registry).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -125,6 +127,22 @@ def start_http_server(port: int, registry: MetricRegistry,
                 # top-level import here would be circular
                 from .. import health as _health
                 body = json.dumps(_health.statusz()).encode()
+                ctype = "application/json"
+            elif path == "/programz":
+                # lazy imports for the same circularity reason as /statusz
+                from .. import atlas as _atlas
+                from .. import health as _health
+                top_k = 10
+                for part in query.split("&"):
+                    if part.startswith("top_k="):
+                        try:
+                            top_k = int(part[len("top_k="):])
+                        except ValueError:
+                            pass
+                doc = {"programs": {n: pc.as_dict()
+                                    for n, pc in _health.programs().items()},
+                       "atlas": _atlas.snapshot(top_k=top_k)}
+                body = json.dumps(doc).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)

@@ -373,6 +373,25 @@ class FlightRecorder:
                        "role": os.environ.get("DMLC_ROLE", "worker"),
                        "t0_unix_us": time.time() * 1e6 - _profiler._now_us(),
                        "events": events}
+                # post-mortem program context: which cached XLA programs
+                # were live (cost + the env flags that built them), plus
+                # the atlas per-scope tables when available.  The programs
+                # block does not depend on atlas being enabled.
+                try:
+                    from . import health as _health
+                    progs = {n: pc.as_dict()
+                             for n, pc in _health.programs().items()}
+                    if progs:
+                        doc["programs"] = progs
+                except Exception:
+                    pass
+                try:
+                    from . import atlas as _atlas
+                    at = _atlas.snapshot(top_k=10)
+                    if at:
+                        doc["atlas"] = at
+                except Exception:
+                    pass
                 path = self.path()
                 tmp = "%s.tmp.%d" % (path, os.getpid())
                 with open(tmp, "w") as f:

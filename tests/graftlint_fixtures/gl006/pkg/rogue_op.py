@@ -1,0 +1,19 @@
+"""Known-bad: ops opening their own scopes corrupt atlas attribution."""
+import jax
+import jax as _jax
+from jax import named_scope
+
+
+def bad_dotted(x):
+    with jax.named_scope("MyOp:custom"):
+        return x + 1
+
+
+def bad_aliased(x):
+    with _jax.named_scope("MyOp:aliased"):
+        return x * 2
+
+
+def bad_bare(x):
+    with named_scope("MyOp:bare"):
+        return x - 1

@@ -1,0 +1,383 @@
+"""Program Atlas (mxnet_tpu/atlas.py + tools/program_atlas.py).
+
+Covers the scope-name contract surviving into lowered modules, >=90%
+flop coverage on a ResNet-style plan, call-site dedup and flop-model
+goldens on hand-written MLIR, the --diff tool, the /programz endpoint,
+flight-recorder program/atlas blocks, and the zero-extra-compile
+regression (analysis must never touch XLA).
+"""
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import atlas, health, nd, telemetry, tracing
+
+S = mx.symbol
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    health.reset()
+    atlas.reset()
+    yield
+    health.disable()
+    telemetry.disable()
+    telemetry.reset()
+    health.reset()
+    atlas.reset()
+
+
+def _residual_net():
+    """ResNet-style symbol: conv stem, two residual conv/BN blocks,
+    global pool, FC head, softmax loss."""
+    def block(data, n, name):
+        c1 = S.Convolution(data, num_filter=n, kernel=(3, 3), pad=(1, 1),
+                           no_bias=True, name=name + "_conv1")
+        b1 = S.BatchNorm(c1, name=name + "_bn1")
+        a1 = S.Activation(b1, act_type="relu", name=name + "_relu1")
+        c2 = S.Convolution(a1, num_filter=n, kernel=(3, 3), pad=(1, 1),
+                           no_bias=True, name=name + "_conv2")
+        b2 = S.BatchNorm(c2, name=name + "_bn2")
+        return S.Activation(b2 + data, act_type="relu", name=name + "_out")
+
+    data = S.var("data")
+    stem = S.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                         no_bias=True, name="stem_conv")
+    body = block(block(stem, 8, "res1"), 8, "res2")
+    pool = S.Pooling(body, global_pool=True, pool_type="avg", name="pool")
+    fc = S.FullyConnected(S.Flatten(pool), num_hidden=10, name="fc")
+    return S.SoftmaxOutput(fc, S.var("softmax_label"), name="softmax")
+
+
+def _run_fwdbwd():
+    """One train fwd+bwd on the residual net -> "fwdbwd" registration."""
+    ex = _residual_net().simple_bind(mx.cpu(), data=(2, 8, 8, 8),
+                                     softmax_label=(2,))
+    ex.forward(is_train=True)
+    ex.backward()
+    return ex
+
+
+# ---------------------------------------------------------------------------
+# scope naming contract
+# ---------------------------------------------------------------------------
+class TestScopeNames:
+    def test_scope_name_sanitized(self):
+        assert atlas.scope_name("Convolution", "stage1 conv/1") == \
+            "Convolution:stage1_conv_1"
+        assert atlas.scope_name("FullyConnected") == "FullyConnected:~"
+
+    def test_optimizer_scope_uses_hook_then_class(self):
+        from mxnet_tpu import optimizer as opt
+        sgd = opt.SGD(learning_rate=0.1)
+        assert atlas.optimizer_scope(sgd.fused_update) == "Optimizer::SGD"
+
+        class Custom(opt.SGD):
+            def atlas_scope_name(self):
+                return "SGD(momentum)"
+
+        c = Custom(learning_rate=0.1)
+        assert atlas.optimizer_scope(c.fused_update) == \
+            "Optimizer::SGD_momentum_"
+
+    def test_innermost_token_wins_through_autodiff_wrappers(self):
+        name = ("jit(f)/jit(main)/transpose(jvp(FullyConnected:fc1))/"
+                "Activation:relu1/dot_general")
+        toks = atlas._SCOPE_TOKEN_RE.findall(name)
+        assert toks[-1] == "Activation:relu1"
+
+
+# ---------------------------------------------------------------------------
+# analyze_text goldens (hand-written MLIR: no jax involved)
+# ---------------------------------------------------------------------------
+GOLDEN_MLIR = """\
+#loc1 = loc("jit(f)/jit(main)/FullyConnected:fc1/dot_general"("a":1:1))
+#loc2 = loc("jit(f)/jit(main)/transpose(jvp(FullyConnected:fc1))/dot_general"("a":2:2))
+#loc3 = loc("jit(f)/jit(main)/GradSync/add"("a":3:3))
+#loc4 = loc("jit(f)/jit(main)/Optimizer::SGD/mul"("a":4:4))
+#loc5 = loc(unknown)
+module @jit_f {
+  func.func public @main(%arg0: tensor<4x8xf32>, %arg1: tensor<8x16xf32>) -> tensor<4x16xf32> {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<4x8xf32>, tensor<8x16xf32>) -> tensor<4x16xf32> loc(#loc1)
+    %1 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<4x8xf32>, tensor<8x16xf32>) -> tensor<4x16xf32> loc(#loc2)
+    %2 = stablehlo.add %1, %1 : tensor<4x16xf32> loc(#loc3)
+    %3 = stablehlo.multiply %2, %2 : tensor<4x16xf32> loc(#loc4)
+    %4 = call @helper(%3) : (tensor<4x16xf32>) -> tensor<4x16xf32> loc(#loc1)
+    %5 = call @helper(%4) : (tensor<4x16xf32>) -> tensor<4x16xf32> loc(#loc5)
+    return %5 : tensor<4x16xf32> loc(#loc5)
+  }
+  func.func private @helper(%arg0: tensor<4x16xf32>) -> tensor<4x16xf32> {
+    %0 = stablehlo.exponential %arg0 : tensor<4x16xf32> loc(#loc4)
+    return %0 : tensor<4x16xf32> loc(#loc5)
+  }
+}
+"""
+
+
+class TestAnalyzeText:
+    def test_golden_attribution(self):
+        atl = atlas.analyze_text("golden", GOLDEN_MLIR)
+        fc = atl.scopes["FullyConnected:fc1"]
+        # two 4x8 @ 8x16 dot_generals (the transpose(jvp(...)) wrapper
+        # resolves to the same layer token): 2*64*8 each, plus one
+        # call-site-charged helper body (exp over 64 elems)
+        assert fc.flops == 2 * (2.0 * 64 * 8) + 64
+        assert fc.calls == 1
+        assert atl.scopes["GradSync"].flops == 64
+        # own multiply (64) + the UNscoped second call merging helper's
+        # internal Optimizer::SGD attribution (64)
+        assert atl.scopes["Optimizer::SGD"].flops == 128
+        # no cost_analysis denominator: coverage is vs the parsed total,
+        # and the unknown-loc call contributed no unattributed flops
+        assert atl.coverage() == pytest.approx(1.0)
+
+    def test_call_site_dedup_charges_caller(self):
+        # the shared private func body carries only its first caller's
+        # internal locations — a scoped call site must own the cost, not
+        # leak it into the body's own scope a second time
+        atl = atlas.analyze_text("golden", GOLDEN_MLIR)
+        assert atl.scopes["Optimizer::SGD"].flops < 3 * 64
+
+    def test_unknown_scope_is_unattributed(self):
+        asm = (
+            '#loc9 = loc(unknown)\n'
+            'module @m {\n'
+            '  func.func public @main(%arg0: tensor<2x2xf32>) -> '
+            'tensor<2x2xf32> {\n'
+            '    %0 = stablehlo.add %arg0, %arg0 : tensor<2x2xf32> '
+            'loc(#loc9)\n'
+            '    return %0 : tensor<2x2xf32> loc(#loc9)\n'
+            '  }\n'
+            '}\n')
+        atl = atlas.analyze_text("u", asm)
+        assert not atl.scopes
+        assert atl.unattributed.flops == 4
+        assert atl.coverage() == 0.0
+
+    def test_conv_flops_from_dim_numbers(self):
+        asm = (
+            '#loc1 = loc("jit(f)/jit(main)/Convolution:c1/conv"("a":1:1))\n'
+            'module @m {\n'
+            '  func.func public @main(%arg0: tensor<1x4x8x8xf32>, '
+            '%arg1: tensor<16x4x3x3xf32>) -> tensor<1x16x8x8xf32> {\n'
+            '    %0 = stablehlo.convolution(%arg0, %arg1) '
+            'dim_numbers = [b, f, 0, 1]x[o, i, 0, 1]->[b, f, 0, 1], '
+            'window = {pad = [[1, 1], [1, 1]]} : '
+            '(tensor<1x4x8x8xf32>, tensor<16x4x3x3xf32>) -> '
+            'tensor<1x16x8x8xf32> loc(#loc1)\n'
+            '    return %0 : tensor<1x16x8x8xf32> loc(#loc1)\n'
+            '  }\n'
+            '}\n')
+        atl = atlas.analyze_text("c", asm)
+        # 2 * out_numel(1*16*8*8) * (i=4 * kh=3 * kw=3)
+        assert atl.scopes["Convolution:c1"].flops == 2.0 * 1024 * 36
+
+
+# ---------------------------------------------------------------------------
+# live lowerings: coverage, scope presence, zero extra compiles
+# ---------------------------------------------------------------------------
+class TestLiveAttribution:
+    def test_resnet_style_coverage_and_scope_presence(self):
+        health.enable()
+        _run_fwdbwd()
+        atl = atlas.get("fwdbwd")
+        assert atl is not None
+        # acceptance bar: >=90% of cost_analysis flops attributed to
+        # named scopes (fwd AND bwd ride the same layer scopes via vjp)
+        assert atl.coverage() >= 0.90
+        # every op type in the plan surfaces as a named scope
+        for op_type in ("Convolution", "BatchNorm", "Activation",
+                        "Pooling", "FullyConnected", "SoftmaxOutput"):
+            assert any(s.startswith(op_type + ":") for s in atl.scopes), \
+                "no scope for op type %s in %s" % (op_type,
+                                                   sorted(atl.scopes))
+        # the ranked table is flop-sorted with shares against the total
+        rows = atl.table(top_k=5)
+        assert rows == sorted(rows, key=lambda r: -r["flops"])
+        assert all(0.0 <= r["flops_share"] <= 1.0 for r in rows)
+
+    def test_eager_op_scope_is_anonymous_node(self):
+        # the registry choke point stamps "<OpType>:~" into single-op
+        # jits, where no graph node name exists
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import registry
+        op = registry.get_op("Activation")
+        attrs = op.parse_attrs({"act_type": "relu"})
+        x = jnp.ones((2, 2), jnp.float32)
+        op(attrs, x)  # first call installs the jitted cache entry
+        jfn = next(v for v in op._jit_cache.values()
+                   if hasattr(v, "lower"))
+        asm = jfn.lower(x).compiler_ir().operation.get_asm(
+            enable_debug_info=True)
+        assert "Activation:~" in asm
+
+    def test_zero_extra_compiles(self, monkeypatch):
+        # analysis is serialization-only: poison AOT compile and prove
+        # registration + atlas still succeed end to end
+        import jax
+        monkeypatch.delenv("MXNET_HEALTH_DEEP", raising=False)
+
+        def boom(self, *a, **k):
+            raise AssertionError("AOT compile during atlas/health analysis")
+
+        monkeypatch.setattr(jax.stages.Lowered, "compile", boom)
+        health.enable()
+        _run_fwdbwd()
+        assert atlas.get("fwdbwd") is not None
+        assert health.programs()["fwdbwd"].flops > 0
+
+    def test_fused_step_has_optimizer_scope_and_env(self):
+        from mxnet_tpu.io import DataBatch
+        from mxnet_tpu.module import Module
+        health.enable()
+        data = S.var("data")
+        fc1 = S.FullyConnected(data, num_hidden=8, name="fc1")
+        act = S.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = S.FullyConnected(act, num_hidden=4, name="fc2")
+        sym = S.SoftmaxOutput(fc2, S.var("softmax_label"), name="softmax")
+        mod = Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 6))],
+                 label_shapes=[("softmax_label", (2,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        batch = DataBatch(data=[nd.array(np.random.rand(2, 6))],
+                          label=[nd.array(np.array([1, 2], np.float32))])
+        mod.forward_backward(batch)
+        mod.update()
+        prog = next((n for n in ("mesh_step", "step", "update")
+                     if atlas.get(n) is not None), None)
+        assert prog is not None, "no step/update program analyzed: %s" % (
+            sorted(atlas.atlases()),)
+        atl = atlas.get(prog)
+        assert any(s.startswith("Optimizer::SGD") for s in atl.scopes)
+        # env snapshot of the step cache-key flags rides the cost record
+        env = health.programs()[prog].env
+        assert "MXNET_TPU_FUSED_STEP" in env
+
+
+# ---------------------------------------------------------------------------
+# diff tool (golden)
+# ---------------------------------------------------------------------------
+SNAP_A = {"step": {"scopes": [
+    {"scope": "Convolution:c1", "flops": 1000.0, "bytes": 100},
+    {"scope": "Optimizer::SGD", "flops": 50.0, "bytes": 10},
+    {"scope": "Activation:r1", "flops": 5.0, "bytes": 5},
+]}}
+SNAP_B = {"step": {"scopes": [
+    {"scope": "Convolution:c1", "flops": 400.0, "bytes": 60},
+    {"scope": "Optimizer::SGD", "flops": 50.0, "bytes": 10},
+    {"scope": "GradSync", "flops": 20.0, "bytes": 8},
+    {"scope": "Activation:r1", "flops": 5.0, "bytes": 5},
+]}}
+
+GOLDEN_DIFF = [
+    {"program": "step", "scope": "Convolution:c1",
+     "flops_a": 1000.0, "flops_b": 400.0,
+     "delta_flops": -600.0, "delta_bytes": -40},
+    {"program": "step", "scope": "GradSync",
+     "flops_a": 0.0, "flops_b": 20.0,
+     "delta_flops": 20.0, "delta_bytes": 8},
+]
+
+
+class TestDiff:
+    def test_golden(self):
+        # unchanged scopes (Optimizer, Activation) are skipped; rows rank
+        # by |delta flops|
+        assert atlas.diff(SNAP_A, SNAP_B) == GOLDEN_DIFF
+
+    def test_cli_diff_json(self, tmp_path, capsys):
+        from tools import program_atlas as cli
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(SNAP_A))
+        b.write_text(json.dumps(SNAP_B))
+        rc = cli.main(["--diff", str(a), str(b), "--format", "json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == GOLDEN_DIFF
+
+    def test_cli_renders_flight_dump_atlas_block(self, tmp_path, capsys):
+        from tools import program_atlas as cli
+        dump = tmp_path / "dump.json"
+        dump.write_text(json.dumps(
+            {"reason": "manual", "events": [],
+             "atlas": {"step": {"total_flops": 10.0, "coverage_pct": 95.0,
+                                "n_scopes": 1, "n_instructions": 3,
+                                "scopes": [{"scope": "Convolution:c1",
+                                            "flops": 9.5, "bytes": 4,
+                                            "instructions": 2, "calls": 0,
+                                            "flops_share": 0.95,
+                                            "bytes_share": 1.0}]}}}))
+        rc = cli.main([str(dump)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Convolution:c1" in out
+
+
+# ---------------------------------------------------------------------------
+# /programz + flight-recorder embedding
+# ---------------------------------------------------------------------------
+class TestExposure:
+    def test_programz_endpoint(self):
+        health.enable()
+        _run_fwdbwd()
+        port = telemetry.start_http_server(port=0)
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/programz?top_k=3" % port,
+                    timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+        finally:
+            telemetry.stop_http_server()
+        assert "fwdbwd" in doc["programs"]
+        assert "env" in doc["programs"]["fwdbwd"]
+        atl = doc["atlas"]["fwdbwd"]
+        assert atl["coverage_pct"] >= 90.0
+        assert len(atl["scopes"]) <= 3
+
+    def test_flight_dump_carries_programs_and_atlas(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH",
+                           str(tmp_path / "fr.json"))
+        health.enable()
+        _run_fwdbwd()
+        path = tracing.flight.dump("manual")
+        with open(path) as f:
+            doc = json.load(f)
+        assert "fwdbwd" in doc["programs"]
+        assert doc["programs"]["fwdbwd"]["env"] is not None
+        assert doc["atlas"]["fwdbwd"]["coverage_pct"] >= 90.0
+
+    def test_flight_dump_programs_survive_atlas_off(self, tmp_path,
+                                                    monkeypatch):
+        # satellite contract: the programs snapshot does NOT depend on
+        # the atlas being enabled
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH",
+                           str(tmp_path / "fr.json"))
+        monkeypatch.setattr(atlas, "enabled", False)
+        health.enable()
+        _run_fwdbwd()
+        assert atlas.get("fwdbwd") is None
+        path = tracing.flight.dump("manual")
+        with open(path) as f:
+            doc = json.load(f)
+        assert "fwdbwd" in doc["programs"]
+        assert "atlas" not in doc
+
+    def test_atlas_metrics_exported(self):
+        health.enable()
+        _run_fwdbwd()
+        assert telemetry.value("atlas_scope_coverage_pct",
+                               program="fwdbwd") >= 90.0
+        assert telemetry.value("atlas_scopes", program="fwdbwd") > 0

@@ -145,6 +145,26 @@ class TestGL005:
 
 
 # ---------------------------------------------------------------------------
+# GL006: named_scope discipline (atlas attribution)
+# ---------------------------------------------------------------------------
+class TestGL006:
+    CFG = {"named_scope_allowlist": ("pkg/registry.py",)}
+
+    def test_rogue_scopes_flagged(self):
+        d = details(lint("gl006", ["GL006"], config=self.CFG).findings)
+        # every jax spelling is caught: dotted, aliased, bare import
+        assert "raw-named-scope:pkg.rogue_op.bad_dotted" in d
+        assert "raw-named-scope:pkg.rogue_op.bad_aliased" in d
+        assert "raw-named-scope:pkg.rogue_op.bad_bare" in d
+
+    def test_choke_point_and_non_jax_silent(self):
+        d = details(lint("gl006", ["GL006"], config=self.CFG).findings)
+        # the allowlisted choke point and a non-jax named_scope attribute
+        # both stay silent
+        assert not any("registry" in x for x in d)
+
+
+# ---------------------------------------------------------------------------
 # suppression directives
 # ---------------------------------------------------------------------------
 class TestSuppressions:
@@ -224,7 +244,8 @@ class TestCLI:
         for key in ("version", "root", "checks", "findings", "baselined",
                     "suppressed", "stale_baseline", "summary"):
             assert key in out
-        assert out["checks"] == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+        assert out["checks"] == ["GL001", "GL002", "GL003", "GL004", "GL005",
+                                 "GL006"]
         assert out["summary"]["findings"] == 0
         assert out["summary"]["stale_baseline"] == 0
         for f in out["baselined"] + out["findings"]:

@@ -72,7 +72,7 @@ class TestProfiler:
         mx.profiler.dump()
         mx.profiler.set_state("stop")
         ev = json.load(open(f))["traceEvents"]
-        assert any(e["name"] == "Executor::Forward" for e in ev)
+        assert any(e["name"] == "Executor::ForwardDispatch" for e in ev)
 
 
 class TestMonitor:

@@ -336,8 +336,8 @@ class TestInstrumentation:
         ex = y.simple_bind(mx.cpu(), x=(2, 5))
         ex.forward(is_train=True, x=nd.ones((2, 5)))
         ex.backward()
-        assert telemetry.value("executor_forward_seconds") >= 1
-        assert telemetry.value("executor_backward_seconds") >= 1
+        assert telemetry.value("executor_forward_dispatch_seconds") >= 1
+        assert telemetry.value("executor_backward_dispatch_seconds") >= 1
 
     def test_profiler_counter_bridges_to_gauge(self):
         telemetry.enable()

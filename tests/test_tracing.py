@@ -416,7 +416,8 @@ class TestJitCacheObservability:
         ex.forward()
         ex.forward()
         profiler.set_state("stop")
-        spans = [e for e in _events() if e["name"] == "Executor::Forward"]
+        spans = [e for e in _events()
+                 if e["name"] == "Executor::ForwardDispatch"]
         assert spans[0]["args"]["first_run"] is True
         assert spans[1]["args"]["first_run"] is False
 

@@ -2,13 +2,14 @@
 from __future__ import annotations
 
 from . import (gl001_env_cache_key, gl002_tracer_purity,
-               gl003_lock_discipline, gl004_donation, gl005_metric_registry)
+               gl003_lock_discipline, gl004_donation, gl005_metric_registry,
+               gl006_named_scope)
 
 ALL_CHECKS = {
     mod.CODE: mod
     for mod in (gl001_env_cache_key, gl002_tracer_purity,
                 gl003_lock_discipline, gl004_donation,
-                gl005_metric_registry)
+                gl005_metric_registry, gl006_named_scope)
 }
 
 DESCRIPTIONS = {mod.CODE: mod.TITLE for mod in ALL_CHECKS.values()}

@@ -1,0 +1,88 @@
+"""GL006: raw ``jax.named_scope`` only at the atlas choke points.
+
+The Program Atlas (docs/observability.md "Atlas") attributes fused-program
+instructions to layers by the ``jax.named_scope`` names the runtime opens
+at a handful of central choke points — the registry op-apply wrapper, the
+executor plan/segment loops, and the optimizer/grad-sync stages of the
+step-program builders.  An op or layer opening its OWN scope nests inside
+(or collides with) the choke-point scope and corrupts the attribution:
+the innermost token wins, so the rogue scope silently steals every
+instruction under it.  This check flags any ``jax.named_scope`` call in
+the runtime tree outside the allowlisted choke-point modules; new scope
+vocabulary belongs in :mod:`mxnet_tpu.atlas`, not at op definitions.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, _dotted
+
+CODE = "GL006"
+TITLE = "atlas scope discipline: jax.named_scope only at the choke points"
+
+#: modules allowed to open scopes — the documented choke points (plus the
+#: atlas itself, which owns the naming contract)
+DEFAULT_ALLOWLIST = (
+    "mxnet_tpu/atlas.py",
+    "mxnet_tpu/ops/registry.py",
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/fused_step.py",
+    "mxnet_tpu/fused.py",
+    "mxnet_tpu/optimizer.py",
+)
+
+
+def _is_jax_named_scope(mod, chain):
+    """True when a dotted call chain resolves to jax's named_scope."""
+    if not chain or chain[-1] != "named_scope":
+        return False
+    if len(chain) == 1:
+        src = mod.from_imports.get("named_scope")
+        return bool(src) and (src[0] == "jax" or src[0].startswith("jax."))
+    head = chain[0]
+    target = mod.imports.get(head)
+    if target is not None:
+        return target == "jax" or target.startswith("jax.")
+    src = mod.from_imports.get(head)
+    if src is not None:
+        full = ".".join(p for p in src if p)
+        return full == "jax" or full.startswith("jax.")
+    # unresolvable head: conservative only for the canonical spellings
+    return head in ("jax", "_jax")
+
+
+def _enclosing(mod, lineno):
+    """Innermost function qualname containing ``lineno`` (or <module>)."""
+    best, best_line = None, -1
+    for qual, node in mod.functions.items():
+        start = getattr(node, "lineno", None)
+        end = getattr(node, "end_lineno", None)
+        if start is None or end is None:
+            continue
+        if start <= lineno <= end and start > best_line:
+            best, best_line = qual, start
+    return best or "<module>"
+
+
+def run(project: Project):
+    allow = set(project.config.get("named_scope_allowlist",
+                                   DEFAULT_ALLOWLIST))
+    findings = []
+    for mod in project.modules.values():
+        if mod.rel in allow:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain or not _is_jax_named_scope(mod, chain):
+                continue
+            where = _enclosing(mod, node.lineno)
+            findings.append(Finding(
+                CODE, mod.rel, node.lineno,
+                "raw jax.named_scope outside the atlas choke points "
+                "(corrupts per-layer attribution; see docs/observability.md "
+                "'Atlas' — scopes belong to the registry/executor/step-"
+                "builder wrappers)",
+                "raw-named-scope:%s.%s" % (mod.name, where)))
+    return findings
