@@ -151,6 +151,48 @@ def _phase_breakdown(mx, gluon, net, batch_size, image_size, ctx, iters=3):
     }
 
 
+def _io_breakdown(mx, ctx, batches=6, epochs=3):
+    """Synthetic fast-step probe of the input pipeline: a PrefetchingIter
+    (worker pool + producer-side device_put) feeds a trivial consumer and
+    the io_* telemetry series say how starved that consumer was.  A
+    prefetch-wait p50 of ~0 means the pipeline keeps up at full step
+    rate; the device-put total is host->device time the producer absorbed
+    off the step's critical path."""
+    from mxnet_tpu import telemetry
+    was = telemetry.enabled
+    telemetry.enable()
+    batch = 32
+    data = np.zeros((batches * batch, 8), np.float32)
+    label = np.zeros((batches * batch,), np.float32)
+    it = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(data, label, batch_size=batch),
+        device=ctx, num_workers=2)
+    n = 0
+    for _ in range(epochs):
+        for b in it:
+            float(b.data[0].asnumpy().ravel()[0])  # simulated fast step
+            n += 1
+        it.reset()
+    put = telemetry.registry().get("io_device_put_seconds")
+    put_sum = (put.labels(iter="PrefetchingIter").get()["sum"]
+               if put is not None else 0.0)
+    out = {
+        "prefetch_wait_p50_ms": round(1e3 * telemetry.quantile(
+            "io_prefetch_wait_seconds", 0.5, iter="PrefetchingIter"), 3),
+        "prefetch_wait_p99_ms": round(1e3 * telemetry.quantile(
+            "io_prefetch_wait_seconds", 0.99, iter="PrefetchingIter"), 3),
+        "device_put_seconds": round(put_sum, 4),
+        "pipeline_depth": int(telemetry.value(
+            "io_pipeline_depth", iter="PrefetchingIter")),
+        "pipeline_workers": int(telemetry.value(
+            "io_pipeline_workers", iter="PrefetchingIter")),
+        "batches": n,
+    }
+    if not was:
+        telemetry.disable()
+    return out
+
+
 def bench_lstm_lm(ctx, dtype, peak_tflops):
     """BASELINE metric #2: Gluon LSTM LM training tokens/sec/chip
     (ref workload: example/gluon/word_language_model/train.py; the
@@ -480,25 +522,45 @@ def main():
     for _ in range(warmup):
         fetch(step())
 
-    # --- phase 1: per-step, hard D2H block each step (latency profile)
-    step_times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        lval = fetch(step())
-        step_times.append(time.perf_counter() - t0)
+    from mxnet_tpu.train_loop import OverlappedLoop
+
+    def blocked_phase(depth, n):
+        """Per-step wall times with every loss fetched via a real D2H,
+        but `depth` steps in flight (train_loop overlapped window);
+        depth=0 is the fully serial dispatch->block reference loop.
+        Steady state: each iteration pays one dispatch + one (deferred)
+        block, so n iterations still contain n hard fetches."""
+        loop = OverlappedLoop(depth)
+        times, last = [], None
+        for i in range(n + depth):
+            t0 = time.perf_counter()
+            loss = step()
+            out = loop.push(lambda l=loss: fetch(l))
+            dt = time.perf_counter() - t0
+            if i >= depth:     # prefill iterations ran no block: drop
+                times.append(dt)
+            if out is not None:
+                last = out
+        out = loop.drain()
+        return times, (out if out is not None else last)
+
+    # --- phase 1: per-step D2H-blocked latency, overlapped by default
+    # (the pipelined train loop IS the product path now); depth=0 below
+    # re-measures the old fully serial loop for the before/after delta
+    overlap_depth = max(0, int(os.environ.get("BENCH_OVERLAP_DEPTH", "2")))
+    step_times, lval = blocked_phase(overlap_depth, iters)
     med, spread, spread_maxmin = _spread_stats(step_times)
     blocked_ips = batch_size / med
+    serial_times, _ = blocked_phase(0, iters)
+    med_serial = statistics.median(serial_times)
+    serial_ips = batch_size / med_serial
 
     # monitor overhead A/B on the same blocked protocol: the acceptance
     # bar is <1% on the step-time median with the hooks live
     overhead_pct = None
     if health_on:
         _health.disable()
-        off_times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            fetch(step())
-            off_times.append(time.perf_counter() - t0)
+        off_times, _ = blocked_phase(overlap_depth, iters)
         med_off = statistics.median(off_times)
         _health.enable()
         _health.monitor.drop_window()  # don't attribute the off-span
@@ -542,6 +604,9 @@ def main():
         "step_spread_pct": round(100 * spread, 1),
         "step_spread_maxmin_pct": round(100 * spread_maxmin, 1),
         "blocked_img_per_sec": round(blocked_ips, 2),
+        "overlap_depth": overlap_depth,
+        "serial_img_per_sec": round(serial_ips, 2),
+        "step_ms_median_serial": round(med_serial * 1e3, 2),
         "windowed_img_per_sec": round(window_ips, 2),
         "window_scaling_ratio": round(scaling, 3),
         "window_suspect": not scaling_ok,
@@ -597,6 +662,13 @@ def main():
                 mx, gluon, net, batch_size, image_size, ctx)
         except Exception as e:
             result["phase_breakdown"] = {"error": repr(e)[:200]}
+        # io pipeline block (satellite, round 11): prefetch-wait
+        # quantiles + producer-side device-put time under a synthetic
+        # fast-step load — tracks host-boundness round over round
+        try:
+            result["phase_breakdown"]["io"] = _io_breakdown(mx, ctx)
+        except Exception as e:
+            result["phase_breakdown"]["io"] = {"error": repr(e)[:200]}
 
     # BASELINE metric #2: LSTM LM tokens/sec (nested so the driver still
     # sees ONE JSON line whose primary metric is the ResNet number)

@@ -43,6 +43,8 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import io
+from . import train_loop
+from .train_loop import OverlappedLoop
 from . import recordio
 from . import rnn
 from . import kvstore as kv

@@ -579,8 +579,14 @@ class Executor:
             if k not in self.arg_dict:
                 raise MXNetError("unknown input %r" % k)
             dst = self.arg_dict[k]
-            dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
-                else jnp.asarray(v, dst.dtype)
+            if isinstance(v, NDArray):
+                # adopt pre-placed producer batches as-is (PrefetchingIter
+                # device double buffering): no re-put, no same-dtype astype
+                src = v._data
+                dst._data = src if src.dtype == dst.dtype \
+                    else src.astype(dst.dtype)
+            else:
+                dst._data = jnp.asarray(v, dst.dtype)
         from . import profiler as _profiler
         plan = self._plan(bool(is_train))
         keys = self._keys(plan)
@@ -666,8 +672,12 @@ class Executor:
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 dst = self.arg_dict[k]
-                dst._data = v._data.astype(dst.dtype) \
-                    if isinstance(v, NDArray) else jnp.asarray(v, dst.dtype)
+                if isinstance(v, NDArray):
+                    src = v._data
+                    dst._data = src if src.dtype == dst.dtype \
+                        else src.astype(dst.dtype)
+                else:
+                    dst._data = jnp.asarray(v, dst.dtype)
         plan = self._plan(True)
         keys = self._keys(plan)
         self._last_keys = keys

@@ -368,8 +368,14 @@ class ModuleFusedStep:
         feeds = staged.feeds() if staged is not None else None
         for kname, v in (feeds[0] if feeds else {}).items():
             dst = ex.arg_dict[kname]
-            dst._data = v._data.astype(dst.dtype) if isinstance(v, NDArray) \
-                else jnp.asarray(v, dst.dtype)
+            if isinstance(v, NDArray):
+                # adopt pre-placed producer batches as-is (PrefetchingIter
+                # device double buffering): no re-put, no same-dtype astype
+                src = v._data
+                dst._data = src if src.dtype == dst.dtype \
+                    else src.astype(dst.dtype)
+            else:
+                dst._data = jnp.asarray(v, dst.dtype)
         slots = self._slots_for_device(ex, 0, 1)
         pvals, svals, lrs, wds, ts = self._gather_update_inputs(ex, 0, slots)
         rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
@@ -602,7 +608,12 @@ class ModuleFusedStep:
                 dt = ex.arg_dict[n].dtype
                 if v.dtype != dt:
                     v = v.astype(dt)
-                others.append(jax.device_put(v, bsh))
+                if getattr(v, "sharding", None) != bsh:
+                    # producer-prefetched batches (PrefetchingIter with
+                    # sharding=batch_sharding()) arrive pre-sharded: the
+                    # H2D + shard already happened during the PREVIOUS step
+                    v = jax.device_put(v, bsh)
+                others.append(v)
                 full_shapes[n] = tuple(v.shape)
             else:
                 others.append(jax.device_put(ex.arg_dict[n]._data, repl))

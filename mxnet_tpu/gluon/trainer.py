@@ -167,6 +167,17 @@ class Trainer:
         if _health.enabled:
             _health.monitor.on_step("trainer_update")
 
+    def fit_epoch(self, data_iter, step_fn, block_fn=None, depth=None):
+        """Drive one epoch with dispatch and blocking tails overlapped
+        (train_loop.run_epoch): ``step_fn(batch)`` runs fwd/bwd +
+        ``self.step`` and returns an async handle (e.g. the loss);
+        ``block_fn(handle, i)`` — optional — is the hard-blocking tail
+        (loss D2H, logging), deferred ``depth`` steps behind dispatch so
+        the device pipeline stays full.  Returns batches consumed."""
+        from ..train_loop import run_epoch
+        return run_epoch(data_iter, step_fn, block_fn=block_fn,
+                         depth=depth)
+
     def allreduce_grads(self):
         """Reduce gradients over devices only (then call update())."""
         if not self._kv_initialized:

@@ -439,12 +439,22 @@ class ImageIter(_io.DataIter):
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
-                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
-                 aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 path_imgidx=None, shuffle=False, part_index=None,
+                 num_parts=None, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
         super().__init__()
         assert path_imgrec or path_imglist or (isinstance(imglist, list)) \
             or path_root, "must provide a data source"
+        # per-mesh-host sharding defaults (single-process => whole set):
+        # each host walks only its 1/num_parts stride of the sequence
+        if num_parts is None and part_index is None:
+            from .parallel.mesh import host_shard_hint
+            part_index, num_parts = host_shard_hint()
+        num_parts = 1 if num_parts is None else int(num_parts)
+        part_index = 0 if part_index is None else int(part_index)
+        if not 0 <= part_index < num_parts:
+            raise MXNetError("ImageIter: part_index %d out of range for "
+                             "num_parts %d" % (part_index, num_parts))
         if path_imgrec:
             if path_imgidx is None:
                 path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"

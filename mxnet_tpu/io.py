@@ -16,7 +16,7 @@ import queue
 import struct
 import threading
 import time
-from collections import namedtuple
+from collections import deque, namedtuple
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -36,6 +36,16 @@ _IO_WAIT = _telemetry.histogram(
 _IO_WS = _telemetry.gauge(
     "io_workspace_bytes",
     "Pooled staging-workspace bytes held by the iterator", ("iter",))
+_IO_PUT = _telemetry.histogram(
+    "io_device_put_seconds",
+    "Producer-side device placement (host->device upload) per batch",
+    ("iter",))
+_IO_DEPTH = _telemetry.gauge(
+    "io_pipeline_depth",
+    "Configured in-flight batch depth of the producer pipeline", ("iter",))
+_IO_WORKERS = _telemetry.gauge(
+    "io_pipeline_workers",
+    "Worker threads producing batches for the pipeline", ("iter",))
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
@@ -317,22 +327,76 @@ def _read_idx(path):
 
 
 class PrefetchingIter(DataIter):
-    """Background prefetch via the dependency engine (ref io.py:349 +
-    iter_prefetcher.h double buffering)."""
+    """Multi-worker background prefetch with device-side double buffering
+    (ref io.py:349 + iter_prefetcher.h + the iter_image_recordio_2.cc
+    worker pool).
+
+    ``num_workers`` threads produce batches concurrently.  The underlying
+    ``next(it)`` calls stay serialized under a fetch lock — inner
+    iterators are not thread-safe and batch ORDER must match the
+    unpipelined iterator exactly — while the expensive per-batch work
+    (flattening plus, when ``sharding``/``device`` is set, the
+    host->device ``jax.device_put``) runs outside the lock in parallel
+    and is reassembled in sequence order before entering the bounded
+    prefetch queue.  With a placement target the producer lands batch
+    N+1 on device (pre-sharded against the cached ``NamedSharding`` for
+    the mesh step, plain device placement otherwise) while the consumer
+    computes step N, so the train step never pays the H2D copy on its
+    critical path.
+
+    ``prefetch_depth`` bounds in-flight batches (0 -> env
+    ``MXNET_IO_PREFETCH_DEPTH``, default 2); ``num_workers`` defaults
+    from ``MXNET_IO_PIPELINE_WORKERS`` falling back to
+    ``MXNET_CPU_WORKER_NTHREADS``.
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2, sharding=None):
+                 prefetch_depth=0, sharding=None, device=None,
+                 num_workers=0):
         if not isinstance(iters, list):
             iters = [iters]
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
         super().__init__(iters[0].batch_size, sharding=sharding)
+        if prefetch_depth <= 0:
+            prefetch_depth = int(os.environ.get(
+                "MXNET_IO_PREFETCH_DEPTH", "2"))
+        self.prefetch_depth = max(1, prefetch_depth)
+        if num_workers <= 0:
+            num_workers = int(os.environ.get(
+                "MXNET_IO_PIPELINE_WORKERS",
+                os.environ.get("MXNET_CPU_WORKER_NTHREADS", "2")))
+        self.num_workers = max(1, num_workers)
+        self.device = device
+        self._target = self._placement()
         self.current_batch = None
-        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=self.prefetch_depth)
         self._stop = threading.Event()
-        self._thread = None
+        self._fetch_lock = threading.Lock()
+        self._emit_cv = threading.Condition()
+        self._threads: List[threading.Thread] = []
+        self._seq = 0
+        self._next_emit = 0
+        self._eof = False
+        self._done = False
         self._start()
+
+    def _placement(self):
+        """Sharding the producer lands batches on (None = host batches)."""
+        if self.sharding is not None:
+            return self.sharding
+        if self.device is None:
+            return None
+        from jax.sharding import SingleDeviceSharding
+        dev = getattr(self.device, "jax_device", self.device)
+        return SingleDeviceSharding(dev)
+
+    @property
+    def _label(self):
+        return "PrefetchingIter.mesh" if self.sharding is not None \
+            else "PrefetchingIter"
 
     @property
     def provide_data(self):
@@ -340,8 +404,11 @@ class PrefetchingIter(DataIter):
         for i, it in enumerate(self.iters):
             descs = it.provide_data
             if self.rename_data:
+                # keep ALL four DataDesc fields: dropping layout here
+                # broke get_batch_axis for renamed non-NCHW inputs
                 descs = [DataDesc(self.rename_data[i].get(d.name, d.name),
-                                  d.shape, d.dtype) for d in descs]
+                                  d.shape, d.dtype, d.layout)
+                         for d in descs]
             out.extend(descs)
         return out
 
@@ -352,7 +419,8 @@ class PrefetchingIter(DataIter):
             descs = it.provide_label
             if self.rename_label:
                 descs = [DataDesc(self.rename_label[i].get(d.name, d.name),
-                                  d.shape, d.dtype) for d in descs]
+                                  d.shape, d.dtype, d.layout)
+                         for d in descs]
             out.extend(descs)
         return out
 
@@ -367,46 +435,100 @@ class PrefetchingIter(DataIter):
         return False
 
     def _place(self, arr):
-        """Land a batch array against the mesh batch sharding on the
-        producer thread, so the consumer-side step finds it pre-sharded."""
+        """Land a batch array on the placement target (producer side), so
+        the consumer-side step finds it already on device/pre-sharded."""
         import jax
         data = getattr(arr, "_data", None)
         if data is None:
             return arr
-        if getattr(data, "sharding", None) != self.sharding:
-            arr._data = jax.device_put(data, self.sharding)
+        if getattr(data, "sharding", None) != self._target:
+            arr._data = jax.device_put(data, self._target)
         return arr
 
-    def _producer(self):
+    def _assemble(self, batches):
+        data = sum((b.data for b in batches), [])
+        label = sum((b.label for b in batches), [])
+        if self._target is not None:
+            t0 = time.perf_counter()
+            data = [self._place(a) for a in data]
+            label = [self._place(a) for a in label]
+            if _telemetry.enabled:
+                _IO_PUT.labels(iter=self._label).observe(
+                    time.perf_counter() - t0)
+        return DataBatch(data, label, pad=batches[0].pad,
+                         index=getattr(batches[0], "index", None))
+
+    def _emit(self, seq, item) -> bool:
+        """Ordered reassembly: deliver `item` as the seq-th queue entry."""
+        with self._emit_cv:
+            while self._next_emit != seq:
+                if self._stop.is_set():
+                    return False
+                self._emit_cv.wait(timeout=0.05)
+            ok = self._put(item)
+            self._next_emit = seq + 1
+            self._emit_cv.notify_all()
+        return ok
+
+    def _worker(self):
         while not self._stop.is_set():
-            try:
-                batches = [next(it) for it in self.iters]
-            except StopIteration:
-                self._put(None)
+            with self._fetch_lock:
+                if self._eof:
+                    return
+                seq = self._seq
+                self._seq += 1
+                try:
+                    batches = [next(it) for it in self.iters]
+                except StopIteration:
+                    self._eof = True
+                    batches = None
+                except Exception as e:  # surfaced on the consumer side
+                    self._eof = True
+                    batches = e
+            if batches is None:
+                self._emit(seq, None)
                 return
-            data = sum((b.data for b in batches), [])
-            label = sum((b.label for b in batches), [])
-            if self.sharding is not None:
-                data = [self._place(a) for a in data]
-                label = [self._place(a) for a in label]
-            if not self._put(DataBatch(data, label, pad=batches[0].pad)):
+            if isinstance(batches, Exception):
+                self._emit(seq, batches)
+                return
+            try:
+                item = self._assemble(batches)
+            except Exception as e:  # surfaced on the consumer side
+                with self._fetch_lock:
+                    self._eof = True
+                item = e
+            if not self._emit(seq, item):
                 return
 
     def _start(self):
-        self._thread = threading.Thread(target=self._producer, daemon=True)
-        self._thread.start()
+        self._seq = 0
+        self._next_emit = 0
+        self._eof = False
+        self._done = False
+        if _telemetry.enabled:
+            _IO_DEPTH.labels(iter=self._label).set(self.prefetch_depth)
+            _IO_WORKERS.labels(iter=self._label).set(self.num_workers)
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name="prefetch-worker-%d" % i)
+            for i in range(self.num_workers)]
+        for t in self._threads:
+            t.start()
 
     def reset(self):
-        # stop the producer FIRST, then drain — otherwise an in-flight batch
-        # lands after the drain and leaks into the next epoch
+        # stop the producers FIRST, then drain — otherwise an in-flight
+        # batch lands after the drain and leaks into the next epoch
         self._stop.set()
-        while self._thread.is_alive():
-            try:  # unblock a producer stuck in put on a full queue
-                self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.05)
-        while True:  # final drain after the producer has exited
+        with self._emit_cv:
+            self._emit_cv.notify_all()
+        for t in self._threads:
+            while t.is_alive():
+                try:  # unblock a producer stuck in put on a full queue
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+        while True:  # final drain after every producer has exited
             try:
                 self._queue.get_nowait()
             except queue.Empty:
@@ -423,12 +545,22 @@ class PrefetchingIter(DataIter):
             return self._queue.get()
         t0 = time.perf_counter()
         batch = self._queue.get()
-        label = "PrefetchingIter.mesh" if self.sharding is not None \
-            else "PrefetchingIter"
         wait = time.perf_counter() - t0
-        _IO_WAIT.labels(iter=label).observe(wait)
+        _IO_WAIT.labels(iter=self._label).observe(wait)
         if _health.enabled:
             _health.monitor.note_phase("input", wait)
+        return batch
+
+    def _consume(self):
+        batch = self._get_timed()
+        if batch is None:
+            self._done = True
+            return None
+        if isinstance(batch, Exception):
+            self._done = True
+            raise batch
+        if _telemetry.enabled:
+            _IO_BATCHES.labels(iter="PrefetchingIter").inc()
         return batch
 
     def __next__(self):
@@ -437,11 +569,12 @@ class PrefetchingIter(DataIter):
         if self.current_batch is not None:
             batch, self.current_batch = self.current_batch, None
             return batch
-        batch = self._get_timed()
+        if self._done:
+            # post-EOF next() must re-raise, not block on an idle queue
+            raise StopIteration
+        batch = self._consume()
         if batch is None:
             raise StopIteration
-        if _telemetry.enabled:
-            _IO_BATCHES.labels(iter="PrefetchingIter").inc()
         return batch
 
     next = __next__
@@ -449,11 +582,11 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if self.current_batch is not None:
             return True
-        batch = self._get_timed()
+        if self._done:
+            return False
+        batch = self._consume()
         if batch is None:
             return False
-        if _telemetry.enabled:
-            _IO_BATCHES.labels(iter="PrefetchingIter").inc()
         self.current_batch = batch
         return True
 
@@ -534,12 +667,17 @@ class ImageRecordIter(DataIter):
     walks the record file sequentially (cheap), ``preprocess_threads``
     workers JPEG-decode + augment concurrently (cv2/PIL release the GIL),
     and assembled batches wait in a bounded prefetch queue so decode
-    overlaps the training step.  Thread count honors the
+    overlaps the training step.  Up to ``prefetch_buffer`` BATCHES decode
+    concurrently: workers write straight into a ring of reusable staging
+    buffers and the producer reassembles them strictly in order, so the
+    pool is never drained batch-by-batch.  Thread count honors the
     ``MXNET_CPU_WORKER_NTHREADS`` env (the reference's engine worker knob,
     docs/faq/env_var.md) with ``preprocess_threads`` as the per-iterator
-    override; ``prefetch_buffer`` batches are produced ahead.  The
-    augmentation params mirror image_aug_default.cc (resize, rand_crop,
-    rand_mirror, mean/std normalization)."""
+    override.  ``num_parts``/``part_index`` shard the stream per mesh
+    host (defaulting from ``parallel.mesh.host_shard_hint``) so
+    multi-host training never decodes the full dataset on every host.
+    The augmentation params mirror image_aug_default.cc (resize,
+    rand_crop, rand_mirror, mean/std normalization)."""
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
@@ -547,7 +685,8 @@ class ImageRecordIter(DataIter):
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  preprocess_threads=0, prefetch_buffer=2, path_imgidx=None,
                  round_batch=True, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", num_parts=None,
+                 part_index=None, **kwargs):
         super().__init__(batch_size)
         from . import recordio
         self.data_shape = tuple(data_shape)
@@ -570,6 +709,12 @@ class ImageRecordIter(DataIter):
         self._producer_thread = None
         self._stop = threading.Event()
         self._mem = None
+        # pipelined-producer state: up to `prefetch_buffer` batches decode
+        # concurrently, each into its own slot of a reusable staging ring
+        self._inflight = deque()
+        self._bufs = None
+        self._reader_done = False
+        self._seq_read = 0
         # batch staging buffers come from the per-context temp-space pool
         # (resource.cc kTempSpace semantics: one rotating slot per user,
         # reused across batches instead of a fresh malloc per batch)
@@ -599,6 +744,28 @@ class ImageRecordIter(DataIter):
                     if raw is None:
                         break
                     self._mem.append(raw)
+        # per-host sharded loading: each mesh host keeps 1/num_parts of the
+        # stream (defaults from parallel.mesh.host_shard_hint), so multi-
+        # host training never re-decodes the full dataset on every host
+        if num_parts is None and part_index is None:
+            from .parallel.mesh import host_shard_hint
+            part_index, num_parts = host_shard_hint()
+        num_parts = 1 if num_parts is None else int(num_parts)
+        part_index = 0 if part_index is None else int(part_index)
+        if not 0 <= part_index < num_parts:
+            raise MXNetError(
+                "ImageRecordIter: part_index %d out of range for "
+                "num_parts %d" % (part_index, num_parts))
+        self.num_parts, self.part_index = num_parts, part_index
+        if num_parts > 1:
+            if self.keys is not None:
+                n = len(self.keys)
+                self.keys = self.keys[n * part_index // num_parts:
+                                      n * (part_index + 1) // num_parts]
+            elif self._mem is not None:
+                n = len(self._mem)
+                self._mem = self._mem[n * part_index // num_parts:
+                                      n * (part_index + 1) // num_parts]
         self._order = None
         self.reset()
 
@@ -631,6 +798,7 @@ class ImageRecordIter(DataIter):
         elif self._mem is not None:
             self._order = np.random.permutation(len(self._mem)).tolist()
             self._pos = 0
+        self._seq_read = 0
         self._done = False
         self._start_producer()
 
@@ -639,7 +807,9 @@ class ImageRecordIter(DataIter):
         if getattr(self, "_pool", None) is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
-        # release the temp-space slot with the iterator, not at GC time
+        # release the staging ring and temp-space slot with the iterator,
+        # not at GC time
+        self._bufs = None
         self._workspace_res = None
 
     __del__ = close
@@ -671,14 +841,28 @@ class ImageRecordIter(DataIter):
             raw = self._mem[self._order[self._pos]]
             self._pos += 1
             return raw
+        if self.num_parts > 1:
+            # sequential .rec without an index: stride-skip other hosts'
+            # records — skipped bytes are read but never hit the decode
+            # pool, so each host only pays decode for its own 1/num_parts
+            while True:
+                raw = self.rec.read()
+                if raw is None:
+                    return None
+                i = self._seq_read
+                self._seq_read += 1
+                if i % self.num_parts == self.part_index:
+                    return raw
         return self.rec.read()
 
-    def _decode_one(self, raw):
-        """Worker stage: JPEG decode + augment (GIL released in cv2/PIL)."""
+    def _decode_into(self, raw, buf, i):
+        """Worker stage: JPEG decode + augment straight into row ``i`` of
+        the staging slot (GIL released in cv2/PIL; the row write is the
+        worker's own memcpy, off the assembly thread)."""
         from . import recordio
         header, img = recordio.unpack_img(raw, iscolor=1)
-        label = float(np.asarray(header.label).ravel()[0])
-        return self._augment(img), label
+        buf[0][i] = self._augment(img)
+        buf[1][i] = float(np.asarray(header.label).ravel()[0])
 
     # --- producer/prefetch machinery (dmlc::ThreadedIter analog) ---------
     def _start_producer(self):
@@ -687,8 +871,22 @@ class ImageRecordIter(DataIter):
         if self._pool is None:
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 self._nthreads, thread_name_prefix="imgrec-decode")
+        if self._bufs is None:
+            # reusable staging ring: one HWC+label slot per in-flight
+            # batch (a single workspace carve can't back several batches
+            # decoding concurrently), allocated once and recycled
+            c, h, w = self.data_shape
+            self._bufs = queue.Queue()
+            for _ in range(self._prefetch + 1):
+                self._bufs.put(
+                    (np.empty((self.batch_size, h, w, c), np.float32),
+                     np.empty((self.batch_size,), np.float32)))
+        self._reader_done = False
         self._queue = queue.Queue(self._prefetch)
         self._stop.clear()
+        if _telemetry.enabled:
+            _IO_DEPTH.labels(iter="ImageRecordIter").set(self._prefetch)
+            _IO_WORKERS.labels(iter="ImageRecordIter").set(self._nthreads)
         # the thread holds only a WEAK reference between batches, so an
         # abandoned iterator stays collectable and its loop exits instead
         # of leaking the thread + pool
@@ -718,45 +916,80 @@ class ImageRecordIter(DataIter):
                 pass
             self._producer_thread.join(timeout=0.05)
         self._producer_thread = None
+        # settle in-flight decodes before the ring is recycled: a worker
+        # still writing into a slot would corrupt the next epoch's batches
+        if self._inflight:
+            for futs, _n, buf in self._inflight:
+                for f in futs:
+                    if not f.cancel():
+                        try:
+                            f.result()
+                        except Exception:  # noqa: BLE001 — epoch abandoned
+                            pass
+                if self._bufs is not None:
+                    self._bufs.put(buf)
+            self._inflight.clear()
 
-    def _produce_one(self):
-        """Assemble one batch.  Returns (items_to_enqueue, done)."""
-        raws = []
-        while len(raws) < self.batch_size:
-            raw = self._read_raw()
-            if raw is None:
+    def _pump(self):
+        """One producer turn (pipelined): keep up to ``prefetch_buffer``
+        batches decoding in the pool, then finish + assemble the OLDEST
+        one — batch order is exactly the reader order even though several
+        batches' decodes overlap.  Returns (items_to_enqueue, done)."""
+        while (not self._reader_done
+               and len(self._inflight) < self._prefetch):
+            raws = []
+            while len(raws) < self.batch_size:
+                raw = self._read_raw()
+                if raw is None:
+                    self._reader_done = True
+                    break
+                raws.append(raw)
+            if not raws:
                 break
-            raws.append(raw)
-        if not raws:
+            try:
+                buf = self._bufs.get_nowait()
+            except queue.Empty:  # can't happen by sizing; stay deadlock-free
+                c, h, w = self.data_shape
+                buf = (np.empty((self.batch_size, h, w, c), np.float32),
+                       np.empty((self.batch_size,), np.float32))
+            futs = [self._pool.submit(self._decode_into, r, buf, i)
+                    for i, r in enumerate(raws)]
+            self._inflight.append((futs, len(raws), buf))
+        if not self._inflight:
             return [None], True
-        futures = [self._pool.submit(self._decode_one, r) for r in raws]
-        results = [f.result() for f in futures]
+        futs, n, buf = self._inflight.popleft()
+        for f in futs:
+            f.result()
+        batch = self._assemble_batch(buf, n)
+        self._bufs.put(buf)
+        pad = self.batch_size - n
+        done = bool(pad) or (self._reader_done and not self._inflight)
+        return ([batch, None], True) if done else ([batch], False)
+
+    def _assemble_batch(self, buf, n):
+        """Pad + transpose one decoded staging slot into a DataBatch."""
+        data, label = buf
         c, h, w = self.data_shape
-        # staging scratch from the resource pool: one workspace carved for
-        # HWC staging + CHW output + label (the reference op pattern —
-        # request one space sized for everything); safe to reuse because
-        # nd.array's astype copy (guaranteed, never aliasing) materializes
-        # the batch before the next call overwrites the workspace
+        # CHW output still comes from the pooled temp space (one rotating
+        # slot; only the producer thread touches it).  Reuse of both the
+        # carve and the ring slot is safe because nd.array's astype copy
+        # (guaranteed, never aliasing) materializes the batch first.
         n_img = self.batch_size * h * w * c
-        ws = self._workspace.get_space(
-            (2 * n_img + self.batch_size,), np.float32)
+        ws = self._workspace.get_space((n_img,), np.float32)
         if _telemetry.enabled:
-            _IO_WS.labels(iter="ImageRecordIter").set(ws.nbytes)
-        data = ws[:n_img].reshape((self.batch_size, h, w, c))
-        chw = ws[n_img:2 * n_img].reshape((self.batch_size, c, h, w))
-        label = ws[2 * n_img:]
-        for i, (d, l) in enumerate(results):
-            data[i], label[i] = d, l
-        pad = self.batch_size - len(results)
+            _IO_WS.labels(iter="ImageRecordIter").set(
+                ws.nbytes + (self._prefetch + 1)
+                * (data.nbytes + label.nbytes))
+        chw = ws[:n_img].reshape((self.batch_size, c, h, w))
+        pad = self.batch_size - n
         if pad:
-            data[len(results):] = data[:1]
-            label[len(results):] = label[:1]
+            data[n:] = data[:1]
+            label[n:] = label[:1]
         # one vectorized HWC->CHW for the whole batch (cheaper than 128
         # per-image strided copies, and outside the decode workers),
         # written into the pooled CHW carve instead of a fresh allocation
         np.copyto(chw, data.transpose(0, 3, 1, 2))
-        batch = DataBatch([nd.array(chw)], [nd.array(label)], pad=pad)
-        return ([batch, None], True) if pad else ([batch], False)
+        return DataBatch([nd.array(chw)], [nd.array(label)], pad=pad)
 
     def _augment(self, img):
         c, h, w = self.data_shape
@@ -825,7 +1058,7 @@ def _imgrec_produce_loop(ref, stop, q):
         if it is None:
             return
         try:
-            items, done = it._produce_one()
+            items, done = it._pump()
         except Exception as e:               # noqa: BLE001 — surfaced below
             items, done = [e, None], True
         del it

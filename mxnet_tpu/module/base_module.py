@@ -122,11 +122,24 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The reference fit loop (base_module.py:399-560)."""
+            monitor=None, sparse_row_id_fn=None, overlap_depth=None):
+        """The reference fit loop (base_module.py:399-560).
+
+        ``overlap_depth`` > 0 (default from ``MXNET_IO_OVERLAP_DEPTH``)
+        defers each step's blocking tail — metric D2H + batch callback —
+        behind that many dispatched steps, so the device never idles on
+        host-side bookkeeping.  Side effects still run in exact step
+        order; pass 0 for the fully serial reference loop.  A monitor
+        forces the serial loop (it must observe each step synchronously).
+        """
         assert num_epoch is not None, "num_epoch must be specified"
         from .. import initializer as init_mod
+        from ..train_loop import OverlappedLoop, default_overlap_depth
         initializer = initializer or init_mod.Uniform(0.01)
+        depth = default_overlap_depth() if overlap_depth is None \
+            else max(0, int(overlap_depth))
+        overlap = (depth > 0 and monitor is None
+                   and hasattr(self, "defer_metric_update"))
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -147,18 +160,35 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
+            loop = OverlappedLoop(depth) if overlap else None
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    _call(batch_end_callback,
-                          BatchEndParam(epoch, nbatch, eval_metric))
+                deferred = None
+                if loop is not None:
+                    deferred = self.defer_metric_update(
+                        eval_metric, data_batch.label)
+                if deferred is not None:
+                    # blocking tail (metric D2H + callback) runs `depth`
+                    # steps behind dispatch, in exact step order
+                    def _tail(_d=deferred, _i=nbatch, _e=epoch):
+                        _d()
+                        if batch_end_callback is not None:
+                            _call(batch_end_callback,
+                                  BatchEndParam(_e, _i, eval_metric))
+                    loop.push(_tail)
+                else:
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        _call(batch_end_callback,
+                              BatchEndParam(epoch, nbatch, eval_metric))
                 nbatch += 1
+            if loop is not None:
+                loop.drain()
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

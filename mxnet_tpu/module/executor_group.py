@@ -107,13 +107,20 @@ class DataParallelExecutorGroup:
     def _load_batch(self, data_batch):
         data = data_batch.data
         label = data_batch.label or []
+        # single-device fast path: no slicing — a batch the producer
+        # already placed on the right device (PrefetchingIter double
+        # buffering) passes through untouched (as_in_context is a no-op
+        # when the context matches), so the step pays no re-put
+        whole = len(self.slices) == 1
         feeds = []
         for i, slc in enumerate(self.slices):
             feed = {}
             for name, arr in zip(self.data_names, data):
-                feed[name] = arr[slc].as_in_context(self.contexts[i])
+                feed[name] = (arr if whole else
+                              arr[slc]).as_in_context(self.contexts[i])
             for name, arr in zip(self.label_names, label):
-                feed[name] = arr[slc].as_in_context(self.contexts[i])
+                feed[name] = (arr if whole else
+                              arr[slc]).as_in_context(self.contexts[i])
             feeds.append(feed)
         return feeds
 

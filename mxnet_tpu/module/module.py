@@ -349,6 +349,27 @@ class Module(BaseModule):
             fs.flush_eager()
         self._exec_group.update_metric(eval_metric, labels)
 
+    def defer_metric_update(self, eval_metric, labels):
+        """Capture this step's outputs/labels and return a zero-arg
+        closure performing the metric update LATER — the overlapped fit
+        loop (train_loop.OverlappedLoop) runs it a few steps behind
+        dispatch so the metric's hard D2H never stalls the next step.
+        Returns None when deferring would not be equivalent (multi-device
+        eager group, whose outputs are rebound per step)."""
+        fs = self._fused()
+        if fs is not None:
+            outs = fs.mesh_outputs()
+            if outs is not None:
+                labels = list(labels)
+                return lambda: eval_metric.update(labels, outs)
+            fs.flush_eager()
+        eg = self._exec_group
+        if len(eg.execs) != 1:
+            return None
+        lab = [l[eg.slices[0]] for l in labels]
+        outs = list(eg.execs[0].outputs)
+        return lambda: eval_metric.update(lab, outs)
+
     def get_params(self):
         assert self.binded and self.params_initialized
         fs = self._fused()

@@ -8,6 +8,7 @@ import ctypes  # noqa: F401  (kept for API-shape parity)
 import numbers
 import os
 import struct
+import threading
 from collections import namedtuple
 
 import numpy as np
@@ -155,7 +156,17 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         self.fidx = None
+        self._read_lock = threading.RLock()
         super().__init__(uri, flag)
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["_read_lock"] = None  # locks don't pickle; __setstate__ rebuilds
+        return d
+
+    def __setstate__(self, d):
+        super().__setstate__(d)
+        self._read_lock = threading.RLock()
 
     def open(self):
         super().open()
@@ -184,8 +195,12 @@ class MXIndexedRecordIO(MXRecordIO):
         self._seek(self.idx[idx])
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        # seek+read must be ONE atomic unit: the pipelined ImageRecordIter
+        # reader thread shares this handle with user-thread random access,
+        # and an interleaved seek lands the read on the wrong record
+        with self._read_lock:
+            self.seek(idx)
+            return self.read()
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

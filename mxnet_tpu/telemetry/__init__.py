@@ -36,7 +36,8 @@ from . import export as _export
 
 __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "registry", "snapshot", "snapshot_json", "prometheus_text",
-           "value", "reset", "start_http_server", "stop_http_server",
+           "value", "quantile", "reset", "start_http_server",
+           "stop_http_server",
            "Counter", "Gauge", "Histogram", "MetricRegistry",
            "DEFAULT_TIME_BUCKETS", "log_buckets"]
 
@@ -108,6 +109,19 @@ def value(name, **labels):
     if isinstance(data, dict):
         return data["count"]
     return data
+
+
+def quantile(name, q, **labels):
+    """Estimated q-quantile of one histogram series (bucket-interpolated;
+    see _HistogramChild.quantile).  Returns 0.0 for unknown/never-observed
+    series so callers can report without existence checks."""
+    fam = _registry.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels)
+    if not hasattr(child, "quantile"):
+        return 0.0
+    return child.quantile(q)
 
 
 def reset():

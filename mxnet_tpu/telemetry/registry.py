@@ -152,6 +152,31 @@ class _HistogramChild(_Child):
         out["+Inf"] = cum + counts[-1]
         return {"buckets": out, "sum": s, "count": n}
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0..1) from the bucket counts, linearly
+        interpolated inside the containing bucket.  Bucketed estimate —
+        good to a half-decade, which is all p50/p99 dashboards need.
+        Returns 0.0 with no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise MXNetError("quantile q must be in [0, 1], got %r" % q)
+        with self._family._lock:
+            counts = list(self._counts)
+            n = self._count
+        if n == 0:
+            return 0.0
+        bounds = self._family.buckets
+        target = q * n
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            prev_cum = cum
+            cum += c
+            if cum >= target:
+                hi = bounds[i]
+                lo = bounds[i - 1] if i > 0 else 0.0
+                frac = (target - prev_cum) / c if c else 0.0
+                return lo + (hi - lo) * frac
+        return bounds[-1]  # target falls in the +Inf overflow bucket
+
 
 class _MetricFamily:
     """Common machinery: name/help/label validation + the child table."""
@@ -263,6 +288,9 @@ class Histogram(_MetricFamily):
 
     def get(self) -> Dict[str, object]:
         return self._default_child().get()
+
+    def quantile(self, q: float) -> float:
+        return self._default_child().quantile(q)
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -1,0 +1,26 @@
+"""CI schema guard for the input-pipeline benchmark: `bench_io --smoke`
+must exit 0 and emit one JSON line per path (pipelined + bare) with the
+stable field set other tooling parses."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_io_smoke_schema():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bench_io", "--smoke"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 2, proc.stdout
+    assert [l["pipelined"] for l in lines] == [False, True]
+    for line in lines:
+        assert line["metric"] == "imagerecorditer_img_per_sec"
+        assert line["value"] > 0

@@ -1,0 +1,292 @@
+"""Pipelined input-path tests: worker-pool PrefetchingIter determinism
+(ordering, mid-epoch reset, epoch boundaries, exception propagation),
+device/mesh placement parity, pipelined ImageRecordIter, per-host
+sharding, the overlapped train loop, and the fit() integration."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.io import DataDesc, NDArrayIter, PrefetchingIter
+from mxnet_tpu.train_loop import OverlappedLoop, run_epoch
+
+
+def _epoch(it):
+    """[(data, label)] numpy snapshot of one full epoch."""
+    return [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+            for b in it]
+
+
+def _make_arrays(n=40, dim=5):
+    return (np.arange(n * dim, dtype=np.float32).reshape(n, dim),
+            np.arange(n, dtype=np.float32))
+
+
+def _assert_same(a, b):
+    assert len(a) == len(b)
+    for (ad, al), (bd, bl) in zip(a, b):
+        assert np.array_equal(ad, bd)
+        assert np.array_equal(al, bl)
+
+
+# ---- worker-pool PrefetchingIter determinism ------------------------------
+def test_worker_pool_matches_unpipelined():
+    X, y = _make_arrays()
+    ref = _epoch(NDArrayIter(X, y, batch_size=8))
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=8),
+                         num_workers=4, prefetch_depth=3)
+    _assert_same(ref, _epoch(pf))
+    pf.reset()
+    _assert_same(ref, _epoch(pf))   # epoch 2 identical, nothing leaked
+
+
+def test_midepoch_reset_no_dup_drop_reorder():
+    X, y = _make_arrays()
+    ref = _epoch(NDArrayIter(X, y, batch_size=8))
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=8),
+                         num_workers=3, prefetch_depth=2)
+    next(pf)
+    next(pf)
+    pf.reset()                       # workers + queued batches mid-flight
+    _assert_same(ref, _epoch(pf))
+
+
+def test_epoch_boundary_exact():
+    X, y = _make_arrays(n=20)
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=5), num_workers=4)
+    assert len(list(pf)) == 4
+    # exhausted: further next() must re-raise instead of blocking
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert pf.iter_next() is False
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_inner_exception_propagates():
+    class Boom(NDArrayIter):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._n = 0
+
+        def next(self):
+            self._n += 1
+            if self._n > 2:
+                raise RuntimeError("decode blew up")
+            return super().next()
+
+    X, y = _make_arrays()
+    pf = PrefetchingIter(Boom(X, y, batch_size=8), num_workers=3)
+    next(pf)
+    next(pf)
+    with pytest.raises(RuntimeError, match="decode blew up"):
+        next(pf)
+    with pytest.raises(StopIteration):   # done after the error, no hang
+        next(pf)
+
+
+def test_mesh_sharded_prefetch_bit_identical():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = np.array(jax.devices())
+    batch = 8
+    if batch % len(devs):
+        devs = devs[:1]
+    mesh = Mesh(devs, ("dp",))
+    bsh = NamedSharding(mesh, P("dp"))
+    X, y = _make_arrays()
+    ref = _epoch(NDArrayIter(X, y, batch_size=batch))
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=batch),
+                         sharding=bsh, num_workers=3)
+    got = []
+    for b in pf:
+        assert b.data[0]._data.sharding == bsh   # pre-sharded by producer
+        got.append((b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy()))
+    _assert_same(ref, got)
+
+
+def test_device_placement_values_identical():
+    import jax
+    dev = jax.devices()[0]
+    X, y = _make_arrays()
+    ref = _epoch(NDArrayIter(X, y, batch_size=8))
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=8),
+                         device=dev, num_workers=2)
+    got = []
+    for b in pf:
+        assert dev in b.data[0]._data.sharding.device_set
+        got.append((b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy()))
+    _assert_same(ref, got)
+
+
+def test_rename_preserves_layout():
+    X, y = _make_arrays()
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=8),
+                         rename_data=[{"data": "renamed"}],
+                         rename_label=[{"softmax_label": "lab"}],
+                         num_workers=1)
+    d = pf.provide_data[0]
+    l = pf.provide_label[0]
+    assert isinstance(d, DataDesc) and d.name == "renamed"
+    assert d.layout == "NCHW"        # the 4th field must survive renaming
+    assert l.name == "lab" and l.layout == "NCHW"
+    list(pf)
+
+
+def test_pipeline_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXNET_IO_PIPELINE_WORKERS", "5")
+    monkeypatch.setenv("MXNET_IO_PREFETCH_DEPTH", "7")
+    X, y = _make_arrays()
+    pf = PrefetchingIter(NDArrayIter(X, y, batch_size=8))
+    assert pf.num_workers == 5
+    assert pf.prefetch_depth == 7
+    list(pf)
+
+
+# ---- pipelined ImageRecordIter --------------------------------------------
+def _build_rec(prefix, n=40, size=56):
+    rec_path, idx_path = prefix + ".rec", prefix + ".idx"
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+    return rec_path, idx_path
+
+
+def _labels(it):
+    out = []
+    for b in it:
+        good = b.data[0].shape[0] - b.pad
+        out.extend(b.label[0].asnumpy()[:good].tolist())
+    return out
+
+
+def test_imgrec_pipelined_order_and_reset(tmp_path):
+    rec, idx = _build_rec(str(tmp_path / "t"))
+    it = mx.io.ImageRecordIter(rec, (3, 48, 48), 16, path_imgidx=idx,
+                               preprocess_threads=3, prefetch_buffer=3)
+    l1 = _labels(it)
+    assert l1 == [float(i) for i in range(40)]   # reader order, no dup/drop
+    it.reset()
+    assert _labels(it) == l1
+    it.reset()
+    next(it)                                     # in-flight decodes alive
+    it.reset()
+    assert _labels(it) == l1
+    it.close()
+
+
+def test_imgrec_num_parts_partition(tmp_path):
+    rec, idx = _build_rec(str(tmp_path / "p"))
+    full = [float(i) for i in range(40)]
+    for mode in ("idx", "seq"):
+        seen = []
+        for p in range(2):
+            it = mx.io.ImageRecordIter(
+                rec, (3, 48, 48), 8,
+                path_imgidx=idx if mode == "idx" else None,
+                preprocess_threads=2, num_parts=2, part_index=p)
+            part = _labels(it)
+            assert part, mode
+            seen.extend(part)
+            it.close()
+        assert sorted(seen) == full, mode        # exact disjoint cover
+
+
+def test_imgrec_part_index_validation(tmp_path):
+    rec, idx = _build_rec(str(tmp_path / "v"), n=8)
+    with pytest.raises(mx.MXNetError, match="part_index"):
+        mx.io.ImageRecordIter(rec, (3, 48, 48), 4, path_imgidx=idx,
+                              num_parts=2, part_index=2)
+
+
+# ---- overlapped train loop ------------------------------------------------
+def test_overlapped_loop_order_and_window():
+    ran = []
+    loop = OverlappedLoop(depth=2)
+    for i in range(5):
+        loop.push(lambda i=i: ran.append(i))
+        assert len(loop) <= 2
+        # blocker i-2 must have run by the time i is pushed
+        assert ran == list(range(max(0, i - 1)))
+    loop.drain()
+    assert ran == list(range(5))
+    assert len(loop) == 0
+
+
+def test_overlapped_loop_depth_zero_is_serial():
+    ran = []
+    loop = OverlappedLoop(depth=0)
+    for i in range(3):
+        out = loop.push(lambda i=i: (ran.append(i), i)[1])
+        assert out == i              # runs immediately, returns the value
+    assert ran == [0, 1, 2]
+
+
+def test_run_epoch_counts_and_defers():
+    X, y = _make_arrays(n=32)
+    it = NDArrayIter(X, y, batch_size=8)
+    dispatched, blocked = [], []
+    n = run_epoch(it, lambda b: dispatched.append(1) or len(dispatched),
+                  block_fn=lambda h, i: blocked.append((h, i)), depth=2)
+    assert n == 4
+    assert [i for _, i in blocked] == [0, 1, 2, 3]
+    assert [h for h, _ in blocked] == [1, 2, 3, 4]
+
+
+def test_fit_overlapped_matches_serial():
+    """Module.fit with the overlapped loop: same params, same metric, and
+    batch_end_callback fires once per batch in exact order."""
+    def build():
+        from mxnet_tpu import sym
+        from mxnet_tpu.module import Module
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        return Module(sym.SoftmaxOutput(fc, name="softmax"),
+                      context=mx.cpu(0))
+
+    rs = np.random.RandomState(0)
+    X = rs.uniform(size=(24, 6)).astype(np.float32)
+    y = rs.randint(0, 4, (24,)).astype(np.float32)
+
+    def fit(depth):
+        mx.random.seed(11)
+        mod = build()
+        seen = []
+        mod.fit(NDArrayIter(X, y, batch_size=8), num_epoch=2,
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.1},
+                batch_end_callback=lambda p: seen.append(p.nbatch),
+                overlap_depth=depth)
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}, seen
+
+    p_serial, cb_serial = fit(0)
+    p_over, cb_over = fit(2)
+    assert cb_serial == cb_over == [0, 1, 2, 0, 1, 2]
+    for k in p_serial:
+        assert np.allclose(p_serial[k], p_over[k], atol=1e-6), k
+
+
+# ---- telemetry quantile ----------------------------------------------------
+def test_histogram_quantile():
+    from mxnet_tpu import telemetry
+    h = telemetry.histogram("test_quantile_seconds", "t", ("iter",))
+    child = h.labels(iter="x")
+    for _ in range(90):
+        child.observe(1e-4)
+    for _ in range(10):
+        child.observe(1.0)
+    p50 = telemetry.quantile("test_quantile_seconds", 0.5, iter="x")
+    p99 = telemetry.quantile("test_quantile_seconds", 0.99, iter="x")
+    assert p50 < 2e-3                # ~1e-4 bucket, half-decade accuracy
+    assert p99 > 0.1                 # tail lands in the ~1s bucket
+    assert telemetry.quantile("test_quantile_seconds", 0.5, iter="no") == 0.0
+    assert telemetry.quantile("never_created_metric", 0.5) == 0.0
+    with pytest.raises(mx.MXNetError):
+        child.quantile(1.5)
