@@ -16,6 +16,7 @@ _apply_pool_env()
 from .base import MXNetError
 from . import telemetry
 from . import tracing
+from . import runlog  # env-gated ledger activation (MXNET_RUNLOG_DIR/_PATH)
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus)
 from . import engine

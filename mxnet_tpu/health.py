@@ -257,6 +257,25 @@ def register_program(name, fn, args, kwargs=None, donated=False, env=None):
         _atlas.analyze(name, lowered, cost_flops=flops)
     except Exception:
         pass
+    try:
+        from . import runlog as _runlog
+        if _runlog.enabled():
+            _runlog.note_topology()  # jax is initialized by now
+            digest = None
+            try:
+                from . import atlas as _atlas
+                snap = _atlas.snapshot(top_k=1).get(name)
+                if snap:
+                    digest = {"coverage_pct": snap.get("coverage_pct"),
+                              "n_scopes": snap.get("n_scopes"),
+                              "n_instructions": snap.get("n_instructions")}
+            except Exception:
+                pass
+            _runlog.event("program_registered", program=name, flops=flops,
+                          arg_bytes=arg_b, out_bytes=out_b, temp_bytes=tmp_b,
+                          donated=bool(donated), env=env, atlas=digest)
+    except Exception:
+        pass
     _PROG_FLOPS.labels(program=name).set(flops)
     _PROG_HBM.labels(program=name, kind="args").set(arg_b)
     _PROG_HBM.labels(program=name, kind="output").set(out_b)
@@ -462,12 +481,24 @@ class StepMonitor(object):
                  else program, "anomaly": tripped,
                  "compile_misses": miss_d}
         with self._lock:
+            prev_cause = self._cause
             self._ewma = ewma
             self._window.append(dt)
             self._last_dt = dt
             self._cause = cause
             self._mfu = mfu
             self._ledger.append(entry)
+        if cause != prev_cause:
+            # durable record of every verdict transition (not every step:
+            # the ledger is an event log, not a metrics store)
+            try:
+                from . import runlog as _runlog
+                _runlog.event("health_verdict", cause=cause,
+                              prev_cause=prev_cause, step_seconds=dt,
+                              shares=shares, mfu_pct=mfu,
+                              ewma_seconds=ewma)
+            except Exception:
+                pass
 
     def _flight_dump(self, dt, ewma, cause, shares):
         """Record the anomaly into the flight ring and dump it; evidence
@@ -481,7 +512,14 @@ class StepMonitor(object):
                 end_us - dt * 1e6, end_us,
                 args={"step_seconds": dt, "ewma_seconds": ewma,
                       "cause": cause, "shares": shares})
-            _tracing.flight.dump(reason="health_anomaly")
+            dump_path = _tracing.flight.dump(reason="health_anomaly")
+            try:
+                from . import runlog as _runlog
+                _runlog.event("anomaly", step_seconds=dt,
+                              ewma_seconds=ewma, cause=cause,
+                              shares=shares, flight_dump=dump_path)
+            except Exception:
+                pass
         except Exception:
             pass
 
@@ -533,6 +571,7 @@ class WorkerTable(object):
     def __init__(self):
         self._lock = threading.Lock()
         self._workers = {}
+        self._flags = {}  # rank -> bool, for transition-edge ledger events
 
     def update(self, rank, step_seconds):
         rank = str(rank)
@@ -543,9 +582,25 @@ class WorkerTable(object):
         _WORKER_STEP.labels(rank=rank).set(step_seconds)
         if len(snap) >= 2:
             med = _median(list(snap.values()))
+            transitions = []
+            with self._lock:
+                for r, s in snap.items():
+                    flag = bool(med > 0 and s > self.BAND * med)
+                    if self._flags.get(r, False) != flag:
+                        transitions.append((r, flag, s))
+                    self._flags[r] = flag
             for r, s in snap.items():
                 _STRAGGLER.labels(rank=r).set(
                     1.0 if (med > 0 and s > self.BAND * med) else 0.0)
+            if transitions:
+                try:
+                    from . import runlog as _runlog
+                    for r, flag, s in transitions:
+                        _runlog.event("straggler", worker_rank=r,
+                                      straggler=flag, step_seconds=s,
+                                      median_seconds=med)
+                except Exception:
+                    pass
 
     def snapshot(self):
         with self._lock:
@@ -561,6 +616,7 @@ class WorkerTable(object):
     def clear(self):
         with self._lock:
             self._workers.clear()
+            self._flags.clear()
 
 
 #: process-wide singletons driven by the hook sites.

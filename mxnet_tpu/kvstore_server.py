@@ -668,5 +668,15 @@ def run_server():
                 json.dump({"workers": _health.workers.snapshot()}, f)
         except OSError:
             pass
+    try:
+        # ledger epilogue: the final straggler table, then run_end
+        from . import health as _health
+        from . import runlog as _runlog
+        if _runlog.enabled():
+            _runlog.event("straggler_table",
+                          workers=_health.workers.snapshot())
+            _runlog.disable()
+    except Exception:
+        pass
     if _tracing.enabled:
         _tracing.dump_process_trace(role="server")

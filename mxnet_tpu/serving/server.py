@@ -413,8 +413,23 @@ class ModelServer:
             causes.append("deadline_misses")
         if self._stopped:
             causes.append("stopped")
+        status = "degraded" if causes else "serving"
+        prev = getattr(self, "_last_health_status", None)
+        if status != prev:
+            # durable trail of every serving/degraded flip; edge-triggered
+            # so a /healthz poll loop doesn't flood the ledger
+            self._last_health_status = status
+            try:
+                from .. import runlog as _runlog
+                _runlog.event("healthz", status=status, prev_status=prev,
+                              causes=causes,
+                              queue_saturation=round(saturation, 4),
+                              post_warmup_compiles=compiles,
+                              deadline_miss_rate=round(miss_rate, 4))
+            except Exception:
+                pass
         return {
-            "status": "degraded" if causes else "serving",
+            "status": status,
             "causes": causes,
             "queue_saturation": saturation,
             "post_warmup_compiles": compiles,

@@ -31,15 +31,15 @@ from __future__ import annotations
 
 from ..base import get_env
 from .registry import (Counter, Gauge, Histogram, MetricRegistry,
-                       DEFAULT_TIME_BUCKETS, log_buckets)
+                       WindowedRate, DEFAULT_TIME_BUCKETS, log_buckets)
 from . import export as _export
 
 __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "registry", "snapshot", "snapshot_json", "prometheus_text",
            "value", "quantile", "reset", "start_http_server",
-           "stop_http_server",
+           "stop_http_server", "timeseries",
            "Counter", "Gauge", "Histogram", "MetricRegistry",
-           "DEFAULT_TIME_BUCKETS", "log_buckets"]
+           "WindowedRate", "DEFAULT_TIME_BUCKETS", "log_buckets"]
 
 # The process-wide default registry.  Always live: instruments can be
 # created and driven regardless of `enabled` (the flag only gates the
@@ -71,17 +71,21 @@ def histogram(name, help="", labelnames=(),  # noqa: A002
 
 def enable():
     """Turn the built-in instrumentation on; starts the /metrics endpoint
-    when ``MXNET_TELEMETRY_PORT`` is set."""
+    when ``MXNET_TELEMETRY_PORT`` is set and the time-series sampler
+    unless ``MXNET_TELEMETRY_TS=0``."""
     global enabled
     enabled = True
     port = get_env("MXNET_TELEMETRY_PORT", None, int)
     if port is not None:
         start_http_server(port)
+    if get_env("MXNET_TELEMETRY_TS", True, bool):
+        timeseries.start()
 
 
 def disable():
     global enabled
     enabled = False
+    timeseries.stop()
 
 
 def snapshot():
@@ -141,6 +145,10 @@ def start_http_server(port=None, host=None):
 
 def stop_http_server():
     _export.stop_http_server()
+
+
+# imported after _registry exists (timeseries.store() binds to it lazily)
+from . import timeseries  # noqa: E402
 
 
 if get_env("MXNET_TELEMETRY", False, bool):

@@ -105,8 +105,10 @@ _server_lock = threading.Lock()
 def start_http_server(port: int, registry: MetricRegistry,
                       host: str = "127.0.0.1"):
     """Serve ``/metrics`` (text exposition), ``/metrics.json``,
-    ``/statusz`` (health snapshot) and ``/programz`` (registered XLA
-    programs with their atlas per-scope tables) on a daemon thread.
+    ``/statusz`` (health snapshot), ``/programz`` (registered XLA
+    programs with their atlas per-scope tables) and ``/timeseriesz``
+    (multi-resolution metric history; ``?window=SECS&prefix=NAME`` to
+    filter, ``?format=ascii`` for sparklines) on a daemon thread.
     ``/programz?top_k=N`` bounds each program's scope table.  Binds loopback by
     default — the wire is unauthenticated, so exposing it wider is an
     explicit operator choice (``MXNET_TELEMETRY_HOST``).  Returns the
@@ -128,6 +130,32 @@ def start_http_server(port: int, registry: MetricRegistry,
                 from .. import health as _health
                 body = json.dumps(_health.statusz()).encode()
                 ctype = "application/json"
+            elif path == "/timeseriesz":
+                # lazy import: the package init imports this module first
+                from . import timeseries as _ts
+                window = None
+                prefix = None
+                fmt = "json"
+                for part in query.split("&"):
+                    if part.startswith("window="):
+                        try:
+                            window = float(part[len("window="):])
+                        except ValueError:
+                            pass
+                    elif part.startswith("prefix="):
+                        prefix = part[len("prefix="):]
+                    elif part.startswith("format="):
+                        fmt = part[len("format="):]
+                snap = _ts.snapshot(window_seconds=window, prefix=prefix)
+                if fmt == "ascii":
+                    body = _ts.render_ascii(snap).encode()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    body = json.dumps(
+                        {"interval": _ts.store().interval,
+                         "running": _ts.running(),
+                         "series": snap}).encode()
+                    ctype = "application/json"
             elif path == "/programz":
                 # lazy imports for the same circularity reason as /statusz
                 from .. import atlas as _atlas

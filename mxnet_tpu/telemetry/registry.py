@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..base import MXNetError
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
-           "log_buckets", "DEFAULT_TIME_BUCKETS"]
+           "WindowedRate", "log_buckets", "DEFAULT_TIME_BUCKETS"]
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -156,7 +156,11 @@ class _HistogramChild(_Child):
         """Estimated q-quantile (0..1) from the bucket counts, linearly
         interpolated inside the containing bucket.  Bucketed estimate —
         good to a half-decade, which is all p50/p99 dashboards need.
-        Returns 0.0 with no observations."""
+        Returns 0.0 with no observations and ``+inf`` when the target
+        falls in the +Inf overflow bucket: the true value is beyond the
+        top finite bound, and silently reporting that bound would make an
+        off-scale tail look healthy.  Consumers that need a finite number
+        (JSON without Infinity, sparklines) must handle it explicitly."""
         if not 0.0 <= q <= 1.0:
             raise MXNetError("quantile q must be in [0, 1], got %r" % q)
         with self._family._lock:
@@ -175,7 +179,39 @@ class _HistogramChild(_Child):
                 lo = bounds[i - 1] if i > 0 else 0.0
                 frac = (target - prev_cum) / c if c else 0.0
                 return lo + (hi - lo) * frac
-        return bounds[-1]  # target falls in the +Inf overflow bucket
+        return float("inf")  # target falls in the +Inf overflow bucket
+
+
+class WindowedRate:
+    """THE windowed-rate definition for counters, shared by every consumer
+    (the time-series sampler, dashboards, bench blocks) so "requests/s"
+    means the same thing everywhere: ``(value - prev) / (now - prev_t)``
+    between two cumulative observations.
+
+    Counter resets (registry.reset(), process restart behind one store)
+    surface as a *decrease*; the window restarts there and reports 0.0
+    rather than a huge negative spike.  The first observation has no
+    window and returns None.  Not thread-safe on its own: each consumer
+    owns its tracker (the shared thing is the definition, not the state).
+    """
+
+    __slots__ = ("_prev_t", "_prev_v")
+
+    def __init__(self):
+        self._prev_t = None
+        self._prev_v = None
+
+    def observe(self, value: float, now: float) -> Optional[float]:
+        """Feed one cumulative sample; returns the rate over the window
+        since the previous sample (None for the first / a zero-length
+        window, 0.0 across a counter reset)."""
+        prev_t, prev_v = self._prev_t, self._prev_v
+        self._prev_t, self._prev_v = float(now), float(value)
+        if prev_t is None or now <= prev_t:
+            return None
+        if value < prev_v:        # counter reset: restart the window
+            return 0.0
+        return (value - prev_v) / (now - prev_t)
 
 
 class _MetricFamily:

@@ -1,0 +1,394 @@
+"""Time-series telemetry: multi-resolution history of the live registry.
+
+The registry answers "what is the process doing *right now*"; everything
+before the current scrape evaporates.  This module gives it a memory in
+the RRDtool/Prometheus-TSDB mold, sized for an always-on runtime rather
+than a database: a background sampler snapshots every registered metric
+on a cadence (``MXNET_TELEMETRY_TS_INTERVAL``, default 1 s) into fixed
+multi-resolution ring buffers — 512 points at 1× the sampling interval,
+512 at 10×, 512 at 60× (≈8.5 min / 85 min / 8.5 h of trailing history at
+the 1 s default) — so a flight-recorder dump or a ``/timeseriesz``
+scrape can show the minutes *leading up to* an anomaly, not just the
+instant after it.
+
+What is stored per series (one point per tier step, mean-aggregated
+into the coarser tiers):
+
+- **counters** → a windowed rate (:class:`registry.WindowedRate` — the
+  one shared rate definition, so "ops/s" here matches any dashboard
+  computing it the same way), under the ``rate`` stat;
+- **gauges** → the sampled value (``value``);
+- **histograms** → ``p50`` / ``p99`` via the existing
+  :meth:`Histogram.quantile` plus an observation-count ``rate``.
+
+Quantiles that fall in the +Inf overflow bucket are stored as ``None``
+(JSON ``null``) — an off-scale tail must read as "off scale", and
+``json.dumps`` would otherwise emit non-standard ``Infinity``.
+
+Cost model: sampling reads counters/gauges/bucket arrays under the
+per-family metric locks the increment path already uses — pure host
+arithmetic, no jax calls, so the sampler adds **zero** XLA compiles by
+construction, and its steady-state cost is one registry walk per
+interval off the training thread (bench.py A/Bs the residual as
+``sampler_overhead_pct``).  Nothing is sampled (and no thread exists)
+until :func:`start` — which ``telemetry.enable()`` calls unless
+``MXNET_TELEMETRY_TS=0``.
+
+Lock discipline (graftlint GL003): samples are *computed* outside the
+store lock and appended under it; the sampler thread sleeps via
+``Event.wait(timeout)`` and is joined with a timeout, never while any
+telemetry lock is held.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import get_env
+from .registry import Histogram, MetricRegistry, WindowedRate
+
+__all__ = ["TimeSeriesStore", "DEFAULT_TIERS", "series_key", "sparkline",
+           "render_ascii", "store", "start", "stop", "running",
+           "snapshot", "trailing"]
+
+#: (base-sample multiplier, ring capacity) per tier, finest first.  A
+#: tier emits one point per ``multiplier`` base samples (the mean of the
+#: non-None samples in that window), so tier spans are exact multiples
+#: of the sampling interval regardless of wall-clock jitter.
+DEFAULT_TIERS: Tuple[Tuple[int, int], ...] = ((1, 512), (10, 512), (60, 512))
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[Optional[float]], width: int = 64) -> str:
+    """Unicode sparkline of ``values`` (None renders as a gap).  Keeps
+    the newest ``width`` points; scaled min..max over the shown finite
+    points so shape, not magnitude, is what reads."""
+    vals = list(values)[-width:]
+    finite = [v for v in vals if v is not None and math.isfinite(v)]
+    if not finite:
+        return " " * len(vals)
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if v is None or not math.isfinite(v):
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK_BLOCKS[0])
+        else:
+            out.append(_SPARK_BLOCKS[int((v - lo) / span
+                                         * (len(_SPARK_BLOCKS) - 1))])
+    return "".join(out)
+
+
+def _finite_or_none(v) -> Optional[float]:
+    if v is None:
+        return None
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+class _Tier:
+    """One resolution's ring buffer plus the open aggregation window that
+    rolls ``every`` base samples up into one (t, mean) point."""
+
+    __slots__ = ("resolution", "every", "points", "_acc_sum", "_acc_n",
+                 "_seen")
+
+    def __init__(self, resolution: float, capacity: int, every: int):
+        self.resolution = resolution
+        self.every = max(1, int(every))
+        self.points: deque = deque(maxlen=capacity)
+        self._acc_sum = 0.0
+        self._acc_n = 0
+        self._seen = 0
+
+    def push(self, t: float, value: Optional[float]):
+        self._seen += 1
+        if value is not None:
+            self._acc_sum += value
+            self._acc_n += 1
+        if self._seen >= self.every:
+            mean = (self._acc_sum / self._acc_n) if self._acc_n else None
+            self.points.append((t, mean))
+            self._acc_sum, self._acc_n, self._seen = 0.0, 0, 0
+
+    def as_dict(self, window_seconds: Optional[float] = None,
+                now: Optional[float] = None) -> Dict[str, object]:
+        pts = list(self.points)
+        if window_seconds is not None and now is not None:
+            cut = now - window_seconds
+            pts = [p for p in pts if p[0] >= cut]
+        return {"resolution": self.resolution,
+                "points": [[round(t, 3), v] for t, v in pts]}
+
+
+class _Series:
+    __slots__ = ("metric", "stat", "labels", "kind", "tiers", "rate")
+
+    def __init__(self, metric, stat, labels, kind, tier_spec, interval):
+        self.metric = metric
+        self.stat = stat
+        self.labels = dict(labels)
+        self.kind = kind
+        self.tiers = [_Tier(interval * mult, cap, mult)
+                      for mult, cap in tier_spec]
+        self.rate = WindowedRate()  # drives counter / hist-count series
+
+    def push(self, t: float, value: Optional[float]):
+        for tier in self.tiers:
+            tier.push(t, value)
+
+
+def series_key(metric: str, stat: str, labelvalues: Dict[str, str]) -> str:
+    lbl = ",".join("%s=%s" % kv for kv in sorted(labelvalues.items()))
+    return "%s:%s{%s}" % (metric, stat, lbl) if lbl \
+        else "%s:%s" % (metric, stat)
+
+
+class TimeSeriesStore:
+    """Per-series multi-resolution rings over one :class:`MetricRegistry`.
+
+    ``sample_once`` is the whole data path: walk the registry, derive
+    each series' sample (rate / value / quantiles) with no lock of this
+    store held, then append under the store lock.
+    """
+
+    #: histogram quantile stats sampled per series.
+    QUANTILES = (("p50", 0.5), ("p99", 0.99))
+
+    def __init__(self, registry: MetricRegistry,
+                 interval: float = 1.0,
+                 tiers: Sequence[Tuple[int, int]] = DEFAULT_TIERS):
+        self.registry = registry
+        self.interval = float(interval)
+        self.tier_spec = tuple(tiers)
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+        # bound name carries "telemetry" so graftlint GL005 attributes
+        # these registrations to the metric registry contract
+        telemetry_registry = registry
+        self._m_samples = telemetry_registry.counter(
+            "timeseries_samples_total",
+            "registry sampling sweeps completed by the time-series store")
+        self._m_errors = telemetry_registry.counter(
+            "timeseries_sample_errors_total",
+            "sampling sweeps aborted by an unexpected error")
+        self._m_series = telemetry_registry.gauge(
+            "timeseries_series",
+            "distinct series currently held in the time-series rings")
+
+    # -- sampling ----------------------------------------------------------
+    def _samples_of(self, fam) -> List[Tuple[str, str, Dict[str, str],
+                                             object]]:
+        """(stat, key, labels, raw) rows for one family's children; raw
+        is ('counter', cumulative) for rate-derived series."""
+        rows = []
+        for labelvalues, data in fam.samples():
+            labels = dict(zip(fam.labelnames, labelvalues))
+            if isinstance(fam, Histogram):
+                child = fam.labels(**labels)
+                for stat, q in self.QUANTILES:
+                    rows.append((stat, series_key(fam.name, stat, labels),
+                                 labels, child.quantile(q)))
+                rows.append(("rate", series_key(fam.name, "rate", labels),
+                             labels, ("counter", float(data["count"]))))
+            elif fam.kind == "counter":
+                rows.append(("rate", series_key(fam.name, "rate", labels),
+                             labels, ("counter", float(data))))
+            else:  # gauge
+                rows.append(("value", series_key(fam.name, "value", labels),
+                             labels, float(data)))
+        return rows
+
+    def sample_once(self, now: Optional[float] = None) -> int:
+        """Sample every registered series once; returns the number of
+        series touched.  Safe to call concurrently with increments (the
+        family locks serialize reads) and with itself (store lock)."""
+        now = time.time() if now is None else float(now)
+        staged = []
+        for fam in self.registry.collect():
+            for stat, key, labels, raw in self._samples_of(fam):
+                staged.append((fam, stat, key, labels, raw))
+        n = 0
+        with self._lock:
+            for fam, stat, key, labels, raw in staged:
+                series = self._series.get(key)
+                if series is None:
+                    series = _Series(fam.name, stat, labels, fam.kind,
+                                     self.tier_spec, self.interval)
+                    self._series[key] = series
+                if isinstance(raw, tuple):   # cumulative counter -> rate
+                    value = series.rate.observe(raw[1], now)
+                else:
+                    value = _finite_or_none(raw)
+                series.push(now, value)
+                n += 1
+            n_series = len(self._series)
+        self._m_samples.inc()
+        self._m_series.set(n_series)
+        return n
+
+    # -- readers -----------------------------------------------------------
+    def snapshot(self, window_seconds: Optional[float] = None,
+                 prefix: Optional[str] = None,
+                 now: Optional[float] = None) -> Dict[str, dict]:
+        """JSON-able {series_key: {metric, stat, labels, kind, tiers}}.
+
+        ``window_seconds`` bounds each tier's points; ``prefix`` filters
+        by metric-name prefix."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            items = sorted(self._series.items())
+        out = {}
+        for key, s in items:
+            if prefix and not s.metric.startswith(prefix):
+                continue
+            out[key] = {
+                "metric": s.metric, "stat": s.stat, "labels": s.labels,
+                "kind": s.kind,
+                "tiers": [t.as_dict(window_seconds, now) for t in s.tiers],
+            }
+        return out
+
+    def trailing(self, window_seconds: float = 120.0,
+                 now: Optional[float] = None) -> Dict[str, object]:
+        """The flight-dump block: per series, the last ``window_seconds``
+        from the finest tier, extended backwards with coarser-tier points
+        when the fine ring alone does not reach the whole window (a
+        long-lived process's 1 s ring covers ~8.5 min; beyond that the
+        10 s / 60 s tiers carry the history)."""
+        now = time.time() if now is None else float(now)
+        cut = now - float(window_seconds)
+        with self._lock:
+            items = sorted(self._series.items())
+        series = {}
+        for key, s in items:
+            pts: List[Tuple[float, Optional[float]]] = []
+            for tier in s.tiers:           # finest first
+                tier_pts = [p for p in tier.points if p[0] >= cut]
+                if pts:
+                    oldest = pts[0][0]
+                    pts = [p for p in tier_pts if p[0] < oldest] + pts
+                else:
+                    pts = tier_pts
+                if pts and pts[0][0] <= cut + tier.resolution:
+                    break                  # window covered; stop coarsening
+            if pts:
+                series[key] = {"metric": s.metric, "stat": s.stat,
+                               "labels": s.labels,
+                               "points": [[round(t, 3), v] for t, v in pts]}
+        return {"window_seconds": float(window_seconds),
+                "interval": self.interval, "unix_time": now,
+                "series": series}
+
+    def clear(self):
+        """Test isolation: drop every ring (rate trackers included)."""
+        with self._lock:
+            self._series.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._series)
+
+
+def render_ascii(snap: Dict[str, dict], width: int = 64) -> str:
+    """Terminal rendering of a :meth:`TimeSeriesStore.snapshot`: one
+    sparkline per series from its finest tier, newest value annotated."""
+    lines = []
+    for key in sorted(snap):
+        tiers = snap[key].get("tiers") or []
+        pts = (tiers[0].get("points") or []) if tiers else []
+        vals = [p[1] for p in pts]
+        last = next((v for v in reversed(vals) if v is not None), None)
+        lines.append("%-56s %s  last=%s"
+                     % (key[:56], sparkline(vals, width),
+                        "-" if last is None else "%.6g" % last))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# sampler thread + module-level singleton
+# ---------------------------------------------------------------------------
+
+class _Sampler(threading.Thread):
+    """Daemon loop: one registry sweep per interval.  Sleeps on an Event
+    so stop() is immediate; a sweep that raises is counted and skipped
+    (telemetry must never take the process down)."""
+
+    def __init__(self, ts_store: TimeSeriesStore):
+        super().__init__(name="mxtpu-telemetry-ts", daemon=True)
+        self._store = ts_store
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.wait(self._store.interval):
+            try:
+                self._store.sample_once()
+            except Exception:
+                self._store._m_errors.inc()
+
+    def halt(self, timeout: float = 2.0):
+        self._stop_evt.set()
+        self.join(timeout)
+
+
+_store: Optional[TimeSeriesStore] = None
+_sampler: Optional[_Sampler] = None
+_state_lock = threading.Lock()
+
+
+def store() -> TimeSeriesStore:
+    """The module singleton over the default telemetry registry
+    (created on first use; no thread is started)."""
+    global _store
+    with _state_lock:
+        if _store is None:
+            from . import _registry
+            _store = TimeSeriesStore(
+                _registry,
+                interval=get_env("MXNET_TELEMETRY_TS_INTERVAL", 1.0, float))
+        return _store
+
+
+def start(interval: Optional[float] = None) -> TimeSeriesStore:
+    """Start (or return the already-running) background sampler over the
+    default registry.  Idempotent; called by ``telemetry.enable()``."""
+    global _sampler
+    s = store()
+    if interval is not None:
+        s.interval = float(interval)
+    with _state_lock:
+        if _sampler is not None and _sampler.is_alive():
+            return s
+        _sampler = _Sampler(s)
+        _sampler.start()
+        return s
+
+
+def stop():
+    """Stop the sampler thread (rings are kept; ``store().clear()`` drops
+    them).  Idempotent."""
+    global _sampler
+    with _state_lock:
+        sampler, _sampler = _sampler, None
+    if sampler is not None:
+        sampler.halt(2.0)
+
+
+def running() -> bool:
+    with _state_lock:
+        return _sampler is not None and _sampler.is_alive()
+
+
+def snapshot(window_seconds: Optional[float] = None,
+             prefix: Optional[str] = None) -> Dict[str, dict]:
+    return store().snapshot(window_seconds=window_seconds, prefix=prefix)
+
+
+def trailing(window_seconds: float = 120.0) -> Dict[str, object]:
+    return store().trailing(window_seconds=window_seconds)

@@ -392,6 +392,17 @@ class FlightRecorder:
                         doc["atlas"] = at
                 except Exception:
                     pass
+                # trailing metric history: the minutes *leading up to*
+                # the trip, not just the spans after it (empty until the
+                # time-series sampler has run at least once).
+                try:
+                    from .telemetry import timeseries as _ts
+                    win = get_env("MXNET_FLIGHT_TS_WINDOW", 120.0, float)
+                    tsdoc = _ts.trailing(window_seconds=win)
+                    if tsdoc.get("series"):
+                        doc["timeseries"] = tsdoc
+                except Exception:
+                    pass
                 path = self.path()
                 tmp = "%s.tmp.%d" % (path, os.getpid())
                 with open(tmp, "w") as f:

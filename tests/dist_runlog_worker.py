@@ -1,0 +1,62 @@
+"""dist_async worker for the run-ledger acceptance test: every process
+(2 workers + 1 server) auto-enables its own JSONL ledger via
+MXNET_RUNLOG_DIR at import, all sharing one MXNET_RUN_ID.  Each rank
+seeds synthetic step times (rank 1 is 20x slower, past the straggler
+band) so the workers write ``health_verdict`` transitions and the server
+writes ``straggler`` edge events; the test then merges the per-process
+files into one ordered timeline.
+
+Launched by tests/test_runlog.py via tools/launch.py with MXNET_HEALTH=1,
+MXNET_RUNLOG_DIR and MXNET_RUN_ID set.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import health, nd, runlog
+
+
+def main():
+    assert health.enabled, "worker must run with MXNET_HEALTH=1"
+    assert runlog.enabled(), "worker must run with MXNET_RUNLOG_DIR set"
+    # create() first: in a DMLC_ROLE=server process this enters the server
+    # loop and never returns
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+
+    step_s = 0.01 if rank == 0 else 0.2
+    kv.init("w", nd.zeros((4, 2)))
+    kv.barrier()
+    for step in range(5):
+        # synthetic closed window (see dist_health_worker.py): drives both
+        # the worker's own verdict ledger event and the wire piggyback the
+        # server's straggler table consumes
+        health.monitor.observe_step(step_s)
+        kv.push("w", nd.array(np.full((4, 2), rank + step, np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+    runlog.event("worker_done", steps=5, step_seconds=step_s)
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+    runlog.disable()                        # run_end + close
+    print("rank %d ledger=%s" % (rank, runlog.path() or "closed"))
+    if rank == 0:
+        # keep the launcher's worker-liveness window open so the server
+        # finishes its ledger shutdown events before cleanup kills it
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Durable run ledger (mxnet_tpu/runlog.py).
+
+Covers the JSONL line schema and per-process seq ordering, rotation,
+torn-line-tolerant merge, the env snapshot (step cache-key flags always
+present), write-failure accounting, the module-level enable/disable
+lifecycle, and the 2-worker dist_async acceptance run: every process
+writes its own ledger and the merge produces one ordered timeline with
+rank-attributed health verdicts and server-side straggler edges.
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import runlog, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    runlog.disable()
+    telemetry.reset()
+    yield
+    runlog.disable()
+    telemetry.reset()
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _counter_value(name, label=None):
+    fam = telemetry.registry().get(name)
+    for lv, v in (fam.samples() if fam is not None else []):
+        if label is None or lv == (label,):
+            return v
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# RunLog object
+# ---------------------------------------------------------------------------
+class TestRunLog:
+    def test_line_schema_and_seq(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path / "r.jsonl"), run_id="rid-1")
+        assert log.event("alpha", k=1)
+        assert log.event("beta", nested={"a": [1, 2]})
+        log.close()
+        recs = _lines(log.path)
+        assert [r["event"] for r in recs] == ["alpha", "beta"]
+        assert [r["seq"] for r in recs] == [0, 1]
+        for r in recs:
+            assert r["run_id"] == "rid-1"
+            assert isinstance(r["ts"], float)
+            assert r["role"] == "local" and r["rank"] == "0"
+        assert recs[1]["nested"] == {"a": [1, 2]}
+
+    def test_payload_cannot_mask_envelope(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path / "r.jsonl"), run_id="rid-2")
+        log.event("x", run_id="spoof", ts="spoof", seq="spoof")
+        log.close()
+        rec = _lines(log.path)[0]
+        assert rec["run_id"] == "rid-2"
+        assert isinstance(rec["ts"], float) and rec["seq"] == 0
+
+    def test_unserializable_payload_falls_back_to_str(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path / "r.jsonl"))
+        assert log.event("odd", obj=object()) is True
+        log.close()
+        assert "object object" in _lines(log.path)[0]["obj"]
+
+    def test_rotation(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        log = runlog.RunLog(p, max_bytes=1500)
+        n = 0
+        while not os.path.exists(p + ".1") and n < 200:
+            assert log.event("tick", i=n)
+            n += 1
+        log.close()
+        assert os.path.exists(p) and os.path.exists(p + ".1")
+        # stop right after the first rotation: no line lost across the
+        # boundary, seq stays monotonic through the rename
+        recs = runlog.merge([p + ".1", p])
+        assert [r["i"] for r in recs] == list(range(n))
+        assert [r["seq"] for r in recs] == list(range(n))
+
+    def test_write_failure_counts_drop(self, tmp_path):
+        d = tmp_path / "blocked"
+        d.mkdir()
+        log = runlog.RunLog(str(d))  # path is a directory: open() fails
+        before = _counter_value("runlog_write_errors_total")
+        assert log.event("doomed") is False
+        assert _counter_value("runlog_write_errors_total") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# env snapshot + module lifecycle
+# ---------------------------------------------------------------------------
+class TestModuleLifecycle:
+    def test_enable_writes_run_start_with_step_env_keys(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.delenv("MXNET_TPU_FUSED_STEP", raising=False)
+        p = str(tmp_path / "m.jsonl")
+        log = runlog.enable(p, run_id="rid-m")
+        assert runlog.enabled() and runlog.run_id() == "rid-m"
+        assert runlog.path() == p
+        assert runlog.enable("ignored") is log        # idempotent
+        runlog.event("custom", x=1)
+        runlog.disable()
+        assert not runlog.enabled() and runlog.event("late") is False
+        recs = _lines(p)
+        assert [r["event"] for r in recs] == ["run_start", "custom",
+                                              "run_end"]
+        env = recs[0]["env"]
+        # cache-key flags snapshotted even when unset: "unset" is a state
+        assert env["MXNET_TPU_FUSED_STEP"] == ""
+        assert recs[0]["pid"] == os.getpid()
+        assert isinstance(recs[0]["argv"], list)
+
+    def test_enable_without_path_or_env_is_noop(self, monkeypatch):
+        monkeypatch.delenv("MXNET_RUNLOG_PATH", raising=False)
+        monkeypatch.delenv("MXNET_RUNLOG_DIR", raising=False)
+        assert runlog.enable() is None
+        assert not runlog.enabled()
+
+    def test_default_path_from_dir(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("MXNET_RUNLOG_PATH", raising=False)
+        monkeypatch.setenv("MXNET_RUNLOG_DIR", str(tmp_path))
+        log = runlog.enable()
+        name = os.path.basename(log.path)
+        assert name == "runlog_local0_%d.jsonl" % os.getpid()
+        runlog.disable()
+
+    def test_events_counter_labelled_by_type(self, tmp_path):
+        runlog.enable(str(tmp_path / "m.jsonl"))
+        runlog.event("bench_result", value=1.0)
+        assert _counter_value("runlog_events_total", "run_start") == 1.0
+        assert _counter_value("runlog_events_total", "bench_result") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+class TestMerge:
+    def test_merge_orders_and_attributes_source(self, tmp_path):
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        la = runlog.RunLog(a, run_id="rid")
+        lb = runlog.RunLog(b, run_id="rid")
+        la.event("first")
+        time.sleep(0.01)
+        lb.event("second")
+        time.sleep(0.01)
+        la.event("third")
+        la.close(); lb.close()
+        recs = runlog.merge([a, b])
+        assert [r["event"] for r in recs] == ["first", "second", "third"]
+        assert [r["source"] for r in recs] == ["a.jsonl", "b.jsonl",
+                                               "a.jsonl"]
+
+    def test_merge_skips_torn_lines_and_missing_files(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        log = runlog.RunLog(p)
+        log.event("ok")
+        log.close()
+        with open(p, "a") as f:
+            f.write('{"ts": 1.0, "event": "torn')   # simulated power loss
+        recs = runlog.merge([p, str(tmp_path / "nope.jsonl")])
+        assert [r["event"] for r in recs] == ["ok"]
+
+    def test_merge_cli(self, tmp_path, capsys):
+        p = str(tmp_path / "c.jsonl")
+        log = runlog.RunLog(p, run_id="rid-cli")
+        log.event("one")
+        log.close()
+        assert runlog.main(["merge", p]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert json.loads(out[0])["event"] == "one"
+        assert runlog.main(["merge"]) == 2       # usage error
+
+
+# ---------------------------------------------------------------------------
+# 2-worker dist_async ledger acceptance run
+# ---------------------------------------------------------------------------
+class TestDistLedger:
+    def test_two_worker_merged_timeline(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import launch
+
+        ldir = str(tmp_path / "ledgers")
+        worker = os.path.join(REPO, "tests", "dist_runlog_worker.py")
+        rc = launch.launch_local(
+            2, [sys.executable, worker],
+            env_extra={"JAX_PLATFORMS": "cpu", "MXNET_TEST_PLATFORM": "cpu",
+                       "MXNET_HEALTH": "1",
+                       "MXNET_RUNLOG_DIR": ldir,
+                       "MXNET_RUN_ID": "dist-accept"},
+            num_servers=1)
+        assert rc == 0
+        # the server writes its shutdown events between serve_forever
+        # returning and launcher cleanup; give the race a moment
+        deadline = time.time() + 10
+        files = []
+        while time.time() < deadline:
+            files = sorted(os.listdir(ldir))
+            if len(files) == 3 and any("server" in f for f in files):
+                break
+            time.sleep(0.1)
+        assert len(files) == 3, files
+        roles = [f.split("_")[1] for f in files]
+        assert sorted(roles) == ["server0", "worker0", "worker1"]
+
+        recs = runlog.merge([os.path.join(ldir, f) for f in files])
+        assert all(r["run_id"] == "dist-accept" for r in recs)
+        # one ordered timeline: ts never decreases
+        ts = [r["ts"] for r in recs]
+        assert ts == sorted(ts)
+        # every process opened its ledger
+        starts = [r for r in recs if r["event"] == "run_start"]
+        assert len(starts) == 3
+        assert {(r["role"], r["rank"]) for r in starts} == {
+            ("server", "0"), ("worker", "0"), ("worker", "1")}
+        # rank-attributed health verdicts from BOTH workers
+        verdicts = [r for r in recs if r["event"] == "health_verdict"]
+        assert {r["rank"] for r in verdicts} == {"0", "1"}
+        assert all(r["role"] == "worker" for r in verdicts)
+        by_rank = {r["rank"]: r for r in verdicts}
+        assert by_rank["0"]["step_seconds"] == pytest.approx(0.01)
+        assert by_rank["1"]["step_seconds"] == pytest.approx(0.2)
+        # the server attributed rank 1 as the straggler (edge event)
+        edges = [r for r in recs if r["event"] == "straggler"]
+        assert edges and all(r["role"] == "server" for r in edges)
+        assert any(r["worker_rank"] == "1" and r["straggler"] is True
+                   for r in edges)
+        assert not any(r["worker_rank"] == "0" and r["straggler"] is True
+                       for r in edges)
+        # server shutdown wrote the final table + run_end
+        tables = [r for r in recs if r["event"] == "straggler_table"]
+        assert tables and tables[-1]["workers"]["1"]["straggler"] is True
+        assert [r for r in recs if r["event"] == "run_end"]
+        # both workers completed their synthetic phase
+        done = [r for r in recs if r["event"] == "worker_done"]
+        assert {r["rank"] for r in done} == {"0", "1"}

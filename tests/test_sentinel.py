@@ -1,0 +1,301 @@
+"""Perf regression sentinel (tools/sentinel.py).
+
+Covers the tolerance-band arithmetic (direction, relative vs absolute
+bands, zero-tolerance metrics, NEW/MISSING handling, worst-first
+ranking), every normalizer shape (driver wrapper, multichip, serving,
+run-ledger JSONL, canonical passthrough), round merging, the CLI
+(verdict table + exit code, --normalize, --update-baseline refusal and
+seeding, --smoke), and the end-to-end acceptance property: a ~20%
+injected throughput regression exits nonzero with a ranked table.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import sentinel
+
+
+def _base(**metrics):
+    m = {"resnet50_img_per_sec": 1000.0, "resnet50_step_spread_pct": 1.0}
+    m.update(metrics)
+    return {"round": "rB", "source": "base", "kind": "bench",
+            "metrics": m, "context": {}}
+
+
+def _cand(**metrics):
+    doc = _base(**metrics)
+    doc["source"] = "cand"
+    return doc
+
+
+def _row(rows, name):
+    return next(r for r in rows if r["metric"] == name)
+
+
+# ---------------------------------------------------------------------------
+# compare semantics
+# ---------------------------------------------------------------------------
+class TestCompare:
+    def test_identical_passes(self):
+        rows = sentinel.compare(_base(), _base())
+        assert all(r["verdict"] == "PASS" for r in rows)
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_twenty_pct_regression_fails_ranked_first(self):
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_img_per_sec=800.0))
+        assert rows[0]["metric"] == "resnet50_img_per_sec"
+        assert rows[0]["verdict"] == "FAIL"
+        assert rows[0]["delta_pct"] == pytest.approx(-20.0)
+        assert sentinel.verdict_exit(rows) == 1
+
+    def test_within_band_wobble_passes(self):
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_img_per_sec=970.0))
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_past_half_band_warns(self):
+        # band = 10% of 1000 -> 100; an 80-point drop is past half of it
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_img_per_sec=920.0))
+        r = _row(rows, "resnet50_img_per_sec")
+        assert r["verdict"] == "WARN"
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_improvement_always_passes(self):
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_img_per_sec=5000.0))
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_lower_is_better_absolute_slack(self):
+        # spread band is 3 absolute points, not relative: 1 -> 3.5 FAILs
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_step_spread_pct=4.5))
+        assert _row(rows, "resnet50_step_spread_pct")["verdict"] == "FAIL"
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_step_spread_pct=2.0))
+        assert _row(rows, "resnet50_step_spread_pct")["verdict"] == "PASS"
+        # and improvement (smaller spread) passes
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_step_spread_pct=0.1))
+        assert _row(rows, "resnet50_step_spread_pct")["verdict"] == "PASS"
+
+    def test_zero_tolerance_metric(self):
+        rows = sentinel.compare(_base(post_warmup_compiles=0.0),
+                                _cand(post_warmup_compiles=1.0))
+        r = _row(rows, "post_warmup_compiles")
+        assert r["verdict"] == "FAIL" and r["excess"] == float("inf")
+
+    def test_new_metric_is_informational(self):
+        rows = sentinel.compare(_base(), _cand(shiny_new_metric=5.0))
+        assert _row(rows, "shiny_new_metric")["verdict"] == "NEW"
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_missing_metric_warns_not_fails(self):
+        cand = _cand()
+        del cand["metrics"]["resnet50_step_spread_pct"]
+        rows = sentinel.compare(_base(), cand)
+        assert _row(rows, "resnet50_step_spread_pct")["verdict"] == "MISSING"
+        assert sentinel.verdict_exit(rows) == 0
+
+    def test_unknown_metric_gets_default_band(self):
+        assert sentinel.band_of("never_seen") == sentinel.DEFAULT_BAND
+        rows = sentinel.compare(_base(mystery=100.0), _cand(mystery=50.0))
+        assert _row(rows, "mystery")["verdict"] == "FAIL"  # -50% > 15%
+
+    def test_markdown_table(self):
+        rows = sentinel.compare(_base(),
+                                _cand(resnet50_img_per_sec=800.0))
+        md = sentinel.markdown_table(rows, _base(), _cand())
+        assert "**REGRESSION**" in md and "**FAIL**" in md
+        assert "| resnet50_img_per_sec (^) |" in md
+        md_ok = sentinel.markdown_table(sentinel.compare(_base(), _base()),
+                                        _base(), _base())
+        assert "**OK**" in md_ok
+
+    def test_merged_source_renders_joined(self):
+        merged = sentinel.merge_rounds([_base(), _cand()])
+        assert merged["source"] == ["base", "cand"]
+        md = sentinel.markdown_table([], _base(), merged)
+        assert "base+cand" in md
+
+
+# ---------------------------------------------------------------------------
+# normalizers
+# ---------------------------------------------------------------------------
+class TestNormalize:
+    def test_driver_wrapper(self, tmp_path):
+        doc = {"n": 9, "cmd": "python bench.py", "rc": 0, "tail": "",
+               "parsed": {"value": 2452.0, "mfu_pct": 30.6,
+                          "step_spread_pct": 0.7,
+                          "window_scaling_ratio": 1.99,
+                          "lstm": {"value": 460779.8, "mfu_pct": 39.8},
+                          "health": {"monitor_overhead_pct": 0.5,
+                                     "sampler_overhead_pct": 0.2},
+                          "atlas": {"a": {"coverage_pct": 98.0},
+                                    "b": {"coverage_pct": 91.0}}}}
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps(doc))
+        n = sentinel.normalize(str(p))
+        assert n["round"] == "r09" and n["kind"] == "bench"
+        m = n["metrics"]
+        assert m["resnet50_img_per_sec"] == 2452.0
+        assert m["lstm_tokens_per_sec"] == 460779.8
+        assert m["sampler_overhead_pct"] == 0.2
+        assert m["atlas_coverage_pct"] == 91.0       # worst program wins
+        assert "unvalidated" not in n["context"]
+
+    def test_unvalidated_record_flagged(self):
+        n = sentinel.normalize({"parsed": {"value": 70464.0}}, "BENCH_r01")
+        assert n["context"]["unvalidated"] is True
+
+    def test_lstm_error_block_skipped(self):
+        n = sentinel.normalize(
+            {"parsed": {"value": 1.0, "lstm": {"error": "oom"}}}, "r02")
+        assert "lstm_tokens_per_sec" not in n["metrics"]
+
+    def test_multichip(self):
+        n = sentinel.normalize({"value": 3.17, "scaling_efficiency": 0.11,
+                                "platform": "cpu-virtual", "n_devices": 8},
+                               "MULTICHIP_r06.json")
+        assert n["kind"] == "multichip"
+        assert n["metrics"]["multichip_img_per_sec"] == 3.17
+        assert n["metrics"]["multichip_scaling_efficiency"] == 0.11
+        assert n["context"]["platform"] == "cpu-virtual"
+
+    def test_serving(self):
+        n = sentinel.normalize({"p99_ms": 12.5, "throughput_rps": 800.0,
+                                "post_warmup_compiles": 0}, "serving.json")
+        assert n["kind"] == "serving"
+        assert n["metrics"]["serving_p99_ms"] == 12.5
+        assert n["metrics"]["post_warmup_compiles"] == 0.0
+
+    def test_canonical_passthrough(self):
+        n = sentinel.normalize(_base(), "x")
+        assert n["metrics"] == _base()["metrics"]
+
+    def test_unknown_shape_is_empty_not_fatal(self):
+        n = sentinel.normalize({"what": "ever"}, "junk.json")
+        assert n["kind"] == "unknown" and n["metrics"] == {}
+
+    def test_nonfinite_values_dropped(self):
+        n = sentinel.normalize({"parsed": {"value": float("nan"),
+                                           "mfu_pct": 30.0}}, "r03")
+        assert "resnet50_img_per_sec" not in n["metrics"]
+        assert n["metrics"]["resnet50_mfu_pct"] == 30.0
+
+    def test_ledger_extraction(self, tmp_path):
+        from mxnet_tpu import runlog
+        p = str(tmp_path / "ledger.jsonl")
+        log = runlog.RunLog(p, run_id="rid-s")
+        log.event("run_start", env={"MXNET_TPU_FUSED_STEP": "1"})
+        log.event("bench_result", metric="img/sec", value=2000.0,
+                  result={"value": 2000.0, "mfu_pct": 25.0,
+                          "window_scaling_ratio": 2.0})
+        log.event("healthz", status="degraded", post_warmup_compiles=2)
+        log.event("bench_result", metric="img/sec", value=2100.0,
+                  result={"value": 2100.0, "mfu_pct": 26.0,
+                          "window_scaling_ratio": 2.0})
+        log.close()
+        with open(p, "a") as f:
+            f.write('{"torn')                        # reader must survive
+        n = sentinel.normalize(p)
+        assert n["kind"] == "ledger"
+        assert n["metrics"]["resnet50_img_per_sec"] == 2100.0  # last wins
+        assert n["metrics"]["post_warmup_compiles"] == 2.0
+        assert n["context"]["run_id"] == "rid-s"
+        assert n["context"]["step_env"] == {"MXNET_TPU_FUSED_STEP": "1"}
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end
+# ---------------------------------------------------------------------------
+class TestCLI:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_regression_exits_nonzero_with_table(self, tmp_path):
+        b = self._write(tmp_path, "baseline.json", _base())
+        c = self._write(tmp_path, "cand.json",
+                        _cand(resnet50_img_per_sec=800.0))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "sentinel.py"),
+             "--baseline", b, "--candidate", c],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "**REGRESSION**" in proc.stdout
+        assert proc.stdout.index("resnet50_img_per_sec") \
+            < proc.stdout.index("resnet50_step_spread_pct")
+
+    def test_identical_exits_zero(self, tmp_path):
+        b = self._write(tmp_path, "baseline.json", _base())
+        c = self._write(tmp_path, "cand.json", _base())
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "sentinel.py"),
+             "--baseline", b, "--candidate", c, "--format", "json"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["regression"] is False
+
+    def test_update_baseline_refuses_on_fail(self, tmp_path):
+        b = self._write(tmp_path, "baseline.json", _base())
+        c = self._write(tmp_path, "cand.json",
+                        _cand(resnet50_img_per_sec=500.0))
+        rc = sentinel.main(["--baseline", b, "--candidate", c,
+                            "--update-baseline"])
+        assert rc == 1
+        assert json.load(open(b))["metrics"]["resnet50_img_per_sec"] \
+            == 1000.0                                 # untouched
+
+    def test_update_baseline_promotes_on_pass(self, tmp_path):
+        b = self._write(tmp_path, "baseline.json", _base())
+        c = self._write(tmp_path, "cand.json",
+                        _cand(resnet50_img_per_sec=1200.0))
+        assert sentinel.main(["--baseline", b, "--candidate", c,
+                              "--update-baseline"]) == 0
+        assert json.load(open(b))["metrics"]["resnet50_img_per_sec"] \
+            == 1200.0
+
+    def test_missing_baseline_seeds_with_flag(self, tmp_path):
+        b = str(tmp_path / "fresh" / "baseline.json")
+        c = self._write(tmp_path, "cand.json", _base())
+        assert sentinel.main(["--baseline", b, "--candidate", c]) == 2
+        assert sentinel.main(["--baseline", b, "--candidate", c,
+                              "--update-baseline"]) == 0
+        assert json.load(open(b))["metrics"]["resnet50_img_per_sec"] \
+            == 1000.0
+
+    def test_normalize_mode_writes_canonical(self, tmp_path):
+        self._write(tmp_path, "BENCH_r07.json",
+                    {"parsed": {"value": 5.0, "window_scaling_ratio": 2.0}})
+        out = tmp_path / "canon"
+        rc = sentinel.main(["--normalize", str(tmp_path / "BENCH_r07.json"),
+                            "-o", str(out)])
+        assert rc == 0
+        doc = json.load(open(out / "bench_r07.canonical.json"))
+        assert doc["metrics"]["resnet50_img_per_sec"] == 5.0
+
+    def test_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "sentinel.py"),
+             "--smoke"], capture_output=True, text=True, timeout=60,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec == {"probe": "sentinel", "ok": True}
+
+    def test_committed_baseline_is_valid(self):
+        # the repo ships a baseline; it must stay canonical and self-pass
+        assert os.path.exists(sentinel.DEFAULT_BASELINE)
+        doc = json.load(open(sentinel.DEFAULT_BASELINE))
+        assert doc["metrics"]
+        assert sentinel.verdict_exit(sentinel.compare(doc, doc)) == 0

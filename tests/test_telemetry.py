@@ -561,3 +561,54 @@ class TestEndToEnd:
 
         # acceptance: the exposition output parses line-by-line
         _assert_valid_prometheus(telemetry.prometheus_text())
+
+
+# ---------------------------------------------------------------------------
+# quantile overflow + the shared windowed-rate definition (PR 11)
+# ---------------------------------------------------------------------------
+class TestQuantileOverflow:
+    def test_overflow_bucket_returns_inf(self):
+        reg = MetricRegistry()
+        h = reg.histogram("ovf_seconds", "", buckets=(0.1, 1.0))
+        h.observe(50.0)                       # beyond the top finite bound
+        assert h.quantile(0.5) == float("inf")
+        assert h.quantile(0.99) == float("inf")
+
+    def test_tail_in_overflow_head_still_finite(self):
+        reg = MetricRegistry()
+        h = reg.histogram("tail_seconds", "", buckets=(0.1, 1.0))
+        for _ in range(99):
+            h.observe(0.05)
+        h.observe(50.0)
+        assert h.quantile(0.5) <= 0.1         # median still on scale
+        assert h.quantile(0.999) == float("inf")
+
+    def test_empty_returns_zero_not_inf(self):
+        reg = MetricRegistry()
+        h = reg.histogram("empty_seconds", "", buckets=(0.1, 1.0))
+        assert h.quantile(0.5) == 0.0
+
+
+class TestWindowedRate:
+    def test_first_observation_has_no_window(self):
+        r = telemetry.WindowedRate()
+        assert r.observe(10.0, now=100.0) is None
+
+    def test_steady_rate(self):
+        r = telemetry.WindowedRate()
+        r.observe(0.0, now=100.0)
+        assert r.observe(50.0, now=110.0) == pytest.approx(5.0)
+        assert r.observe(50.0, now=111.0) == pytest.approx(0.0)
+
+    def test_counter_reset_reports_zero_not_negative(self):
+        r = telemetry.WindowedRate()
+        r.observe(1000.0, now=100.0)
+        assert r.observe(3.0, now=101.0) == 0.0       # reset, not -997/s
+        # and the window restarts from the post-reset value
+        assert r.observe(13.0, now=102.0) == pytest.approx(10.0)
+
+    def test_zero_length_window_returns_none(self):
+        r = telemetry.WindowedRate()
+        r.observe(1.0, now=100.0)
+        assert r.observe(2.0, now=100.0) is None
+        assert r.observe(2.0, now=99.0) is None       # clock went backwards

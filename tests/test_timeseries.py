@@ -1,0 +1,318 @@
+"""Time-series telemetry (mxnet_tpu/telemetry/timeseries.py).
+
+Covers tier rollup arithmetic (driven with a fake clock — no sleeping),
+counter->rate derivation through the shared WindowedRate, histogram
+p50/p99 sampling with the +Inf overflow stored as null, the trailing
+window a flight dump embeds (fine tier extended backwards by coarser
+tiers), sparkline/ASCII rendering, the /timeseriesz endpoint, the
+sampler thread lifecycle, and the no-jax-in-the-sample-path guarantee.
+"""
+import json
+import math
+import os
+import sys
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import telemetry, tracing
+from mxnet_tpu.telemetry import timeseries
+from mxnet_tpu.telemetry.registry import MetricRegistry
+from mxnet_tpu.telemetry.timeseries import (TimeSeriesStore, render_ascii,
+                                            series_key, sparkline)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    timeseries.stop()
+    telemetry.reset()
+    timeseries.store().clear()
+    yield
+    telemetry.disable()
+    timeseries.stop()
+    telemetry.reset()
+    timeseries.store().clear()
+
+
+def _fresh(interval=1.0, tiers=((1, 8), (4, 8))):
+    """A store over its own registry: small tiers keep tests readable."""
+    reg = MetricRegistry()
+    return reg, TimeSeriesStore(reg, interval=interval, tiers=tiers)
+
+
+# ---------------------------------------------------------------------------
+# sparkline / key / rendering
+# ---------------------------------------------------------------------------
+class TestRendering:
+    def test_sparkline_shape(self):
+        s = sparkline([0.0, 1.0, 2.0, 3.0])
+        assert s[0] == "▁" and s[-1] == "█" and len(s) == 4
+
+    def test_sparkline_gaps_and_nonfinite(self):
+        assert sparkline([1.0, None, 2.0]) == "▁ █"
+        assert sparkline([1.0, float("inf"), 2.0]) == "▁ █"
+
+    def test_sparkline_constant_and_empty(self):
+        assert sparkline([5.0, 5.0]) == "▁▁"
+        assert sparkline([]) == ""
+        assert sparkline([None, None]) == "  "
+
+    def test_sparkline_width_keeps_newest(self):
+        assert sparkline([9.0] + [0.0, 1.0], width=2) == sparkline([0.0, 1.0])
+
+    def test_series_key(self):
+        assert series_key("m", "rate", {}) == "m:rate"
+        assert series_key("m", "p50", {"b": "2", "a": "1"}) \
+            == "m:p50{a=1,b=2}"
+
+    def test_render_ascii(self):
+        reg, st = _fresh()
+        g = reg.gauge("depth", "")
+        for i in range(4):
+            g.set(float(i))
+            st.sample_once(now=100.0 + i)
+        txt = render_ascii(st.snapshot())
+        line = [ln for ln in txt.splitlines() if "depth:value" in ln][0]
+        assert "▁" in line and "█" in line and "last=3" in line
+
+
+# ---------------------------------------------------------------------------
+# tier rollup + sampling semantics (fake clock throughout)
+# ---------------------------------------------------------------------------
+class TestStore:
+    def test_gauge_tier_rollup(self):
+        reg, st = _fresh(tiers=((1, 8), (4, 8)))
+        g = reg.gauge("q", "")
+        for i in range(8):
+            g.set(float(i))
+            st.sample_once(now=100.0 + i)
+        snap = st.snapshot()["q:value"]
+        fine, coarse = snap["tiers"]
+        assert fine["resolution"] == 1.0 and coarse["resolution"] == 4.0
+        assert [p[1] for p in fine["points"]] == [float(i) for i in range(8)]
+        # coarse points are the means of each 4-sample window
+        assert [p[1] for p in coarse["points"]] == [1.5, 5.5]
+        assert snap["kind"] == "gauge" and snap["stat"] == "value"
+
+    def test_ring_capacity_evicts_oldest(self):
+        reg, st = _fresh(tiers=((1, 4),))
+        g = reg.gauge("q", "")
+        for i in range(10):
+            g.set(float(i))
+            st.sample_once(now=100.0 + i)
+        pts = st.snapshot()["q:value"]["tiers"][0]["points"]
+        assert [p[1] for p in pts] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_counter_becomes_rate(self):
+        reg, st = _fresh()
+        c = reg.counter("ops_total", "")
+        c.inc(0)                           # materialize the child
+        st.sample_once(now=100.0)          # first observation: no window yet
+        c.inc(50)
+        st.sample_once(now=110.0)          # 50 ops / 10 s
+        pts = st.snapshot()["ops_total:rate"]["tiers"][0]["points"]
+        assert pts[0][1] is None
+        assert pts[1][1] == pytest.approx(5.0)
+
+    def test_labelled_counter_per_child_series(self):
+        reg, st = _fresh()
+        c = reg.counter("ev_total", "", labelnames=("kind",))
+        c.labels(kind="a").inc()
+        c.labels(kind="b").inc(3)
+        st.sample_once(now=100.0)
+        c.labels(kind="a").inc(2)
+        st.sample_once(now=101.0)
+        snap = st.snapshot()
+        assert snap["ev_total:rate{kind=a}"]["labels"] == {"kind": "a"}
+        a = snap["ev_total:rate{kind=a}"]["tiers"][0]["points"]
+        b = snap["ev_total:rate{kind=b}"]["tiers"][0]["points"]
+        assert a[-1][1] == pytest.approx(2.0)
+        assert b[-1][1] == pytest.approx(0.0)
+
+    def test_histogram_quantiles_and_count_rate(self):
+        reg, st = _fresh()
+        h = reg.histogram("lat", "", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        st.sample_once(now=100.0)
+        h.observe(0.5)
+        st.sample_once(now=101.0)
+        snap = st.snapshot()
+        p50 = snap["lat:p50"]["tiers"][0]["points"]
+        # interpolated within the (0.1, 1.0] bucket: 0.1 + 1.5/3 * 0.9
+        assert p50[-1][1] == pytest.approx(0.55)
+        rate = snap["lat:rate"]["tiers"][0]["points"]
+        assert rate[-1][1] == pytest.approx(1.0)   # 1 obs in 1 s
+        assert snap["lat:p99"]["kind"] == "histogram"
+
+    def test_overflow_quantile_stored_as_null(self):
+        reg, st = _fresh()
+        h = reg.histogram("lat", "", buckets=(0.1, 1.0))
+        h.observe(99.0)                             # lands in +Inf bucket
+        st.sample_once(now=100.0)
+        p99 = st.snapshot()["lat:p99"]["tiers"][0]["points"]
+        assert p99[-1][1] is None
+        # and the whole snapshot stays strict-JSON serializable
+        assert "Infinity" not in json.dumps(st.snapshot())
+
+    def test_nonfinite_gauge_stored_as_null(self):
+        reg, st = _fresh()
+        g = reg.gauge("ratio", "")
+        g.set(float("nan"))
+        st.sample_once(now=100.0)
+        g.set(2.0)
+        st.sample_once(now=101.0)
+        pts = st.snapshot()["ratio:value"]["tiers"][0]["points"]
+        assert pts[0][1] is None and pts[1][1] == 2.0
+
+    def test_snapshot_window_and_prefix_filter(self):
+        reg, st = _fresh()
+        reg.gauge("a_g", "").set(1.0)
+        reg.gauge("b_g", "").set(2.0)
+        for i in range(5):
+            st.sample_once(now=100.0 + i)
+        snap = st.snapshot(prefix="a_")
+        assert set(snap) == {"a_g:value"}
+        snap = st.snapshot(window_seconds=2.0, now=104.0)
+        assert len(snap["b_g:value"]["tiers"][0]["points"]) == 3  # t>=102
+
+    def test_self_metrics_registered(self):
+        reg, st = _fresh()
+        st.sample_once(now=100.0)
+        assert reg.get("timeseries_samples_total").samples()[0][1] == 1.0
+        st.sample_once(now=101.0)
+        assert reg.get("timeseries_series").samples()[0][1] == len(st)
+
+    def test_clear_and_len(self):
+        reg, st = _fresh()
+        reg.gauge("g", "").set(1.0)
+        st.sample_once(now=100.0)
+        assert len(st) > 0
+        st.clear()
+        assert len(st) == 0
+
+
+# ---------------------------------------------------------------------------
+# trailing window (the flight-dump block)
+# ---------------------------------------------------------------------------
+class TestTrailing:
+    def test_trailing_covers_window_from_fine_tier(self):
+        reg, st = _fresh(tiers=((1, 512), (10, 512)))
+        g = reg.gauge("g", "")
+        for i in range(130):
+            g.set(float(i))
+            st.sample_once(now=1000.0 + i)
+        doc = st.trailing(window_seconds=60.0, now=1000.0 + 129)
+        pts = doc["series"]["g:value"]["points"]
+        assert len(pts) >= 60          # >= 60 s of 1 s-resolution history
+        assert pts[-1][1] == 129.0
+        assert doc["window_seconds"] == 60.0 and doc["interval"] == 1.0
+
+    def test_trailing_extends_with_coarse_tier(self):
+        # fine ring only holds 8 points; the 120 s window must be carried
+        # by the coarse tier behind it
+        reg, st = _fresh(tiers=((1, 8), (10, 64)))
+        g = reg.gauge("g", "")
+        for i in range(100):
+            g.set(float(i))
+            st.sample_once(now=1000.0 + i)
+        pts = st.trailing(window_seconds=90.0,
+                          now=1000.0 + 99)["series"]["g:value"]["points"]
+        ts = [p[0] for p in pts]
+        assert ts == sorted(ts)
+        assert ts[0] <= 1000.0 + 99 - 80   # reaches well past the fine ring
+        assert pts[-1][1] == 99.0          # newest point is fine-tier exact
+        assert min(ts) >= 1000.0 + 99 - 90 - 10  # but bounded by the window
+
+    def test_trailing_empty_store(self):
+        _, st = _fresh()
+        assert st.trailing(window_seconds=60.0, now=100.0)["series"] == {}
+
+
+# ---------------------------------------------------------------------------
+# sampler thread + module singleton + endpoint
+# ---------------------------------------------------------------------------
+class TestSamplerLifecycle:
+    def test_start_stop_idempotent(self):
+        st = timeseries.start(interval=0.05)
+        assert timeseries.running()
+        assert timeseries.start() is st     # second start: same store
+        import threading
+        names = [t.name for t in threading.enumerate()]
+        assert names.count("mxtpu-telemetry-ts") == 1
+        timeseries.stop()
+        timeseries.stop()                   # idempotent
+        assert not timeseries.running()
+
+    def test_sampler_actually_samples(self):
+        telemetry.gauge("live_g", "").set(7.0)
+        timeseries.start(interval=0.02)
+        deadline = 100
+        while "live_g:value" not in timeseries.snapshot() and deadline:
+            import time
+            time.sleep(0.02)
+            deadline -= 1
+        assert "live_g:value" in timeseries.snapshot()
+        timeseries.stop()
+
+    def test_enable_env_gate(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY_TS", "0")
+        telemetry.enable()
+        assert not timeseries.running()
+        monkeypatch.setenv("MXNET_TELEMETRY_TS", "1")
+        telemetry.enable()
+        assert timeseries.running()
+        telemetry.disable()
+        assert not timeseries.running()
+
+    def test_no_jax_in_sample_path(self):
+        # the zero-extra-XLA-compiles property is structural: the sampler
+        # is pure host arithmetic and must never grow a jax import
+        src = open(timeseries.__file__.rstrip("c")).read()
+        assert "import jax" not in src and "from jax" not in src
+        assert "jax" not in dir(timeseries)
+
+    def test_timeseriesz_endpoint(self):
+        telemetry.gauge("srv_g", "").set(3.0)
+        timeseries.store().sample_once()
+        port = telemetry.start_http_server(port=0)
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                "http://127.0.0.1:%d/timeseriesz" % port, timeout=5).read())
+            assert doc["running"] is False
+            assert doc["interval"] == timeseries.store().interval
+            assert "srv_g:value" in doc["series"]
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/timeseriesz?format=ascii&prefix=srv_"
+                % port, timeout=5).read().decode()
+            assert "srv_g:value" in body and "last=3" in body
+            doc = json.loads(urllib.request.urlopen(
+                "http://127.0.0.1:%d/timeseriesz?prefix=nomatch" % port,
+                timeout=5).read())
+            assert doc["series"] == {}
+        finally:
+            telemetry.stop_http_server()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder integration
+# ---------------------------------------------------------------------------
+class TestFlightDump:
+    def test_dump_embeds_trailing_window(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH",
+                           str(tmp_path / "flight.json"))
+        telemetry.gauge("fd_g", "").set(1.25)
+        timeseries.store().sample_once()
+        path = tracing.flight.dump(reason="test_ts_embed")
+        doc = json.load(open(path))
+        assert "timeseries" in doc
+        assert doc["timeseries"]["window_seconds"] >= 60.0
+        assert "fd_g:value" in doc["timeseries"]["series"]
+        # the embedded block passes the merge_traces schema check
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import merge_traces
+        assert merge_traces.is_flight_dump(doc)
+        assert merge_traces.validate_flight_dump(doc) == []

@@ -344,6 +344,59 @@ class TestDistTraceMerge:
         assert merge_traces.main(["--validate", bad_path]) == 1
 
 
+class TestValidateFlightDump:
+    """--validate also schema-checks flight-recorder dumps (PR 11)."""
+
+    def _dump(self):
+        return {"reason": "test", "role": "local", "rank": "0",
+                "unix_time": 1000.0, "pid": 1, "t0_unix_us": 0.0,
+                "events": [{"name": "op", "ts_us": 1.0, "dur_us": 2.0,
+                            "cat": "engine", "tid": 7, "args": None}],
+                "programs": {"step": {"flops": 1e9, "arg_bytes": 8.0,
+                                      "out_bytes": 8.0, "env": None}},
+                "atlas": {"step": {"coverage_pct": 97.0,
+                                   "scopes": [{"scope": "dense",
+                                               "flops": 5e8}]}},
+                "timeseries": {"window_seconds": 120.0, "interval": 1.0,
+                               "series": {"g:value": {
+                                   "metric": "g", "stat": "value",
+                                   "labels": {},
+                                   "points": [[999.0, 1.0],
+                                              [1000.0, None]]}}}}
+
+    def test_dispatch_and_clean_dump(self, tmp_path):
+        doc = self._dump()
+        assert merge_traces.is_flight_dump(doc)
+        assert not merge_traces.is_flight_dump({"traceEvents": []})
+        assert merge_traces.validate_flight_dump(doc) == []
+        p = str(tmp_path / "flight.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        assert merge_traces.main(["--validate", p]) == 0
+
+    def test_blocks_are_optional(self):
+        doc = self._dump()
+        for block in ("programs", "atlas", "timeseries"):
+            del doc[block]
+        assert merge_traces.validate_flight_dump(doc) == []
+
+    def test_corrupted_blocks_reported_precisely(self, tmp_path):
+        doc = self._dump()
+        doc["programs"]["step"]["flops"] = "many"
+        doc["atlas"]["step"]["scopes"][0]["flops"] = None
+        doc["timeseries"]["series"]["g:value"]["points"][0] = [1.0]
+        doc["events"][0].pop("dur_us")
+        errs = merge_traces.validate_flight_dump(doc)
+        assert any("programs[step]" in e and "flops" in e for e in errs)
+        assert any("atlas[step].scopes[0]" in e for e in errs)
+        assert any("timeseries[g:value].points[0]" in e for e in errs)
+        assert any("events[0]" in e and "dur_us" in e for e in errs)
+        p = str(tmp_path / "bad_flight.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        assert merge_traces.main(["--validate", p]) == 1
+
+
 # ---------------------------------------------------------------------------
 # compile observability
 # ---------------------------------------------------------------------------

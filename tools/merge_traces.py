@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Merge per-process Chrome traces from a dist run into one clock-aligned
-trace, or schema-check trace files (``--validate``).
+trace, or schema-check trace files (``--validate``).  Validate mode also
+recognizes flight-recorder dumps (``reason`` + ``events``) and checks
+their ``programs`` / ``atlas`` / ``timeseries`` post-mortem blocks.
 
 Each process of a ``dist_async`` run under ``MXNET_TRACING=1`` +
 ``MXNET_TRACE_DIR=<dir>`` dumps its own ``trace_worker<r>.json`` /
@@ -93,6 +95,110 @@ def validate_trace(trace):
     return errors
 
 
+def is_flight_dump(doc):
+    """A FlightRecorder dump (tracing.FlightRecorder.dump), not a Chrome
+    trace: ring events plus post-mortem blocks."""
+    return isinstance(doc, dict) and "reason" in doc and "events" in doc \
+        and "traceEvents" not in doc
+
+
+def validate_flight_dump(doc):
+    """Schema-check one flight-recorder dump; returns error strings.
+
+    Covers the ring events and every post-mortem block the recorder has
+    grown since PR 3: ``programs`` (health cost records), ``atlas``
+    (per-scope attribution tables) and ``timeseries`` (the trailing
+    metric window) — so a merged multi-process dump set fails loudly on
+    a malformed block instead of silently dropping evidence."""
+    errors = []
+    if not isinstance(doc.get("events"), list):
+        errors.append("events missing or not a list")
+    else:
+        for i, e in enumerate(doc["events"]):
+            if not isinstance(e, dict):
+                errors.append("events[%d]: not an object" % i)
+                continue
+            if not isinstance(e.get("name"), str) or not e["name"]:
+                errors.append("events[%d]: missing name" % i)
+            for k in ("ts_us", "dur_us"):
+                if not isinstance(e.get(k), (int, float)):
+                    errors.append("events[%d]: missing numeric %s" % (i, k))
+    for k in ("reason", "role"):
+        if not isinstance(doc.get(k), str):
+            errors.append("%s missing or not a string" % k)
+    if not isinstance(doc.get("unix_time"), (int, float)):
+        errors.append("unix_time missing or not numeric")
+
+    progs = doc.get("programs")
+    if progs is not None:
+        if not isinstance(progs, dict):
+            errors.append("programs: not an object")
+        else:
+            for name, pc in progs.items():
+                if not isinstance(pc, dict):
+                    errors.append("programs[%s]: not an object" % name)
+                    continue
+                for k in ("flops", "arg_bytes", "out_bytes"):
+                    if not isinstance(pc.get(k), (int, float)):
+                        errors.append("programs[%s]: missing numeric %s"
+                                      % (name, k))
+                if pc.get("env") is not None \
+                        and not isinstance(pc["env"], dict):
+                    errors.append("programs[%s]: env not an object" % name)
+
+    atlas = doc.get("atlas")
+    if atlas is not None:
+        if not isinstance(atlas, dict):
+            errors.append("atlas: not an object")
+        else:
+            for name, a in atlas.items():
+                if not isinstance(a, dict):
+                    errors.append("atlas[%s]: not an object" % name)
+                    continue
+                if not isinstance(a.get("coverage_pct"), (int, float)):
+                    errors.append("atlas[%s]: missing numeric coverage_pct"
+                                  % name)
+                if not isinstance(a.get("scopes"), list):
+                    errors.append("atlas[%s]: scopes not a list" % name)
+                else:
+                    for j, row in enumerate(a["scopes"]):
+                        if not isinstance(row, dict) or \
+                                not isinstance(row.get("flops"),
+                                               (int, float)):
+                            errors.append(
+                                "atlas[%s].scopes[%d]: bad row"
+                                % (name, j))
+
+    ts = doc.get("timeseries")
+    if ts is not None:
+        if not isinstance(ts, dict):
+            errors.append("timeseries: not an object")
+        else:
+            if not isinstance(ts.get("window_seconds"), (int, float)):
+                errors.append("timeseries: missing numeric window_seconds")
+            series = ts.get("series")
+            if not isinstance(series, dict):
+                errors.append("timeseries: series not an object")
+            else:
+                for key, s in series.items():
+                    pts = s.get("points") if isinstance(s, dict) else None
+                    if not isinstance(pts, list):
+                        errors.append("timeseries[%s]: points not a list"
+                                      % key)
+                        continue
+                    for j, p in enumerate(pts):
+                        if (not isinstance(p, list) or len(p) != 2
+                                or not isinstance(p[0], (int, float))
+                                or not (p[1] is None
+                                        or isinstance(p[1],
+                                                      (int, float)))):
+                            errors.append(
+                                "timeseries[%s].points[%d]: expected "
+                                "[t, value|null]" % (key, j))
+                            break
+    return errors
+
+
 def merge(traces):
     """Merge loaded per-process traces into one Chrome trace dict."""
     bases = []
@@ -141,7 +247,9 @@ def main(argv=None):
         ok = True
         for path in args.inputs:
             try:
-                errs = validate_trace(load_trace(path))
+                doc = load_trace(path)
+                errs = (validate_flight_dump(doc) if is_flight_dump(doc)
+                        else validate_trace(doc))
             except (OSError, ValueError) as e:
                 errs = ["unreadable: %s" % e]
             for err in errs:
