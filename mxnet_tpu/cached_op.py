@@ -23,6 +23,8 @@ import numpy as np
 from .base import MXNetError
 from . import autograd as _autograd
 from . import random as _random
+from . import telemetry as _telemetry
+from . import program_cache as _program_cache
 from .executor import _Plan
 
 __all__ = ["CachedOp"]
@@ -60,6 +62,7 @@ class CachedOp:
         plan = self._plan(train)
         key = ("fwd", train) + self._plan_env(plan)
         if key not in self._jitted:
+            _program_cache.ensure_enabled()
             arg_names, aux_names = plan.arg_names, plan.aux_names
 
             def fn(arg_list, aux_list, keys):
@@ -69,6 +72,8 @@ class CachedOp:
                 return outs, [new_aux[n] for n in aux_names]
 
             self._jitted[key] = jax.jit(fn)
+        elif _telemetry.enabled:
+            _program_cache.note_memory_hit()
         return self._jitted[key]
 
     def _bwd(self, train: bool, diff_idx: Tuple[int, ...]):
@@ -76,6 +81,7 @@ class CachedOp:
         plan = self._plan(train)
         key = ("bwd", train, diff_idx) + self._plan_env(plan)
         if key not in self._jitted:
+            _program_cache.ensure_enabled()
             arg_names, aux_names = plan.arg_names, plan.aux_names
             diff_names = [arg_names[i] for i in diff_idx]
 
@@ -98,6 +104,8 @@ class CachedOp:
                 return list(vjp(cots))
 
             self._jitted[key] = jax.jit(fn)
+        elif _telemetry.enabled:
+            _program_cache.note_memory_hit()
         return self._jitted[key]
 
     def __call__(self, *args):

@@ -27,6 +27,7 @@ from . import atlas as _atlas
 from . import random as _random
 from . import telemetry as _telemetry
 from . import health as _health
+from . import program_cache as _program_cache
 
 __all__ = ["Executor"]
 
@@ -356,6 +357,7 @@ class Executor:
         """Cached bulked segments for a placed plan (engine bulking)."""
         key = ("segs", id(plan)) + self._plan_env_of(plan)
         if key not in self._jitted:
+            _program_cache.ensure_enabled()
             self._jitted[key] = plan.build_segments(
                 placements, self._ctx.jax_device)
         return self._jitted[key]
@@ -363,6 +365,7 @@ class Executor:
     def _fwd_fn(self, train: bool):
         key = ("fwd", train) + self._plan_env(train)
         if key not in self._jitted:
+            _program_cache.ensure_enabled()
             plan = self._plan(train)
             arg_names, aux_names = plan.arg_names, plan.aux_names
             placements = self._placements(plan)
@@ -388,12 +391,15 @@ class Executor:
                     return outs, [new_aux[n] for n in aux_names]
 
                 self._jitted[key] = jax.jit(fn)
+        elif _telemetry.enabled:
+            _program_cache.note_memory_hit()
         return self._jitted[key]
 
     def _fwd_bwd_fn(self):
         """Single compiled program: forward + vjp-backward (+aux update)."""
         key = ("fwdbwd",) + self._plan_env(True)
         if key not in self._jitted:
+            _program_cache.ensure_enabled()
             plan = self._plan(True)
             arg_names, aux_names = plan.arg_names, plan.aux_names
             grad_args = self._grad_args
@@ -426,6 +432,8 @@ class Executor:
                 return outs, new_aux, list(grads)
 
             self._jitted[key] = fn if placements else jax.jit(fn)
+        elif _telemetry.enabled:
+            _program_cache.note_memory_hit()
         return self._jitted[key]
 
     def _step_env(self):
@@ -493,7 +501,10 @@ class Executor:
         key = self._step_key(mesh_sig)
         fn = self._jitted.get(key)
         if fn is not None:
+            if _telemetry.enabled:
+                _program_cache.note_memory_hit()
             return fn
+        _program_cache.ensure_enabled()
         plan = self._plan(True)
         arg_names, aux_names = plan.arg_names, plan.aux_names
         pnames = tuple(pnames)
@@ -538,8 +549,11 @@ class Executor:
         key = self._update_key()
         fn = self._jitted.get(key)
         if fn is None:
+            _program_cache.ensure_enabled()
             fn = build_update_program(update_fns)
             self._jitted[key] = fn
+        elif _telemetry.enabled:
+            _program_cache.note_memory_hit()
         return fn
 
     def _gather(self):

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import MXNetError
+from . import program_cache as _program_cache
 
 __all__ = ["FusedTrainer"]
 
@@ -125,6 +126,8 @@ class FusedTrainer:
         # (optimizer.py wd_mult convention — biases/betas are exempt)
         wd_mult = {n: (1.0 if n.endswith(("_weight", "_gamma")) else 0.0)
                    for n in self._arg_names}
+
+        _program_cache.ensure_enabled()
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def _step(args, auxs, moms, data, labels, lr, keys):

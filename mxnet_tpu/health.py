@@ -630,6 +630,7 @@ def statusz():
     """JSON-able health snapshot served by telemetry/export.py."""
     plat = _platform()
     dtype = monitor.dtype or ("bfloat16" if plat == "tpu" else "float32")
+    from . import program_cache as _program_cache
     return {
         "enabled": enabled,
         "platform": plat,
@@ -638,6 +639,7 @@ def statusz():
         "programs": {n: pc.as_dict() for n, pc in programs().items()},
         "step": monitor.snapshot(),
         "workers": workers.snapshot(),
+        "program_cache": _program_cache.stats(),
     }
 
 

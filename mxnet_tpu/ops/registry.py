@@ -35,6 +35,7 @@ from ..base import AttrDict, MXNetError
 from .. import atlas as _atlas
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from .. import program_cache as _program_cache
 
 __all__ = ["Operator", "register", "get_op", "list_ops", "apply_op",
            "param", "OPS"]
@@ -215,9 +216,11 @@ class Operator:
         if c is not None:
             if _telemetry.enabled:
                 _JIT_HITS.labels(op=self.name).inc()
+                _program_cache.note_memory_hit()
             return c
         if _telemetry.enabled:
             _JIT_MISSES.labels(op=self.name).inc()
+        _program_cache.ensure_enabled()
         fn = self.fn
         # Scope choke point: per-op jitted programs carry an anonymous
         # atlas scope ("<OpType>:~" — no graph node here) so single-op
@@ -234,6 +237,7 @@ class Operator:
         def _first_call(*arrays):
             begin = _profiler._now_us()
             t0 = time.perf_counter()
+            puts0 = _program_cache.put_count()
             try:
                 return jfn(*arrays)
             finally:
@@ -241,8 +245,16 @@ class Operator:
                 if _telemetry.enabled:
                     _COMPILE_TIME.labels(op=name).observe(
                         time.perf_counter() - t0)
-                _profiler.record_span("XLA::Compile %s" % name, begin,
-                                      _profiler._now_us(), "compile")
+                # warm restart visibility: when the persistent program
+                # cache served every module this call needed (no put),
+                # the span is a restore, not a compile — zero
+                # XLA::Compile spans is the deploy-prefill contract
+                restored = (puts0 is not None
+                            and _program_cache.put_count() == puts0)
+                _profiler.record_span(
+                    "XLA::%s %s" % ("Restore" if restored else "Compile",
+                                    name),
+                    begin, _profiler._now_us(), "compile")
 
         self._jit_cache[key] = _first_call
         if _telemetry.enabled:

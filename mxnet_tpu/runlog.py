@@ -75,6 +75,9 @@ def _env_snapshot() -> Dict[str, str]:
         # executor may not be importable yet (ledger enabled during
         # package init); fall back to the known cache-key flags.
         keys = ("MXNET_TPU_FUSED_STEP", "MXNET_TPU_MESH_STEP")
+    # program-cache location/size join for the same reason: a warm deploy
+    # and a cold one differ ONLY in these (plus the artifacts on disk)
+    keys = keys + ("MXNET_PROGRAM_CACHE_DIR", "MXNET_PROGRAM_CACHE_MAX_BYTES")
     for k in keys:
         snap.setdefault(k, os.environ.get(k, ""))
     return snap
@@ -202,11 +205,17 @@ def enable(path: Optional[str] = None,
         _log = RunLog(p, run_id=run_id)
         _topology_noted = False
         log = _log
+    # cache identity without forcing jax backend init: dir comes from the
+    # env; the fingerprint is known only once program_cache.enable() ran
+    # (which then also logs a full "program_cache_start" event)
+    from . import program_cache as _program_cache
     log.event("run_start",
               argv=list(sys.argv),
               env=_env_snapshot(),
               python="%d.%d.%d" % sys.version_info[:3],
-              pid=os.getpid())
+              pid=os.getpid(),
+              program_cache_dir=os.environ.get("MXNET_PROGRAM_CACHE_DIR"),
+              program_cache_fingerprint=_program_cache.fingerprint())
     return log
 
 

@@ -36,6 +36,7 @@ import numpy as np
 from ..base import get_env
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
+from .. import program_cache as _program_cache
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       Request, ServerClosedError, ServingError, pow2_buckets)
 
@@ -61,6 +62,10 @@ _PAD_ROWS = _telemetry.counter(
     "Zero rows executed to pad batches up to their bucket")
 _SWAPS = _telemetry.counter(
     "serving_hot_swaps_total", "Atomic weight hot-swaps applied")
+_WARMUP_TIME = _telemetry.gauge(
+    "serving_warmup_seconds",
+    "Wall time of the last warmup(): bucket-ladder trace+compile (cold) "
+    "or program-cache restore (warm deploy)")
 
 
 class ServingConfig:
@@ -150,6 +155,7 @@ class ModelServer:
         self._recent_outcomes: collections.deque = collections.deque(
             maxlen=256)
         self._warm_compile_counts: Optional[int] = None
+        self.warmup_seconds: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, warmup: bool = True):
@@ -172,14 +178,27 @@ class ModelServer:
 
     def warmup(self):
         """Run every bucket once on zeros: all tracing + XLA compilation
-        happens here, bounded by the declared bucket set."""
+        happens here, bounded by the declared bucket set.  With the
+        persistent program cache enabled (MXNET_PROGRAM_CACHE_DIR) and
+        prefilled (tools/cache_prefill.py), "compilation" is a disk
+        restore and ``warmup_seconds`` collapses from minutes to ms."""
         if self._warmed:
             return
+        _program_cache.ensure_enabled()
+        t0 = time.perf_counter()
         with self._swap_lock:
             for b, pred in sorted(self._predictors.items()):
                 feed = {k: np.zeros((b,) + s, np.float32)
                         for k, s in self._example_shapes.items()}
                 pred.forward(**feed)
+        self.warmup_seconds = time.perf_counter() - t0
+        if _telemetry.enabled:
+            _WARMUP_TIME.set(self.warmup_seconds)
+        from .. import runlog as _runlog
+        _runlog.event("serving_warmup",
+                      seconds=round(self.warmup_seconds, 6),
+                      buckets=list(self._batcher.buckets),
+                      program_cache=_program_cache.stats())
         # per-server baseline, not the global op_jit_cache counters (other
         # executors in the process would pollute a global delta): anything
         # beyond this after warmup is a silent recompile

@@ -293,8 +293,14 @@ class Symbol:
             elif node.attrs.get("__shape__") is not None:
                 # declared shape on the Variable (reference symbol.py var
                 # shape attr participates in InferShape); 0-dims mean
-                # "unknown, infer me" (gluon deferred init) — don't seed those
-                declared = tuple(node.attrs["__shape__"])
+                # "unknown, infer me" (gluon deferred init) — don't seed those.
+                # After a tojson round-trip the attr arrives as its string
+                # repr ("(1, 2)"), so parse before iterating.
+                declared = node.attrs["__shape__"]
+                if isinstance(declared, str):
+                    import ast
+                    declared = ast.literal_eval(declared)
+                declared = tuple(declared)
                 if all(d > 0 for d in declared):
                     shapes[(id(node), 0)] = declared
 

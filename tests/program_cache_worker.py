@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Subprocess entry for the program-cache warm-restart tests.
+
+Runs a tiny MLP Module training step (forward_backward + fused update)
+with the persistent program cache pointed at ``MXNET_PROGRAM_CACHE_DIR``
+(inherited from the parent test), then prints one JSON line of the
+counters the parent asserts on:
+
+- ``puts`` / ``misses`` / ``disk_hits`` — program-cache stats; a warm
+  restart must show puts == misses == 0 with disk_hits > 0.
+- ``repeat_op_jit_misses`` — op_jit_cache_misses_total delta across a
+  REPEAT step (steady state must be fully cached in-process).
+- ``compile_spans`` / ``restore_spans`` — profiler ``XLA::Compile`` vs
+  ``XLA::Restore`` span counts; post-restore the compile count is zero.
+
+The process boundary is the point: process A (cold) compiles and
+persists, process B (same cache dir) must restore everything.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler, program_cache, telemetry
+
+    telemetry.enable()
+    profiler.set_state("run")
+
+    S = mx.symbol
+    h = S.Activation(S.FullyConnected(S.var("data"), num_hidden=8,
+                                      name="fc1"), act_type="relu")
+    sym = S.SoftmaxOutput(S.FullyConnected(h, num_hidden=4, name="fc2"),
+                          S.var("softmax_label"), name="softmax")
+    batch = 2
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (batch, 8))],
+             label_shapes=[("softmax_label", (batch,))])
+    mx.random.seed(0)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="local", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rs = np.random.RandomState(1)
+    xarr = mx.nd.array(rs.uniform(size=(batch, 8)).astype(np.float32))
+    yarr = mx.nd.array(rs.randint(0, 4, (batch,)).astype(np.float32))
+
+    class _B:
+        data = [xarr]
+        label = [yarr]
+
+    def step():
+        mod.forward_backward(_B)
+        mod.update()
+        return float(mod.get_outputs()[0].asnumpy().ravel()[0])
+
+    def op_misses():
+        fam = telemetry.registry().get("op_jit_cache_misses_total")
+        return 0 if fam is None else sum(
+            c.get() for c in fam._children.values())
+
+    loss0 = step()
+    m0 = op_misses()
+    step()
+    profiler.set_state("stop")
+    spans = list(profiler._events)
+    s = program_cache.stats()
+    print(json.dumps({
+        "ok": bool(np.isfinite(loss0)),
+        "cache_enabled": bool(s.get("enabled")),
+        "puts": int(s.get("puts", 0)),
+        "misses": int(s.get("misses", 0)),
+        "disk_hits": int(s.get("disk_hits", 0)),
+        "errors": int(s.get("errors", 0)),
+        "repeat_op_jit_misses": int(op_misses() - m0),
+        "compile_spans": sum(
+            1 for e in spans
+            if str(e.get("name", "")).startswith("XLA::Compile")),
+        "restore_spans": sum(
+            1 for e in spans
+            if str(e.get("name", "")).startswith("XLA::Restore")),
+    }))
+
+
+if __name__ == "__main__":
+    main()

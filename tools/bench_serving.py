@@ -248,6 +248,7 @@ def main():
     server.start()
     doc["warmup_compiles"] = telemetry.value(
         "op_jit_cache_misses_total", op="Executor::Forward") - m0
+    doc["warmup_seconds"] = server.warmup_seconds
     doc["buckets"] = list(server.config.batch_buckets)
     try:
         doc["closed"] = bench_closed(server, args.in_dim, args.clients,

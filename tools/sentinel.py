@@ -72,6 +72,13 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     "atlas_coverage_pct":           ("higher", 0.00, 5.0),
     "monitor_overhead_pct":         ("lower",  0.00, 1.0),
     "sampler_overhead_pct":         ("lower",  0.00, 1.0),
+    # cold-start currency (program_cache.py).  Lower is better; a warm
+    # deploy (prefilled cache dir) improves 5x+ and always passes.  The
+    # bands are generous because the COLD path is compile-time noise on
+    # shared CPU — only a 1.5x-plus-slack blowup is a real regression
+    # (an accidental cache bypass shows up as exactly that).
+    "step_first_compile_seconds":   ("lower",  0.50, 3.0),
+    "serving_warmup_seconds":       ("lower",  0.50, 2.0),
 }
 #: band for metrics not in the table: 15% relative, either direction bad
 #: is unknowable, so assume higher-is-better (throughput-style default).
@@ -107,6 +114,8 @@ def _norm_bench_parsed(parsed: dict, source: str) -> dict:
     put("resnet50_img_per_sec", parsed.get("value"))
     put("resnet50_mfu_pct", parsed.get("mfu_pct"))
     put("resnet50_step_spread_pct", parsed.get("step_spread_pct"))
+    put("step_first_compile_seconds",
+        parsed.get("step_first_compile_seconds"))
     lstm = parsed.get("lstm")
     if isinstance(lstm, dict) and "error" not in lstm:
         put("lstm_tokens_per_sec", lstm.get("value"))
@@ -158,7 +167,8 @@ def _norm_serving(doc: dict, source: str) -> dict:
     for src, dst in (("p99_ms", "serving_p99_ms"),
                      ("latency_p99_ms", "serving_p99_ms"),
                      ("throughput_rps", "serving_throughput_rps"),
-                     ("post_warmup_compiles", "post_warmup_compiles")):
+                     ("post_warmup_compiles", "post_warmup_compiles"),
+                     ("warmup_seconds", "serving_warmup_seconds")):
         v = _num(doc.get(src))
         if v is not None and dst not in metrics:
             metrics[dst] = v
@@ -195,6 +205,10 @@ def _norm_ledger(path: str) -> dict:
                 v = _num(rec.get("post_warmup_compiles"))
                 if v is not None:
                     metrics["post_warmup_compiles"] = v
+            elif ev == "serving_warmup":
+                v = _num(rec.get("seconds"))
+                if v is not None:
+                    metrics["serving_warmup_seconds"] = v
             elif ev == "run_start":
                 env = rec.get("env")
                 if isinstance(env, dict):
