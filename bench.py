@@ -542,17 +542,18 @@ def main():
 
     from mxnet_tpu.train_loop import OverlappedLoop
 
-    def blocked_phase(depth, n):
+    def blocked_phase(depth, n, step_fn=None):
         """Per-step wall times with every loss fetched via a real D2H,
         but `depth` steps in flight (train_loop overlapped window);
         depth=0 is the fully serial dispatch->block reference loop.
         Steady state: each iteration pays one dispatch + one (deferred)
         block, so n iterations still contain n hard fetches."""
+        sf = step_fn or step
         loop = OverlappedLoop(depth)
         times, last = [], None
         for i in range(n + depth):
             t0 = time.perf_counter()
-            loss = step()
+            loss = sf()
             out = loop.push(lambda l=loss: fetch(l))
             dt = time.perf_counter() - t0
             if i >= depth:     # prefill iterations ran no block: drop
@@ -598,6 +599,66 @@ def main():
         med_ts_off = statistics.median(ts_off_times)
         if med_ts_off > 0:
             sampler_overhead_pct = (med / med_ts_off - 1.0) * 100.0
+
+    # checkpoint overhead A/B, same blocked protocol, <3% bar (ISSUE 13).
+    # One TrainCheckpointer save cycle = host snapshot of every parameter
+    # + off-thread async orbax write; its marginal cost (including the
+    # write's CPU contention tail) is measured as the wall-time delta of
+    # PAIRED off/on step blocks — sequential whole-window A/B is blind
+    # here: machine drift on a shared-CPU box exceeds the ~1% effect
+    # (the monitor A/B above wobbles ±10% on this protocol), while
+    # pairing + a median over pairs cancels drift.  The per-save cost is
+    # then amortized at the production-shaped cadence BENCH_CKPT_EVERY.
+    checkpoint_overhead_pct = None
+    ckpt_every = 0
+    if os.environ.get("BENCH_CKPT", "1") != "0":
+        import shutil
+        import tempfile
+        from mxnet_tpu.checkpoint import TrainCheckpointer
+        ckpt_every = max(1, int(os.environ.get("BENCH_CKPT_EVERY", "20")))
+        ck_pairs = max(2, int(os.environ.get("BENCH_CKPT_PAIRS", "3")))
+        ck_blk = max(4, int(os.environ.get("BENCH_CKPT_BLOCK", "6")))
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        ckpt = TrainCheckpointer(ckpt_dir, every_n_steps=ckpt_every, keep=1)
+        params = net.collect_params()
+        ck_iter = [0]
+        ck_saves = [0]
+
+        def ckpt_step():
+            loss = step()
+            ck_iter[0] += 1
+            # fire exactly one save per ON block, on the first TIMED
+            # iteration (past the overlap prefill) so the snapshot, the
+            # submit and the write's contention tail all land in steps
+            # the block actually times
+            if ck_iter[0] == overlap_depth + 1 and not ckpt.busy():
+                # snapshot AFTER step returns, BEFORE the next step's
+                # donation — asnumpy forces the D2H while buffers are live
+                tree = {k: v.data().asnumpy() for k, v in params.items()}
+                ck_saves[0] += 1
+                ckpt.maybe_save(ck_saves[0], tree)
+            return loss
+
+        try:
+            deltas, off_means = [], []
+            for _ in range(ck_pairs):
+                off_t, _ = blocked_phase(overlap_depth, ck_blk)
+                ck_iter[0] = 0
+                on_t, _ = blocked_phase(overlap_depth, ck_blk,
+                                        step_fn=ckpt_step)
+                ckpt.wait()           # commit outside the timed region
+                deltas.append(sum(on_t) - sum(off_t))
+                off_means.append(sum(off_t) / len(off_t))
+            ckpt.close()
+            if health_on:
+                _health.monitor.drop_window()
+            save_cost = statistics.median(deltas)
+            step_off = statistics.median(off_means)
+            if step_off > 0 and ck_saves[0] == ck_pairs:
+                checkpoint_overhead_pct = \
+                    100.0 * save_cost / (ckpt_every * step_off)
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     # --- phase 2+3: windowed steady-state + linear-scaling validation
     w1, lval = window(iters)
@@ -651,6 +712,12 @@ def main():
         # (the chip-peak convention the MFU divides by)
         "achieved_tmacs": round(img_per_sec * TRAIN_GMACS_PER_IMG / 1e3, 2),
         "flop_convention": "2 flops per MAC; train = 3x fwd (4.1 GMAC/img)",
+        # donation-safe async checkpointing (ISSUE 13): amortized per-step
+        # cost with a live TrainCheckpointer at the stated cadence
+        "checkpoint_overhead_pct": (round(checkpoint_overhead_pct, 2)
+                                    if checkpoint_overhead_pct is not None
+                                    else None),
+        "checkpoint_every_n_steps": ckpt_every or None,
         "step_first_seconds": round(first_step_wall, 3),
         # trace + XLA-compile (or cache-restore) cost of the first step:
         # its wall time minus one steady-state serial step

@@ -1,0 +1,172 @@
+"""Chaos-injection harness: env-gated fault injection for resilience tests.
+
+A framework that survives worker death, server death, and preemption has
+to *prove* it — by inspection nothing hangs; under injected faults the
+hang is found in CI instead of production.  This module is the single
+switchboard for every injectable fault, all OFF unless ``MXNET_CHAOS=1``:
+
+wire level (hooks inside ``kvstore_server.send_msg`` — both directions,
+worker->server requests and server->worker replies):
+
+  * ``MXNET_CHAOS_FRAME_DROP_P``    — drop the frame (never sent); the
+    peer's deadline-aware recv times out and the retry path replays it.
+  * ``MXNET_CHAOS_FRAME_DELAY_P`` / ``MXNET_CHAOS_FRAME_DELAY_MS`` —
+    sleep before the send (straggling link).
+  * ``MXNET_CHAOS_FRAME_CORRUPT_P`` — flip a byte in the frame header
+    region so the receiver's framing validation rejects it loudly
+    (``kvstore_frame_errors_total``) and the client reconnects.
+
+process level (hooks the training loop / server push path call):
+
+  * ``MXNET_CHAOS_DIE_AT_STEP``     — ``os._exit(1)`` when the worker
+    reaches that step (the kill -9 analog: no cleanup, no atexit).
+  * ``MXNET_CHAOS_SIGTERM_AT_STEP`` — SIGTERM self-delivery at that step
+    (preemption analog; the checkpoint preempt handler must catch it).
+  * ``MXNET_CHAOS_DIE_AT_PUSH``     — server-side: ``os._exit(1)`` after
+    that many applied pushes (parameter-server death mid-run).
+
+``MXNET_CHAOS_ONLY_GEN`` scopes every injection to one elastic restart
+generation (``MXNET_ELASTIC_RESTART``), so a relaunched gang runs clean —
+the canonical "fail once, recover, converge" experiment.  Faults draw
+from a process-local PRNG seeded by ``MXNET_CHAOS_SEED`` + pid (set the
+seed for reproducible fault schedules).  Every injection increments
+``chaos_injections_total{kind}`` and, when a run ledger is open, appends
+a ``chaos_injection`` runlog event for the post-mortem timeline.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+from . import telemetry as _telemetry
+
+__all__ = ["active", "wire_action", "corrupt", "delay_seconds", "step",
+           "server_push"]
+
+_INJECTIONS = _telemetry.counter(
+    "chaos_injections_total",
+    "Faults injected by the chaos harness", ("kind",))
+
+_rng_lock = threading.Lock()
+_rng: Optional[random.Random] = None
+
+
+def _get_rng() -> random.Random:
+    global _rng
+    with _rng_lock:
+        if _rng is None:
+            seed = os.environ.get("MXNET_CHAOS_SEED")
+            _rng = random.Random(
+                (int(seed) + os.getpid()) if seed else None)
+        return _rng
+
+
+def _p(name: str) -> float:
+    try:
+        return max(0.0, min(1.0, float(os.environ.get(name, "0") or 0)))
+    except ValueError:
+        return 0.0
+
+
+def active() -> bool:
+    """Master gate: faults only ever fire under ``MXNET_CHAOS=1``, and
+    only in the elastic generation ``MXNET_CHAOS_ONLY_GEN`` names (any
+    generation when unset)."""
+    if os.environ.get("MXNET_CHAOS", "0") in ("0", "", "false", "off"):
+        return False
+    only_gen = os.environ.get("MXNET_CHAOS_ONLY_GEN")
+    if only_gen not in (None, ""):
+        return os.environ.get("MXNET_ELASTIC_RESTART", "0") == only_gen
+    return True
+
+
+def _note(kind: str):
+    _INJECTIONS.labels(kind=kind).inc()
+    try:
+        from . import runlog as _runlog
+        _runlog.event("chaos_injection", kind=kind)
+    except Exception:
+        pass
+
+
+def wire_action() -> Optional[str]:
+    """One draw of the wire-fault die for a frame about to be sent:
+    ``"drop"`` / ``"delay"`` / ``"corrupt"`` / None.  The caller owns the
+    mechanics (skip the send / sleep / flip bytes); this function owns
+    probability, accounting, and the ledger event."""
+    if not active():
+        return None
+    r = _get_rng().random()
+    p_drop = _p("MXNET_CHAOS_FRAME_DROP_P")
+    p_corrupt = _p("MXNET_CHAOS_FRAME_CORRUPT_P")
+    p_delay = _p("MXNET_CHAOS_FRAME_DELAY_P")
+    if r < p_drop:
+        _note("frame_drop")
+        return "drop"
+    if r < p_drop + p_corrupt:
+        _note("frame_corrupt")
+        return "corrupt"
+    if r < p_drop + p_corrupt + p_delay:
+        _note("frame_delay")
+        return "delay"
+    return None
+
+
+def corrupt(payload: bytes) -> bytes:
+    """Flip one byte in the frame-header region (first 64 bytes past the
+    length prefix) so the receiver's framing validation catches it loudly
+    instead of silently accepting corrupted tensor bytes."""
+    if not payload:
+        return payload
+    idx = _get_rng().randrange(min(64, len(payload)))
+    b = bytearray(payload)
+    b[idx] ^= 0xFF
+    return bytes(b)
+
+
+def delay_seconds() -> float:
+    try:
+        return max(0.0, float(
+            os.environ.get("MXNET_CHAOS_FRAME_DELAY_MS", "50"))) / 1e3
+    except ValueError:
+        return 0.05
+
+
+def _at(name: str, value: int) -> bool:
+    raw = os.environ.get(name)
+    if not raw:
+        return False
+    try:
+        return int(raw) == int(value)
+    except ValueError:
+        return False
+
+
+def step(step_no: int):
+    """Training-loop hook: die / self-preempt when the configured step is
+    reached.  Call once per completed step with the global step number."""
+    if not active():
+        return
+    if _at("MXNET_CHAOS_DIE_AT_STEP", step_no):
+        _note("die_at_step")
+        os._exit(1)
+    if _at("MXNET_CHAOS_SIGTERM_AT_STEP", step_no):
+        _note("sigterm_at_step")
+        os.kill(os.getpid(), signal.SIGTERM)
+        # delivery is asynchronous: give the handler a beat so the "at
+        # step N" contract holds before step N+1 dispatches
+        time.sleep(0.05)
+
+
+def server_push(push_count: int):
+    """Parameter-server hook: die (kill -9 analog) after the configured
+    number of applied pushes."""
+    if not active():
+        return
+    if _at("MXNET_CHAOS_DIE_AT_PUSH", push_count):
+        _note("die_at_push")
+        os._exit(1)

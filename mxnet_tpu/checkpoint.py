@@ -14,15 +14,30 @@ Reference analog + upgrade (SURVEY.md §5.4): the reference checkpoints are
 """
 from __future__ import annotations
 
+import json
 import os
+import re
+import shutil
+import signal
+import threading
 from typing import Dict, Optional, Union
 
 import jax
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
+from . import telemetry as _telemetry
 
-__all__ = ["save_sharded", "load_sharded", "AsyncCheckpointer"]
+__all__ = ["save_sharded", "load_sharded", "AsyncCheckpointer",
+           "TrainCheckpointer", "install_preempt_handler", "preempted",
+           "clear_preempt", "COMMIT_MARKER"]
+
+_CKPT_WRITES = _telemetry.counter(
+    "checkpoint_writes_total",
+    "Training checkpoints committed", ("mode",))
+_CKPT_SKIPS = _telemetry.counter(
+    "checkpoint_skips_total",
+    "Checkpoint opportunities skipped because a write was in flight")
 
 
 def _as_pytree(obj) -> Dict[str, jax.Array]:
@@ -83,13 +98,34 @@ def load_sharded(path: str, target=None):
         else target
     for k, v in restored.items():
         slot = obj[k]
-        if hasattr(slot, "data"):           # Parameter
-            slot.data()._data = v
+        if hasattr(slot, "data") and callable(getattr(slot, "data")):
+            # Parameter: validate against the live value, then go through
+            # set_data so EVERY context replica gets the restored value (a
+            # raw ``.data()._data = v`` used to overwrite one replica and
+            # silently accept dtype/shape drift)
+            cur = slot.data()
+            _check_restored(k, cur, v)
+            slot.set_data(NDArray(jax.numpy.asarray(v), cur.context))
         elif isinstance(slot, NDArray):
+            _check_restored(k, slot, v)
             slot._data = v
         else:
             obj[k] = v
     return restored
+
+
+def _check_restored(name, cur, v):
+    """A restored leaf must match the live parameter exactly — a silent
+    dtype cast or shape broadcast here corrupts training state in a way
+    that only shows up as a diverging loss much later."""
+    if tuple(cur.shape) != tuple(np.shape(v)):
+        raise MXNetError(
+            "checkpoint restore: %r has shape %s, parameter expects %s"
+            % (name, tuple(np.shape(v)), tuple(cur.shape)))
+    if np.dtype(cur.dtype) != np.dtype(getattr(v, "dtype", None)):
+        raise MXNetError(
+            "checkpoint restore: %r has dtype %s, parameter expects %s"
+            % (name, getattr(v, "dtype", None), np.dtype(cur.dtype)))
 
 
 class AsyncCheckpointer:
@@ -121,3 +157,241 @@ class AsyncCheckpointer:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# ---- periodic training checkpoints (donation-safe, commit-marked) ---------
+
+#: a checkpoint step dir without this file is an in-progress or torn write
+#: and must be invisible to restore
+COMMIT_MARKER = "COMMIT.json"
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+_preempt = threading.Event()
+_preempt_installed = False
+
+
+def install_preempt_handler(signum=signal.SIGTERM):
+    """Make SIGTERM (the preemption notice on every major scheduler) set a
+    flag the training loop polls between steps: finish the in-flight step,
+    write a final sync checkpoint, exit 0.  Chains any existing handler.
+    No-op off the main thread (signal API restriction)."""
+    global _preempt_installed
+    if _preempt_installed:
+        return True
+    try:
+        prev = signal.getsignal(signum)
+
+        def _handler(sig, frame):
+            _preempt.set()
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(sig, frame)
+
+        signal.signal(signum, _handler)
+    except ValueError:
+        return False
+    _preempt_installed = True
+    return True
+
+
+def preempted():
+    return _preempt.is_set()
+
+
+def clear_preempt():
+    _preempt.clear()
+
+
+def latest_checkpoint_dir(directory):
+    """Newest COMMITTED ``step_<N>`` dir under ``directory`` (or None).
+    Uncommitted/partial dirs — no marker — are skipped, never loaded."""
+    if not directory or not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        m = _STEP_DIR_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.exists(os.path.join(path, COMMIT_MARKER)):
+            continue
+        if int(m.group(1)) > best_step:
+            best_step, best = int(m.group(1)), path
+    return best
+
+
+class TrainCheckpointer:
+    """Periodic, donation-safe, async training checkpoints.
+
+    The caller snapshots its state into host copies (the snapshot happens
+    BEFORE the next fused step donates the live buffers — after ``step``
+    returns, params/opt-state reference the step's freshly-materialized
+    outputs, and converting them to numpy forces the D2H copy while they
+    are still valid).  The write then overlaps training on orbax's async
+    machinery; a ``COMMIT.json`` marker lands only after the write is
+    durable, so ``latest()`` can never hand back a torn checkpoint.
+
+    Layout per checkpoint::
+
+        <dir>/step_<N>/state/         orbax tree (params + aux)
+        <dir>/step_<N>/<name>.bin     opaque blobs (e.g. pickled updater
+                                      states — written on the async thread)
+        <dir>/step_<N>/COMMIT.json    {"step": N, "meta": {...}}, last
+
+    Retention is keep-last-K over COMMITTED checkpoints; stale uncommitted
+    dirs (from a crash mid-write) are pruned too.
+    """
+
+    def __init__(self, directory, every_n_steps=None, keep=None):
+        self._dir = os.path.abspath(directory)
+        self._every = int(get_env("MXNET_CKPT_EVERY_N_STEPS", 0)
+                          if every_n_steps is None else every_n_steps)
+        self._keep = int(get_env("MXNET_CKPT_KEEP", 3)
+                         if keep is None else keep)
+        os.makedirs(self._dir, exist_ok=True)
+        self._async = AsyncCheckpointer()
+        self._pending: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls):
+        """The ``Module.fit``/``Trainer.fit_epoch`` wiring:
+        ``MXNET_CKPT_DIR`` + ``MXNET_CKPT_EVERY_N_STEPS`` > 0 opt in."""
+        directory = os.environ.get("MXNET_CKPT_DIR")
+        every = int(get_env("MXNET_CKPT_EVERY_N_STEPS", 0))
+        if not directory or every <= 0:
+            return None
+        return cls(directory, every_n_steps=every)
+
+    @property
+    def directory(self):
+        return self._dir
+
+    def due(self, step):
+        return self._every > 0 and step > 0 and step % self._every == 0
+
+    def busy(self):
+        t = self._pending
+        return t is not None and t.is_alive()
+
+    def maybe_save(self, step, tree, meta=None, blobs=None):
+        """Async checkpoint; returns False (and counts a skip) when the
+        previous write is still in flight — a slow filesystem must cost a
+        checkpoint, never stall the training step."""
+        if self.busy():
+            _CKPT_SKIPS.inc()
+            return False
+        self._start_write(step, tree, meta, blobs, sync=False)
+        return True
+
+    def save_sync(self, step, tree, meta=None, blobs=None):
+        """Blocking checkpoint (the preempt path: the process is about to
+        exit, so overlap buys nothing and durability is everything)."""
+        self.wait()
+        self._start_write(step, tree, meta, blobs, sync=True)
+        return os.path.join(self._dir, "step_%d" % int(step))
+
+    def _start_write(self, step, tree, meta, blobs, sync):
+        step = int(step)
+        path = os.path.join(self._dir, "step_%d" % step)
+        if os.path.isdir(path):
+            # leftover from a crashed attempt at the same step (it cannot
+            # be committed: latest() would have resumed past it)
+            shutil.rmtree(path, ignore_errors=True)
+        tree = dict(tree)
+
+        def _finish():
+            # the orbax submit itself (directory creation, serialization
+            # setup) costs real milliseconds — off the training thread
+            # too.  Safe: ``tree`` holds host snapshots the caller never
+            # mutates, and busy()/wait() serialize access to ``_async``.
+            self._async.save(os.path.join(path, "state"), tree)
+            self._async.wait()
+            for name, payload in (blobs or {}).items():
+                with open(os.path.join(path, name), "wb") as f:
+                    f.write(payload)
+            marker = {"step": step, "meta": dict(meta or {})}
+            tmp = os.path.join(path, COMMIT_MARKER + ".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(marker, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(path, COMMIT_MARKER))
+            _CKPT_WRITES.labels(mode="sync" if sync else "async").inc()
+            try:
+                from . import runlog as _runlog
+                _runlog.event("checkpoint_commit", step=step,
+                              sync=bool(sync))
+            except Exception:
+                pass
+            self._prune()
+
+        if sync:
+            _finish()
+        else:
+            t = threading.Thread(target=_finish, daemon=True,
+                                 name="mxnet-ckpt-commit")
+            t.start()
+            self._pending = t
+
+    def wait(self):
+        t = self._pending
+        if t is not None:
+            t.join()
+            self._pending = None
+
+    def latest(self):
+        """Path of the newest committed checkpoint, or None."""
+        return latest_checkpoint_dir(self._dir)
+
+    def load(self, path):
+        """Read one committed checkpoint: ``(tree, meta, blobs)``."""
+        marker = os.path.join(path, COMMIT_MARKER)
+        if not os.path.exists(marker):
+            raise MXNetError(
+                "checkpoint %r has no commit marker (partial write?)"
+                % path)
+        with open(marker, "r", encoding="utf-8") as f:
+            meta = json.load(f).get("meta", {})
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as ckptr:
+            tree = ckptr.restore(os.path.join(path, "state"))
+        blobs = {}
+        for name in os.listdir(path):
+            if name.endswith(".bin"):
+                with open(os.path.join(path, name), "rb") as f:
+                    blobs[name] = f.read()
+        return tree, meta, blobs
+
+    def _prune(self):
+        """Keep-last-K committed checkpoints; also reap uncommitted dirs
+        older than the newest committed one (torn writes from a crash)."""
+        with self._lock:
+            committed, partial = [], []
+            try:
+                names = os.listdir(self._dir)
+            except OSError:
+                return
+            for name in names:
+                m = _STEP_DIR_RE.match(name)
+                if not m:
+                    continue
+                step = int(m.group(1))
+                path = os.path.join(self._dir, name)
+                if os.path.exists(os.path.join(path, COMMIT_MARKER)):
+                    committed.append((step, path))
+                else:
+                    partial.append((step, path))
+            committed.sort()
+            doomed = [p for _, p in committed[:-self._keep]] \
+                if self._keep > 0 else []
+            if committed:
+                newest = committed[-1][0]
+                doomed += [p for s, p in partial if s < newest]
+            for p in doomed:
+                shutil.rmtree(p, ignore_errors=True)
+
+    def close(self):
+        self.wait()
+        self._async.close()

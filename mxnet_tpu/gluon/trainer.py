@@ -173,10 +173,98 @@ class Trainer:
         ``self.step`` and returns an async handle (e.g. the loss);
         ``block_fn(handle, i)`` — optional — is the hard-blocking tail
         (loss D2H, logging), deferred ``depth`` steps behind dispatch so
-        the device pipeline stays full.  Returns batches consumed."""
+        the device pipeline stays full.  Returns batches consumed.
+
+        When ``MXNET_CKPT_DIR``/``MXNET_CKPT_EVERY_N_STEPS`` are set the
+        step is wrapped with donation-safe async checkpointing: on the
+        first call the latest committed checkpoint (if any) is restored,
+        and thereafter every due step snapshots params + optimizer state
+        to host memory before the next step can donate the buffers.  A
+        SIGTERM (preemption notice) triggers a final synchronous
+        checkpoint followed by a clean ``SystemExit(0)``."""
         from ..train_loop import run_epoch
-        return run_epoch(data_iter, step_fn, block_fn=block_fn,
-                         depth=depth)
+        from .. import chaos as _chaos
+        from .. import checkpoint as _ckpt
+        if not hasattr(self, "_ft_ckpt"):
+            self._ft_ckpt = _ckpt.TrainCheckpointer.from_env()
+            self._global_step = 0
+            if self._ft_ckpt is not None:
+                _ckpt.install_preempt_handler()
+                latest = self._ft_ckpt.latest()
+                if latest is not None:
+                    tree, meta, blobs = self._ft_ckpt.load(latest)
+                    self._ft_restore(tree, meta, blobs)
+                    self._global_step = int(meta.get("global_step", 0))
+        ckpt = self._ft_ckpt
+        if ckpt is None and not _chaos.active():
+            return run_epoch(data_iter, step_fn, block_fn=block_fn,
+                             depth=depth)
+
+        def _step(batch):
+            out = step_fn(batch)
+            self._global_step += 1
+            gstep = self._global_step
+            _chaos.step(gstep)
+            if ckpt is not None:
+                if _ckpt.preempted():
+                    ckpt.save_sync(gstep, *self._ft_snapshot(gstep))
+                    ckpt.close()
+                    raise SystemExit(0)
+                if ckpt.due(gstep):
+                    ckpt.maybe_save(gstep, *self._ft_snapshot(gstep))
+            return out
+
+        return run_epoch(data_iter, _step, block_fn=block_fn, depth=depth)
+
+    # ---- fault-tolerant training state ----------------------------------
+    def _ft_snapshot(self, gstep):
+        """Host-side copy of params + optimizer state for the async
+        checkpointer.  Safe against donation: TrainerMeshUpdate scatters
+        updated shards back to per-device arrays after every step, and
+        ``asnumpy`` below forces the D2H copy before the next dispatch."""
+        tree = {}
+        for i, param in enumerate(self._params):
+            tree["param/%d/%s" % (i, param.name)] = \
+                param.list_data()[0].asnumpy()
+        meta = {"global_step": int(gstep)}
+        blobs = {}
+        if not self._update_on_kvstore and getattr(self, "_updaters", None):
+            blobs["opt_states.bin"] = self._updaters[0].get_states(
+                dump_optimizer=False)
+            # per-slot update counts are not part of get_states; without
+            # them an Adam resume restarts bias correction at t=0
+            meta["index_update_count"] = {
+                str(k): int(v)
+                for k, v in self._optimizer._index_update_count.items()}
+            meta["num_update"] = int(self._optimizer.num_update)
+        return tree, meta, blobs
+
+    def _ft_restore(self, tree, meta, blobs):
+        from .. import ndarray as _nd
+        for i, param in enumerate(self._params):
+            key = "param/%d/%s" % (i, param.name)
+            if key not in tree:
+                raise MXNetError(
+                    "checkpoint is missing parameter %r" % key)
+            cur = param.list_data()[0]
+            restored = tree[key]
+            if tuple(restored.shape) != tuple(cur.shape):
+                raise MXNetError(
+                    "checkpoint shape mismatch for %r: saved %s, model %s"
+                    % (key, tuple(restored.shape), tuple(cur.shape)))
+            param.set_data(_nd.array(restored, dtype=restored.dtype))
+        states = (blobs or {}).get("opt_states.bin")
+        if states is not None and getattr(self, "_updaters", None):
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+            counts = meta.get("index_update_count") or {}
+            self._optimizer._index_update_count = {
+                (int(k) if str(k).lstrip("-").isdigit() else k): int(v)
+                for k, v in counts.items()}
+            if "num_update" in meta:
+                self._optimizer.num_update = int(meta["num_update"])
 
     def allreduce_grads(self):
         """Reduce gradients over devices only (then call update())."""

@@ -54,6 +54,31 @@ _KV_BYTES_TX = _telemetry.counter(
 _KV_BYTES_RX = _telemetry.counter(
     "kvstore_bytes_received_total",
     "Tensor payload bytes received from the parameter server", ("key",))
+# Failure-path counters count unconditionally (like the server's frame
+# errors): a reconnect storm is exactly what an operator must see even
+# before opting into hot-path telemetry.
+_KV_RECONNECTS = _telemetry.counter(
+    "kvstore_reconnects_total",
+    "Worker reconnects to the parameter server after a failed op")
+_KV_RETRIES = _telemetry.counter(
+    "kvstore_retries_total",
+    "KVStore ops retried after a timeout/connection failure", ("op",))
+_KV_OP_TIMEOUTS = _telemetry.counter(
+    "kvstore_op_timeout_total",
+    "KVStore ops whose reply missed MXNET_KVSTORE_OP_TIMEOUT")
+
+
+def backoff_delay(attempt, base=0.05, cap=2.0, rng=None):
+    """Exponential backoff with jitter for retry attempt ``attempt``
+    (0-based): ``min(cap, base * 2**attempt)`` scaled by a uniform factor
+    in [0.5, 1.5) so a gang of workers whose server died together does not
+    reconnect in lockstep.  ``rng`` is a 0-arg callable returning [0, 1)
+    (injectable for deterministic tests)."""
+    if base <= 0:
+        return 0.0
+    import random as _random
+    r = (rng or _random.random)()
+    return min(float(cap), float(base) * (2.0 ** int(attempt))) * (0.5 + r)
 
 
 def _key(k):
@@ -403,12 +428,20 @@ class DistAsyncKVStore(KVStore):
     updater stays unused.
     """
 
+    #: ops whose server-side apply is not idempotent: their frames carry a
+    #: (rank, seq) context so a replay after reconnect is acked, not
+    #: re-applied (mirror of KVStoreServer._MUTATING)
+    _SEQ_OPS = frozenset(("push", "push_bucket", "push_rsp", "push_2bit",
+                          "barrier"))
+
     def __init__(self, kind="dist_async"):
         super().__init__(kind)
         import socket as _socket
         from . import kvstore_server as _ps
         host, port = _ps.ps_address()
         self._ps = _ps
+        self._socket_mod = _socket
+        self._host, self._port = host, port
         self._sock = None
         # the server process may come up after the workers: retry connect
         deadline = _time.time() + float(
@@ -418,9 +451,6 @@ class DistAsyncKVStore(KVStore):
             try:
                 self._sock = _socket.create_connection((host, port),
                                                        timeout=60)
-                # blocking thereafter: barrier() legitimately waits for
-                # the slowest worker, which can exceed any fixed timeout
-                self._sock.settimeout(None)
                 break
             except OSError as e:
                 last_err = e
@@ -431,6 +461,15 @@ class DistAsyncKVStore(KVStore):
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._lock = threading.Lock()
+        # per-worker monotonic op sequence (rides the wire as the seq
+        # context; assigned once per LOGICAL op, reused verbatim when the
+        # frame is replayed after a reconnect).  The identity carries a
+        # per-process incarnation suffix: a RELAUNCHED worker restarts at
+        # seq 0, and without a fresh dedup lane a durable server that
+        # remembers the previous incarnation's seqs would silently drop
+        # every new push as a replay.
+        self._seq = 0
+        self._seq_ident = "%d.%s" % (self._rank, os.urandom(4).hex())
 
     def _rpc(self, *msg):
         if _tracing.enabled:
@@ -447,7 +486,37 @@ class DistAsyncKVStore(KVStore):
             raise MXNetError("parameter server: %s" % reply[1])
         return reply[1] if len(reply) > 1 else None
 
+    def _op_timeout(self, op):
+        """Per-attempt deadline: EVERY blocking wire call is bounded by
+        this (a dead server must surface as a timeout, never a hang).
+        barrier() legitimately waits for the slowest worker, so it gets
+        its own larger knob instead of unbounded blocking."""
+        t = float(get_env("MXNET_KVSTORE_OP_TIMEOUT", 120.0))
+        if op == "barrier":
+            t = max(t, float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                     600.0)))
+        return t
+
+    def _reconnect(self, timeout):
+        """Drop the (possibly desynced) connection and dial a fresh one.
+        Returns True on success; failure is left to the caller's retry
+        budget — the server may still be restarting."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        try:
+            self._sock = self._socket_mod.create_connection(
+                (self._host, self._port), timeout=timeout)
+        except OSError:
+            return False
+        _KV_RECONNECTS.inc()
+        return True
+
     def _roundtrip(self, msg, trace_ctx):
+        op = str(msg[0])
         health_ctx = None
         if _health.enabled:
             # piggyback this worker's latest step time on the wire header
@@ -455,15 +524,81 @@ class DistAsyncKVStore(KVStore):
             st = _health.monitor.last_step_seconds()
             if st is not None:
                 health_ctx = {"r": str(self._rank), "st": float(st)}
+        timeout = self._op_timeout(op)
+        max_retries = int(get_env("MXNET_KVSTORE_MAX_RETRIES", 8))
+        base = float(get_env("MXNET_KVSTORE_RETRY_BACKOFF", 0.05))
         with self._lock:
-            # positional-compatible call when untraced: tests (and any
-            # wrapper) may substitute a two-argument send_msg
-            if trace_ctx or health_ctx:
-                self._ps.send_msg(self._sock, msg, trace_ctx=trace_ctx,
-                                  health_ctx=health_ctx)
-            else:
-                self._ps.send_msg(self._sock, msg)
-            return self._ps.recv_msg(self._sock)
+            seq_ctx = None
+            if op in self._SEQ_OPS:
+                self._seq += 1
+                seq_ctx = {"r": self._seq_ident, "s": self._seq}
+            last_err = None
+            for attempt in range(max_retries + 1):
+                if attempt:
+                    _KV_RETRIES.labels(op=op).inc()
+                    _time.sleep(backoff_delay(attempt - 1, base))
+                if self._sock is None and not self._reconnect(timeout):
+                    last_err = "parameter server unreachable"
+                    continue
+                try:
+                    self._sock.settimeout(timeout)
+                    # positional-compatible call when no context rides the
+                    # frame: tests (and any wrapper) may substitute a
+                    # two-argument send_msg
+                    if trace_ctx or health_ctx or seq_ctx:
+                        self._ps.send_msg(self._sock, msg,
+                                          trace_ctx=trace_ctx,
+                                          health_ctx=health_ctx,
+                                          seq_ctx=seq_ctx)
+                    else:
+                        self._ps.send_msg(self._sock, msg)
+                    reply = self._ps.recv_msg(self._sock)
+                except self._socket_mod.timeout:
+                    _KV_OP_TIMEOUTS.inc()
+                    last_err = "no reply within %ss" % timeout
+                    self._drop_connection(op, "timeout", attempt)
+                    continue
+                except OSError as e:
+                    last_err = str(e) or type(e).__name__
+                    self._drop_connection(op, "oserror", attempt)
+                    continue
+                except MXNetError as e:
+                    # a corrupt/truncated REPLY frame: the stream may be
+                    # desynced, so resync by reconnecting and replaying
+                    last_err = str(e)
+                    self._drop_connection(op, "bad_reply", attempt)
+                    continue
+                if reply is None:
+                    # EOF mid-op: the server died (or a chaos drop ate the
+                    # reply); the seq context makes the replay idempotent
+                    last_err = "connection closed mid-op"
+                    self._drop_connection(op, "eof", attempt)
+                    continue
+                if reply[0] == "err" and \
+                        str(reply[1]).startswith("bad frame"):
+                    # OUR frame arrived corrupted (chaos/flaky link); the
+                    # server closes its end after this reply — replay
+                    last_err = str(reply[1])
+                    self._drop_connection(op, "bad_frame", attempt)
+                    continue
+                return reply
+            raise MXNetError(
+                "kvstore %s to %s:%d failed after %d attempts: %s"
+                % (op, self._host, self._port, max_retries + 1, last_err))
+
+    def _drop_connection(self, op, cause, attempt):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        try:
+            from . import runlog as _runlog
+            _runlog.event("kvstore_reconnect", worker_rank=str(self._rank),
+                          op=op, cause=cause, attempt=int(attempt))
+        except Exception:
+            pass
 
     @property
     def rank(self):

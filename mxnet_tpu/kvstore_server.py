@@ -35,7 +35,9 @@ and a stop command arrives, then exits.
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import re
 import os
 import pickle
 import socket
@@ -48,6 +50,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .base import MXNetError
+from . import chaos as _chaos
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
@@ -66,6 +69,15 @@ _SRV_REQS = _telemetry.counter(
 _SRV_LAT = _telemetry.histogram(
     "kvstore_server_request_latency_seconds",
     "Parameter-server request handling latency", ("cmd",))
+_SRV_REPLAYS = _telemetry.counter(
+    "kvstore_server_replays_total",
+    "Duplicate (already-applied) frames dropped by seq dedup", ("cmd",))
+_SRV_SNAPSHOTS = _telemetry.counter(
+    "kvstore_server_snapshots_total",
+    "Durable key-table snapshots written by the parameter server")
+_SRV_REHYDRATES = _telemetry.counter(
+    "kvstore_server_rehydrates_total",
+    "Parameter-server restarts that rehydrated durable state")
 
 
 def ps_address():
@@ -126,33 +138,61 @@ def _decode(node, blobs):
     return node
 
 
-def send_msg(sock: socket.socket, obj: Any, trace_ctx: Optional[dict] = None,
-             health_ctx: Optional[dict] = None):
-    """Frame: <Q total><I header_len><header json><I nblobs>(<Q len><raw>)*
-
-    Without ``trace_ctx``/``health_ctx`` the header is the encoded message
-    list — the original wire format, byte-identical.  With a trace context
-    the header becomes ``{"m": <encoded list>, "tc": {"t": trace_id,
-    "s": span_id}}`` so the receiving handler span can adopt the sender's
-    trace (Dapper-style propagation); ``health_ctx`` rides the same wrapper
-    as ``"h": {"r": rank, "st": step_seconds}`` feeding the server's
-    per-worker straggler table.  Old receivers never see the wrapper unless
-    tracing or health is on."""
+def _pack_payload(obj: Any, trace_ctx: Optional[dict] = None,
+                  health_ctx: Optional[dict] = None,
+                  seq_ctx: Optional[dict] = None) -> bytes:
+    """Serialize a message to frame-payload bytes (everything after the
+    outer ``<Q total>`` length prefix).  Shared by the socket send path and
+    the server's durable snapshot/journal records, so durability reuses the
+    wire format's loud-reject validation on load."""
     blobs: list = []
     node: Any = _encode(list(obj), blobs)
-    if trace_ctx or health_ctx:
+    if trace_ctx or health_ctx or seq_ctx:
         node = {"m": node}
         if trace_ctx:
             node["tc"] = dict(trace_ctx)
         if health_ctx:
             node["h"] = dict(health_ctx)
+        if seq_ctx:
+            node["q"] = dict(seq_ctx)
     header = json.dumps(node).encode()
     parts = [struct.pack("<I", len(header)), header,
              struct.pack("<I", len(blobs))]
     for b in blobs:
         parts.append(struct.pack("<Q", len(b)))
         parts.append(b)
-    payload = b"".join(parts)
+    return b"".join(parts)
+
+
+def send_msg(sock: socket.socket, obj: Any, trace_ctx: Optional[dict] = None,
+             health_ctx: Optional[dict] = None,
+             seq_ctx: Optional[dict] = None):
+    """Frame: <Q total><I header_len><header json><I nblobs>(<Q len><raw>)*
+
+    Without ``trace_ctx``/``health_ctx``/``seq_ctx`` the header is the
+    encoded message list — the original wire format, byte-identical.  With
+    a trace context the header becomes ``{"m": <encoded list>, "tc":
+    {"t": trace_id, "s": span_id}}`` so the receiving handler span can
+    adopt the sender's trace (Dapper-style propagation); ``health_ctx``
+    rides the same wrapper as ``"h": {"r": rank, "st": step_seconds}``
+    feeding the server's per-worker straggler table; ``seq_ctx`` rides as
+    ``"q": {"r": rank, "s": seq}`` so the server can drop replayed frames
+    after a reconnect (at-most-once apply for non-idempotent pushes).  Old
+    receivers never see the wrapper unless one of the contexts is on.
+
+    This is also the chaos harness's wire choke point: under
+    ``MXNET_CHAOS`` a frame may be dropped (never sent — the peer's
+    deadline-aware recv times out), delayed, or corrupted in its header
+    region (the receiver's framing validation rejects it loudly)."""
+    payload = _pack_payload(obj, trace_ctx, health_ctx, seq_ctx)
+    if _chaos.active():
+        action = _chaos.wire_action()
+        if action == "drop":
+            return
+        if action == "delay":
+            time.sleep(_chaos.delay_seconds())
+        elif action == "corrupt":
+            payload = _chaos.corrupt(payload)
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -271,33 +311,60 @@ def _check_health_ctx(hc):
     return {"r": r, "st": float(st)}
 
 
-def recv_msg_full(sock: socket.socket):
-    """Receive one message plus its optional trace and health contexts.
+# sequence-context bounds: rank is a small decimal string (same shape as
+# the health-context rank), seq a non-negative integer — anything else is
+# a malformed frame
+_QC_KEYS = frozenset(("r", "s"))
+_QC_MAX_SEQ = 2 ** 62
+#: worker identity on the wire: "<rank>" or "<rank>.<incarnation-hex>" —
+#: the suffix gives every worker PROCESS its own dedup lane, so a
+#: relaunched worker (seq restarts at 0) is never shadowed by the seqs a
+#: rehydrated server remembers from its previous life
+_QC_IDENT_RE = re.compile(r"^\d+(\.[0-9a-f]{1,16})?$")
+_QC_MAX_IDENT_LEN = 33
 
-    Returns ``(msg, tc, hc)`` where ``tc`` is ``{"t":..., "s":...}`` or
-    None and ``hc`` is ``{"r":..., "st":...}`` or None (old-format frames,
-    whose header is the bare message list, keep parsing unchanged), or
-    None on clean EOF."""
-    header = _recv_exact(sock, 8)
-    if header is None:
-        return None
-    (n,) = struct.unpack("<Q", header)
-    payload = _recv_exact(sock, n)
-    if payload is None:
-        return None
+
+def _check_seq_ctx(qc):
+    """Validate an incoming wire sequence context (loud-reject, like the
+    trace/health contexts and bucket metadata above)."""
+    if not isinstance(qc, dict):
+        _frame_error("seq context is not an object")
+    unknown = set(qc) - _QC_KEYS
+    if unknown:
+        _frame_error("unknown seq-context keys %s" % sorted(unknown))
+    if set(qc) != _QC_KEYS:
+        _frame_error("seq context missing fields")
+    r = qc["r"]
+    if not isinstance(r, str) or not r or len(r) > _QC_MAX_IDENT_LEN \
+            or not _QC_IDENT_RE.match(r):
+        _frame_error("seq-context rank %r malformed" % (r,))
+    s = qc["s"]
+    if not isinstance(s, int) or isinstance(s, bool) \
+            or not (0 <= s < _QC_MAX_SEQ):
+        _frame_error("seq-context seq %r out of bounds" % (s,))
+    return {"r": r, "s": s}
+
+
+def _parse_payload(payload: bytes):
+    """Parse frame-payload bytes into ``(msg, tc, hc, qc)`` with the full
+    loud-reject validation.  Shared by the socket recv path and the
+    durable snapshot/journal loader."""
     if len(payload) < 4:
         _frame_error("frame shorter than its header-length field")
     (hlen,) = struct.unpack_from("<I", payload, 0)
     if 4 + hlen + 4 > len(payload):
         _frame_error("header length %d overruns %d-byte frame"
                      % (hlen, len(payload)))
-    hdr = json.loads(payload[4:4 + hlen].decode())
-    tc = hc = None
+    try:
+        hdr = json.loads(payload[4:4 + hlen].decode())
+    except ValueError:
+        _frame_error("header is not valid JSON")
+    tc = hc = qc = None
     if isinstance(hdr, dict):
-        # wrapped framing: {"m": message, "tc": {...}, "h": {...}} — the
-        # message list itself is always a JSON array at top level, so a
-        # dict here can only be the context wrapper
-        unknown = set(hdr) - {"m", "tc", "h"}
+        # wrapped framing: {"m": message, "tc": {...}, "h": {...},
+        # "q": {...}} — the message list itself is always a JSON array at
+        # top level, so a dict here can only be the context wrapper
+        unknown = set(hdr) - {"m", "tc", "h", "q"}
         if unknown:
             _frame_error("unknown header keys %s" % sorted(unknown))
         if "m" not in hdr:
@@ -306,6 +373,8 @@ def recv_msg_full(sock: socket.socket):
             tc = _check_trace_ctx(hdr["tc"])
         if hdr.get("h") is not None:
             hc = _check_health_ctx(hdr["h"])
+        if hdr.get("q") is not None:
+            qc = _check_seq_ctx(hdr["q"])
         hdr = hdr["m"]
     off = 4 + hlen
     (nblobs,) = struct.unpack_from("<I", payload, off)
@@ -325,7 +394,25 @@ def recv_msg_full(sock: socket.socket):
     if off != len(payload):
         _frame_error("%d trailing bytes after last blob"
                      % (len(payload) - off))
-    return _decode(hdr, blobs), tc, hc
+    return _decode(hdr, blobs), tc, hc, qc
+
+
+def recv_msg_full(sock: socket.socket):
+    """Receive one message plus its optional trace, health, and sequence
+    contexts.
+
+    Returns ``(msg, tc, hc, qc)`` where ``tc`` is ``{"t":..., "s":...}``
+    or None, ``hc`` is ``{"r":..., "st":...}`` or None, and ``qc`` is
+    ``{"r":..., "s":...}`` or None (old-format frames, whose header is the
+    bare message list, keep parsing unchanged), or None on clean EOF."""
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<Q", header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return _parse_payload(payload)
 
 
 def recv_msg_tc(sock: socket.socket):
@@ -364,17 +451,36 @@ class KVStoreServer:
     num_workers), ``stop`` (kStopServer).
     """
 
-    def __init__(self, host="127.0.0.1", port=0, num_workers=1):
+    #: durable snapshot magic (format version 1; program_cache's MXPC1
+    #: pattern: magic + sha256 + payload, atomic tmp+replace writes)
+    SNAP_MAGIC = b"MXKVS1\0"
+    JOURNAL_MAGIC = b"MXKVJ1\0"
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1,
+                 durable_dir: Optional[str] = None):
         self._store: Dict[str, np.ndarray] = {}
         self._locks: Dict[str, threading.Lock] = {}
         self._meta_lock = threading.Lock()
         self._updater = None
+        self._opt_blob: Optional[bytes] = None
         self._num_workers = num_workers
         self._barrier_cond = threading.Condition()
         self._barrier_count = 0
+        self._barrier_ranks: set = set()
         self._barrier_gen = 0
         self._stop = threading.Event()
         self.push_count = 0
+        # per-worker last applied sequence number: a frame replayed after
+        # a reconnect (same rank, seq <= applied) is acked without being
+        # re-applied, making the retry path at-most-once for pushes
+        self._applied_seq: Dict[str, int] = {}
+        self._straggler_streak: Dict[str, int] = {}
+        self._durable_dir = durable_dir
+        self._durable_lock = threading.Lock()
+        self._pushes_since_snap = 0
+        if durable_dir:
+            os.makedirs(durable_dir, exist_ok=True)
+            self._rehydrate()
 
         outer = self
 
@@ -396,31 +502,32 @@ class KVStoreServer:
                         return
                     if got is None:
                         return
-                    msg, tc, hc = got
+                    msg, tc, hc, qc = got
                     if hc is not None:
                         # worker-reported step time -> straggler table
                         # (the worker only attaches it when ITS health
                         # monitor is on, so no server-side gate needed)
                         from . import health as _health
                         _health.workers.update(hc["r"], hc["st"])
+                        outer._maybe_escalate_straggler(hc["r"])
                     if _tracing.enabled:
                         # adopt the worker's trace context: the handler
                         # span joins the pushing span's trace and ends
                         # its cross-process flow
                         with _tracing.server_span(
                                 "Server::%s" % (msg[0],), tc):
-                            reply = self._timed_dispatch(msg)
+                            reply = self._timed_dispatch(msg, qc)
                     else:
-                        reply = self._timed_dispatch(msg)
+                        reply = self._timed_dispatch(msg, qc)
                     send_msg(self.request, reply)
                     if msg[0] == "stop":
                         return
 
-            def _timed_dispatch(self, msg):
+            def _timed_dispatch(self, msg, qc=None):
                 if not _telemetry.enabled:
-                    return outer._dispatch(msg)
+                    return outer._dispatch(msg, qc)
                 t0 = time.perf_counter()
-                reply = outer._dispatch(msg)
+                reply = outer._dispatch(msg, qc)
                 cmd = str(msg[0])
                 _SRV_REQS.labels(cmd=cmd).inc()
                 _SRV_LAT.labels(cmd=cmd).observe(time.perf_counter() - t0)
@@ -442,7 +549,35 @@ class KVStoreServer:
                 lk = self._locks[key] = threading.Lock()
             return lk
 
-    def _dispatch(self, msg):
+    #: commands whose apply is NOT naturally idempotent: replaying one
+    #: after a reconnect must be acked without re-applying (a re-applied
+    #: push would run the optimizer update twice; a re-joined barrier
+    #: would double-count the rank)
+    _MUTATING = frozenset(("push", "push_bucket", "push_rsp", "push_2bit",
+                           "barrier"))
+
+    def _dispatch(self, msg, qc=None):
+        cmd = msg[0] if isinstance(msg, (list, tuple)) and msg else None
+        if qc is not None and cmd in self._MUTATING:
+            with self._meta_lock:
+                done = qc["s"] <= self._applied_seq.get(qc["r"], -1)
+            if done:
+                # the op was applied but its ack was lost to the failure
+                # the client is retrying around — ack, don't re-apply
+                _SRV_REPLAYS.labels(cmd=str(cmd)).inc()
+                return ("ok",)
+        reply = self._dispatch_cmd(msg, qc)
+        applied = isinstance(reply, tuple) and reply and reply[0] == "ok"
+        if applied and qc is not None and cmd in self._MUTATING:
+            with self._meta_lock:
+                self._applied_seq[qc["r"]] = qc["s"]
+        if applied and cmd in ("push", "push_bucket", "push_rsp",
+                               "push_2bit"):
+            self._maybe_snapshot()
+            _chaos.server_push(self.push_count)
+        return reply
+
+    def _dispatch_cmd(self, msg, qc=None):
         cmd = msg[0]
         try:
             if cmd == "init":
@@ -451,6 +586,7 @@ class KVStoreServer:
                     # first writer wins (worker 0 initializes the PS)
                     if key not in self._store:
                         self._store[key] = np.array(arr, copy=True)
+                        self._journal(("init", key, self._store[key]))
                 return ("ok",)
             if cmd == "push":
                 _, key, grad = msg
@@ -573,9 +709,11 @@ class KVStoreServer:
                     if self._updater is None:
                         self._updater = opt.get_updater(
                             pickle.loads(payload))
+                        self._opt_blob = bytes(payload)
+                        self._journal(("set_optimizer", bytes(payload)))
                 return ("ok",)
             if cmd == "barrier":
-                self._wait_barrier()
+                self._wait_barrier(rank=qc["r"] if qc else None)
                 return ("ok",)
             if cmd == "stop":
                 self._stop.set()
@@ -607,17 +745,254 @@ class KVStoreServer:
         self._updater(key, g, w)
         self._store[key] = w.asnumpy()
 
-    def _wait_barrier(self):
+    def _wait_barrier(self, rank=None):
         with self._barrier_cond:
             gen = self._barrier_gen
-            self._barrier_count += 1
-            if self._barrier_count >= self._num_workers:
+            if rank is None:
+                self._barrier_count += 1
+            else:
+                # rank-keyed membership: a retried barrier frame (its
+                # original handler thread may still be parked here) must
+                # not count the same worker twice and release early.  The
+                # identity carries an incarnation suffix ("0.ab12cd34")
+                # so only the rank part counts — a relaunched worker must
+                # not be mistaken for a second gang member
+                self._barrier_ranks.add(str(rank).split(".", 1)[0])
+            if self._barrier_count + len(self._barrier_ranks) \
+                    >= self._num_workers:
                 self._barrier_count = 0
+                self._barrier_ranks.clear()
                 self._barrier_gen += 1
                 self._barrier_cond.notify_all()
             else:
                 while self._barrier_gen == gen and not self._stop.is_set():
                     self._barrier_cond.wait(timeout=1.0)
+
+    # ---- durability ------------------------------------------------------
+    # The key table is the only training state the gang cannot recompute:
+    # a restarted server that comes back empty silently resets every
+    # weight to its init.  Layout under durable_dir:
+    #   snapshot.bin  MAGIC + sha256(payload) + payload   (atomic replace)
+    #   journal.bin   MAGIC + (<Q len><sha256><payload>)* (append + fsync)
+    # The journal holds only the rare structural records (init,
+    # set_optimizer); the weight values themselves ride the periodic
+    # snapshot, so a crash loses at most MXNET_KVSTORE_SNAPSHOT_EVERY
+    # pushes of async-SGD progress — never keys, shapes, or the update
+    # rule.  Replay after a snapshot load is first-writer-wins, so the
+    # two sources compose without ordering bookkeeping.  ``_applied_seq``
+    # rides the snapshot: it is copied BEFORE the weights, so a push that
+    # races the snapshot boundary replays as at-least-once (benign for
+    # async SGD) instead of being silently dropped.
+
+    def _journal(self, record):
+        if not self._durable_dir:
+            return
+        payload = _pack_payload(record)
+        with self._durable_lock:
+            path = os.path.join(self._durable_dir, "journal.bin")
+            fresh = not os.path.exists(path)
+            with open(path, "ab") as f:
+                if fresh:
+                    f.write(self.JOURNAL_MAGIC)
+                f.write(struct.pack("<Q", len(payload)))
+                f.write(hashlib.sha256(payload).digest())
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def _maybe_snapshot(self):
+        if not self._durable_dir:
+            return
+        try:
+            every = int(os.environ.get("MXNET_KVSTORE_SNAPSHOT_EVERY",
+                                       "100"))
+        except ValueError:
+            every = 100
+        if every <= 0:
+            return
+        with self._meta_lock:
+            self._pushes_since_snap += 1
+            due = self._pushes_since_snap >= every
+            if due:
+                self._pushes_since_snap = 0
+        if due:
+            self.snapshot_now()
+
+    def snapshot_now(self):
+        """Write a checksummed snapshot of the full key table (atomic
+        tmp+replace, program_cache-style).  Returns the path, or None when
+        durability is off."""
+        if not self._durable_dir:
+            return None
+        with self._meta_lock:
+            keys = sorted(self._store)
+            seq_ranks = sorted(self._applied_seq)
+            seq_vals = [int(self._applied_seq[r]) for r in seq_ranks]
+            push_count = int(self.push_count)
+            opt_blob = self._opt_blob
+        arrays = []
+        for k in keys:
+            with self._lock_for(k):
+                arrays.append(np.array(self._store[k], copy=True))
+        payload = _pack_payload(("snap", list(keys), arrays,
+                                 list(seq_ranks), seq_vals, push_count,
+                                 opt_blob))
+        blob = (self.SNAP_MAGIC + hashlib.sha256(payload).digest()
+                + payload)
+        path = os.path.join(self._durable_dir, "snapshot.bin")
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with self._durable_lock:
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+        _SRV_SNAPSHOTS.inc()
+        return path
+
+    def _load_snapshot(self):
+        path = os.path.join(self._durable_dir, "snapshot.bin")
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            head = len(self.SNAP_MAGIC)
+            if not raw.startswith(self.SNAP_MAGIC):
+                raise MXNetError("snapshot magic mismatch")
+            want = raw[head:head + 32]
+            payload = raw[head + 32:]
+            if hashlib.sha256(payload).digest() != want:
+                raise MXNetError("snapshot checksum mismatch")
+            msg = _parse_payload(payload)[0]
+            if not (isinstance(msg, list) and len(msg) == 7
+                    and msg[0] == "snap"):
+                raise MXNetError("snapshot record malformed")
+            _, keys, arrays, seq_ranks, seq_vals, push_count, opt_blob = msg
+            if len(keys) != len(arrays) or len(seq_ranks) != len(seq_vals):
+                raise MXNetError("snapshot record malformed")
+            for k, a in zip(keys, arrays):
+                if not isinstance(k, str) or not isinstance(a, np.ndarray):
+                    raise MXNetError("snapshot entry malformed")
+                self._store[k] = np.array(a, copy=True)
+            for r, s in zip(seq_ranks, seq_vals):
+                self._applied_seq[str(r)] = int(s)
+            self.push_count = int(push_count)
+            if opt_blob is not None and self._updater is None:
+                self._set_updater_from_blob(bytes(opt_blob))
+            return True
+        except Exception:
+            # quarantine like program_cache: a corrupt snapshot must not
+            # wedge every future restart
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+            self._store.clear()
+            self._applied_seq.clear()
+            return False
+
+    def _replay_journal(self):
+        path = os.path.join(self._durable_dir, "journal.bin")
+        if not os.path.exists(path):
+            return 0
+        applied = 0
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return 0
+        if not raw.startswith(self.JOURNAL_MAGIC):
+            return 0
+        off = len(self.JOURNAL_MAGIC)
+        while off + 8 + 32 <= len(raw):
+            (n,) = struct.unpack_from("<Q", raw, off)
+            want = raw[off + 8:off + 40]
+            payload = raw[off + 40:off + 40 + n]
+            if len(payload) != n or \
+                    hashlib.sha256(payload).digest() != want:
+                break  # truncated/corrupt tail: crash mid-append
+            off += 40 + n
+            try:
+                rec = _parse_payload(payload)[0]
+            except MXNetError:
+                break
+            if not (isinstance(rec, list) and rec):
+                break
+            if rec[0] == "init" and len(rec) == 3 and \
+                    isinstance(rec[1], str) and \
+                    isinstance(rec[2], np.ndarray):
+                if rec[1] not in self._store:  # snapshot wins
+                    self._store[rec[1]] = np.array(rec[2], copy=True)
+                    applied += 1
+            elif rec[0] == "set_optimizer" and len(rec) == 2:
+                if self._updater is None:
+                    self._set_updater_from_blob(bytes(rec[1]))
+                    applied += 1
+        return applied
+
+    def _set_updater_from_blob(self, blob):
+        from . import optimizer as opt
+        self._updater = opt.get_updater(pickle.loads(blob))
+        self._opt_blob = blob
+
+    def _rehydrate(self):
+        """Restart path: snapshot first (bulk state), then journal replay
+        (structural records since the last snapshot; first-writer-wins
+        keeps the two composable in either order)."""
+        snap = self._load_snapshot()
+        replayed = self._replay_journal()
+        if snap or replayed:
+            _SRV_REHYDRATES.inc()
+            try:
+                from . import runlog as _runlog
+                _runlog.event("kvstore_rehydrate", keys=len(self._store),
+                              ranks={r: s for r, s in
+                                     self._applied_seq.items()},
+                              push_count=int(self.push_count),
+                              from_snapshot=bool(snap),
+                              journal_records=int(replayed))
+            except Exception:
+                pass
+
+    def _maybe_escalate_straggler(self, rank):
+        """PR 7 exported a straggler verdict; nothing consumed it.  After
+        ``MXNET_HEALTH_STRAGGLER_GRACE`` consecutive straggler verdicts for
+        a rank, snapshot and exit nonzero so ElasticRunner relaunches the
+        gang (a persistently slow worker drags every barrier and async
+        epoch; a gang restart re-places it)."""
+        try:
+            grace = int(os.environ.get("MXNET_HEALTH_STRAGGLER_GRACE",
+                                       "0") or 0)
+        except ValueError:
+            grace = 0
+        if grace <= 0:
+            return
+        from . import health as _health
+        verdict = _health.workers.snapshot().get(str(rank), {})
+        with self._meta_lock:
+            if verdict.get("straggler"):
+                streak = self._straggler_streak.get(str(rank), 0) + 1
+            else:
+                streak = 0
+            self._straggler_streak[str(rank)] = streak
+        if streak < grace:
+            return
+        try:
+            from . import runlog as _runlog
+            _runlog.event("straggler_escalation", worker_rank=str(rank),
+                          streak=streak, grace=grace)
+        except Exception:
+            pass
+        self.snapshot_now()
+        os._exit(3)
 
     # ---- lifecycle ------------------------------------------------------
     def start(self):
@@ -655,8 +1030,17 @@ def run_server():
         from . import profiler as _profiler
         _profiler.set_state("run")
     server = KVStoreServer(host=bind_host, port=port,
-                           num_workers=num_workers)
+                           num_workers=num_workers,
+                           durable_dir=os.environ.get(
+                               "MXNET_KVSTORE_DURABLE_DIR") or None)
     server.serve_forever()
+    # clean stop: persist the final key table so a relaunched gang (or a
+    # later evaluation run) starts from the last weights, not the last
+    # periodic snapshot
+    try:
+        server.snapshot_now()
+    except Exception:
+        pass
     snap_path = os.environ.get("MXNET_HEALTH_SNAPSHOT_PATH")
     if snap_path:
         # shutdown evidence for the launcher/tests: the aggregated

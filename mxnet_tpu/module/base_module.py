@@ -155,6 +155,28 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # fault tolerance: periodic donation-safe async checkpoints
+        # (MXNET_CKPT_DIR + MXNET_CKPT_EVERY_N_STEPS), preempt-resume
+        # (SIGTERM -> final sync checkpoint -> exit 0), and the chaos
+        # harness's per-step process faults
+        from .. import chaos as _chaos
+        from .. import checkpoint as _ckpt
+        ckpt = _ckpt.TrainCheckpointer.from_env()
+        gstep = 0
+        skip_batches = 0
+        if ckpt is not None:
+            _ckpt.install_preempt_handler()
+            latest = ckpt.latest()
+            if latest is not None:
+                tree, meta, blobs = ckpt.load(latest)
+                self._ft_restore(tree, meta, blobs)
+                gstep = int(meta.get("global_step", 0))
+                begin_epoch = max(begin_epoch, int(meta.get("epoch", 0)))
+                skip_batches = int(meta.get("nbatch", 0))
+                self.logger.info(
+                    "Resumed from %s (epoch %d, batch %d, step %d)",
+                    latest, begin_epoch, skip_batches, gstep)
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -162,10 +184,31 @@ class BaseModule:
             train_data.reset()
             loop = OverlappedLoop(depth) if overlap else None
             for data_batch in train_data:
+                if nbatch < skip_batches:
+                    # data-iter cursor fast-forward: the checkpointed
+                    # epoch already consumed these batches
+                    nbatch += 1
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                gstep += 1
+                _chaos.step(gstep)
+                if ckpt is not None:
+                    if _ckpt.preempted():
+                        # preemption notice: the step above is complete,
+                        # so snapshot it durably and hand back exit 0 (a
+                        # clean handoff, not a failure)
+                        ckpt.save_sync(
+                            gstep,
+                            *self._ft_snapshot(epoch, nbatch + 1, gstep))
+                        ckpt.close()
+                        raise SystemExit(0)
+                    if ckpt.due(gstep):
+                        ckpt.maybe_save(
+                            gstep,
+                            *self._ft_snapshot(epoch, nbatch + 1, gstep))
                 deferred = None
                 if loop is not None:
                     deferred = self.defer_metric_update(
@@ -189,6 +232,7 @@ class BaseModule:
                 nbatch += 1
             if loop is not None:
                 loop.drain()
+            skip_batches = 0  # fast-forward applies to the resume epoch only
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
@@ -206,12 +250,71 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
+        if ckpt is not None:
+            ckpt.close()
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init, allow_extra=allow_extra)
+
+    # ---- fault-tolerant training state ----------------------------------
+    def _ft_snapshot(self, epoch, nbatch, gstep):
+        """Capture params + opt state + cursor as HOST copies, safe to
+        hand to an async writer: ``get_params`` is de-mesh-aware (mesh
+        globals are repointed to per-device arrays first) and the
+        ``asnumpy``/``get_states`` conversions below force the D2H copy
+        while the step's output buffers are still valid — before the next
+        fused step donates them.  Returns ``(tree, meta, blobs)`` for
+        :class:`~mxnet_tpu.checkpoint.TrainCheckpointer`."""
+        arg, aux = self.get_params()
+        tree = {}
+        for k, v in arg.items():
+            tree["param/%s" % k] = v.asnumpy()
+        for k, v in aux.items():
+            tree["aux/%s" % k] = v.asnumpy()
+        meta = {"epoch": int(epoch), "nbatch": int(nbatch),
+                "global_step": int(gstep)}
+        blobs = {}
+        updater = getattr(self, "_updater", None)
+        if updater is not None:
+            blobs["opt_states.bin"] = updater.get_states(
+                dump_optimizer=False)
+            optimizer = getattr(self, "_optimizer", None)
+            if optimizer is not None:
+                # Updater.get_states drops the per-slot update counts; an
+                # Adam resume without them restarts bias correction at
+                # t=0 and is NOT bit-exact — carry them in the marker
+                meta["index_update_count"] = {
+                    str(k): int(v)
+                    for k, v in optimizer._index_update_count.items()}
+                meta["num_update"] = int(optimizer.num_update)
+        return tree, meta, blobs
+
+    def _ft_restore(self, tree, meta, blobs):
+        """Inverse of :meth:`_ft_snapshot` on a bound module: write params
+        into every executor, rebuild updater states, restore the
+        optimizer's update counts (bit-exact lr schedules / Adam t)."""
+        arg = {k[len("param/"):]: nd.array(v) for k, v in tree.items()
+               if k.startswith("param/")}
+        aux = {k[len("aux/"):]: nd.array(v) for k, v in tree.items()
+               if k.startswith("aux/")}
+        self.set_params(arg, aux, force_init=True)
+        updater = getattr(self, "_updater", None)
+        if updater is not None and "opt_states.bin" in (blobs or {}):
+            updater.set_states(blobs["opt_states.bin"])
+            # pickled state dict keys arrive as-is, but slot indices may
+            # have been JSON-stringified in the meta — normalize to int
+            optimizer = getattr(self, "_optimizer", None)
+            if optimizer is not None:
+                counts = meta.get("index_update_count") or {}
+                optimizer._index_update_count = {
+                    (int(k) if str(k).lstrip("-").isdigit() else k): int(v)
+                    for k, v in counts.items()}
+                if "num_update" in meta:
+                    optimizer.num_update = int(meta["num_update"])
+                updater.optimizer = optimizer
 
     def install_monitor(self, mon):
         raise NotImplementedError

@@ -24,8 +24,10 @@ mirroring how DMLC_* variables drive the dist kvstore.
 """
 from __future__ import annotations
 
+import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 import time
@@ -37,27 +39,59 @@ __all__ = ["ElasticRunner", "run_elastic", "latest_checkpoint",
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
-def save_step(ckpt_dir: str, step: int, params) -> str:
-    """Write a step-numbered sharded checkpoint; returns its path."""
-    from ..checkpoint import save_sharded
+def save_step(ckpt_dir: str, step: int, params, keep: Optional[int] = None
+              ) -> str:
+    """Write a step-numbered sharded checkpoint; returns its path.
+
+    The write is two-phase: tensors first, then an atomic commit marker —
+    ``latest_checkpoint`` only considers marked directories, so a worker
+    killed mid-save can never poison the resume point.  After committing,
+    all but the newest ``keep`` committed checkpoints are pruned
+    (``MXNET_CKPT_KEEP``, default 3)."""
+    from ..base import get_env
+    from ..checkpoint import COMMIT_MARKER, save_sharded
     path = os.path.join(ckpt_dir, "step_%d" % step)
     save_sharded(path, params, force=True)
+    marker = os.path.join(path, COMMIT_MARKER)
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker)
+    if keep is None:
+        keep = get_env("MXNET_CKPT_KEEP", 3, int)
+    if keep and keep > 0:
+        committed = sorted(_committed_steps(ckpt_dir))
+        for old in committed[:-keep]:
+            shutil.rmtree(os.path.join(ckpt_dir, "step_%d" % old),
+                          ignore_errors=True)
     return path
 
 
-def latest_checkpoint(ckpt_dir: str):
-    """(step, path) of the newest complete checkpoint, or (None, None)."""
-    if not os.path.isdir(ckpt_dir):
-        return None, None
-    best = None
+def _committed_steps(ckpt_dir: str) -> List[int]:
+    from ..checkpoint import COMMIT_MARKER
+    steps = []
     for name in os.listdir(ckpt_dir):
         m = _STEP_RE.match(name)
-        if m:
-            step = int(m.group(1))
-            if best is None or step > best:
-                best = step
-    if best is None:
+        if m and os.path.exists(
+                os.path.join(ckpt_dir, name, COMMIT_MARKER)):
+            steps.append(int(m.group(1)))
+    return steps
+
+
+def latest_checkpoint(ckpt_dir: str):
+    """(step, path) of the newest COMMITTED checkpoint, or (None, None).
+
+    Uncommitted directories — a worker died between the tensor write and
+    the marker — are skipped, not errors: the previous committed step is
+    still a valid resume point."""
+    if not os.path.isdir(ckpt_dir):
         return None, None
+    committed = _committed_steps(ckpt_dir)
+    if not committed:
+        return None, None
+    best = max(committed)
     return best, os.path.join(ckpt_dir, "step_%d" % best)
 
 
@@ -102,12 +136,13 @@ class ElasticRunner:
 
     def __init__(self, cmd: Sequence[str], nworkers: int,
                  max_restarts: int = 3, env: Optional[dict] = None,
-                 poll_interval: float = 0.2):
+                 poll_interval: float = 0.2, restart_backoff: float = 0.2):
         self.cmd = list(cmd)
         self.nworkers = nworkers
         self.max_restarts = max_restarts
         self.env = dict(env or os.environ)
         self.poll_interval = poll_interval
+        self.restart_backoff = restart_backoff
         self.restarts = 0
 
     def _launch(self) -> List[subprocess.Popen]:
@@ -151,9 +186,19 @@ class ElasticRunner:
                 time.sleep(self.poll_interval)
             if not failed:
                 return self.restarts
+            cause = "worker_exit_%s" % next(
+                (c for c in codes if c not in (None, 0)), "unknown")
             self._reap(procs)
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 raise RuntimeError(
                     "elastic training failed: %d restarts exhausted"
                     % self.max_restarts)
+            from .. import runlog as _runlog
+            _runlog.event("elastic_restart", generation=self.restarts,
+                          cause=cause)
+            # brief backoff before relaunch: lets the dead gang's sockets
+            # leave TIME_WAIT and keeps a crash-looping worker from
+            # hot-spinning the supervisor
+            time.sleep(min(5.0, self.restart_backoff * (2 ** (
+                self.restarts - 1))))

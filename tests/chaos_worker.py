@@ -1,0 +1,91 @@
+"""Gang member for the chaos acceptance tests (ISSUE 13 tentpole).
+
+Launched by tests/test_chaos.py through ElasticRunner with a 3-member
+gang: elastic rank 0 becomes the parameter server (DMLC_ROLE=server with
+MXNET_KVSTORE_DURABLE_DIR), ranks 1..N become dist_async workers running
+a least-squares regression with a server-side optimizer.  Faults are
+injected by mxnet_tpu.chaos from MXNET_CHAOS_* env set by the test —
+worker death (MXNET_CHAOS_DIE_AT_STEP), server death
+(MXNET_CHAOS_DIE_AT_PUSH), wire faults (drop/delay/corrupt) — always
+gated to generation 0 via MXNET_CHAOS_ONLY_GEN, so the relaunched gang
+runs clean and the test can assert recovery.
+
+Each worker appends "gen step loss" lines to <logdir>/loss_rank<k>.log so
+the test can check the resumed loss trajectory continues where the killed
+generation left off instead of restarting from scratch.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+LOGDIR = sys.argv[1]
+TOTAL = int(sys.argv[2])
+
+ERANK = int(os.environ["MXNET_ELASTIC_RANK"])
+GEN = int(os.environ["MXNET_ELASTIC_RESTART"])
+NWORKERS = int(os.environ["MXNET_ELASTIC_NWORKERS"]) - 1  # minus server
+
+
+def run_server():
+    os.environ["DMLC_ROLE"] = "server"
+    os.environ["DMLC_NUM_WORKER"] = str(NWORKERS)
+    import mxnet_tpu as mx
+    mx.kv.create("dist_async")  # enters run_server(); returns on stop
+    sys.exit(0)
+
+
+def run_worker():
+    rank = ERANK - 1
+    os.environ["DMLC_ROLE"] = "worker"
+    os.environ["DMLC_NUM_WORKER"] = str(NWORKERS)
+    os.environ["DMLC_WORKER_ID"] = str(rank)
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import chaos, nd
+
+    kv = mx.kv.create("dist_async")
+    rng = np.random.RandomState(100 + rank)
+    w_true = np.array([[1.0], [-2.0], [3.0]], np.float32)
+    X = rng.randn(128, 3).astype(np.float32)
+    y = X @ w_true
+
+    kv.init("w", nd.zeros((3, 1)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    kv.barrier()
+
+    w = nd.zeros((3, 1))
+    log = open(os.path.join(LOGDIR, "loss_rank%d.log" % rank), "a")
+    for step in range(TOTAL):
+        kv.pull("w", out=w)
+        i = (step * 32) % 96
+        xb, yb = nd.array(X[i:i + 32]), nd.array(y[i:i + 32])
+        resid = nd.dot(xb, w) - yb
+        loss = float((resid.asnumpy() ** 2).mean())
+        log.write("%d %d %.6f\n" % (GEN, step, loss))
+        log.flush()
+        grad = nd.dot(xb.T, resid) / 32
+        kv.push("w", grad)
+        chaos.step(step + 1)
+    kv.barrier()
+
+    kv.pull("w", out=w)
+    err = float(np.abs(w.asnumpy() - w_true).max())
+    print("rank %d gen %d final err %.4f" % (rank, GEN, err))
+    assert err < 0.05, "chaos run did not converge: err=%.4f" % err
+    kv.barrier()
+    if rank == 0:
+        with open(os.path.join(LOGDIR, "final.txt"), "w") as f:
+            f.write("%g\n" % err)
+        kv.send_command_to_servers(0, "")  # kStopServer
+    kv.close()
+
+
+if __name__ == "__main__":
+    if ERANK == 0:
+        run_server()
+    else:
+        run_worker()

@@ -1,0 +1,56 @@
+"""Worker for the preempt-resume bit-exactness test (tests/test_elastic.py).
+
+Trains a small MLP through Module.fit with the async checkpointer wired
+(MXNET_CKPT_DIR / MXNET_CKPT_EVERY_N_STEPS).  The test runs it three
+ways: uninterrupted (reference), chaos-SIGTERMed mid-epoch (preemption:
+the handler writes a final sync checkpoint and exits 0), and resumed
+(chaos off via MXNET_ELASTIC_RESTART=1).  The resumed run's final params
+must equal the uninterrupted run's bit for bit.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.io import NDArrayIter  # noqa: E402
+from mxnet_tpu.module import Module  # noqa: E402
+
+OUT = sys.argv[1]
+NUM_EPOCH = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+
+
+def main():
+    rng = np.random.RandomState(0)
+    centers = rng.randn(3, 10) * 3
+    X = np.zeros((200, 10), np.float32)
+    y = np.zeros((200,), np.float32)
+    for i in range(200):
+        c = i % 3
+        X[i] = centers[c] + rng.randn(10) * 0.5
+        y[i] = c
+
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=3, name="fc2")
+    net = sym.SoftmaxOutput(h, name="softmax")
+
+    mx.random.seed(7)
+    mod = Module(net, context=mx.cpu())
+    it = NDArrayIter(X, y, batch_size=20)
+    mod.fit(it, num_epoch=NUM_EPOCH, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.01),))
+    arg, aux = mod.get_params()
+    np.savez(OUT, **{k: v.asnumpy() for k, v in
+                     list(arg.items()) + list(aux.items())})
+
+
+if __name__ == "__main__":
+    main()

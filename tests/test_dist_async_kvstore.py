@@ -147,9 +147,9 @@ class TestInProcess:
         sent = []
         orig = ps.send_msg
 
-        def spy(sock, obj):
+        def spy(sock, obj, **kw):
             sent.append(obj)
-            return orig(sock, obj)
+            return orig(sock, obj, **kw)
 
         ps.send_msg = spy
         try:

@@ -24,6 +24,71 @@ def test_latest_checkpoint_bookkeeping(tmp_path):
     np.testing.assert_allclose(np.asarray(got["w"]), 2.0)
 
 
+def test_partial_checkpoint_skipped(tmp_path):
+    """A step dir without the commit marker (writer died mid-save) must
+    be invisible to latest_checkpoint."""
+    d = str(tmp_path)
+    save_step(d, 5, {"w": np.ones((2,), np.float32)})
+    partial = os.path.join(d, "step_9")
+    os.makedirs(partial)
+    with open(os.path.join(partial, "garbage.bin"), "wb") as f:
+        f.write(b"\x00" * 16)
+    step, path = latest_checkpoint(d)
+    assert step == 5 and path.endswith("step_5")
+
+
+def test_save_step_keeps_last_k(tmp_path):
+    d = str(tmp_path)
+    for s in (5, 10, 15, 20):
+        save_step(d, s, {"w": np.full((2,), s, np.float32)}, keep=2)
+    kept = sorted(n for n in os.listdir(d) if n.startswith("step_"))
+    assert kept == ["step_15", "step_20"]
+    step, _ = latest_checkpoint(d)
+    assert step == 20
+
+
+@pytest.mark.slow
+def test_preempt_resume_bitexact(tmp_path):
+    """SIGTERM mid-epoch (preemption notice): fit writes a final sync
+    checkpoint and exits 0; the relaunched run must resume and finish
+    with params BIT-IDENTICAL to an uninterrupted run."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "preempt_worker.py")
+
+    def run(out, ckpt_dir, extra_env):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                    "MXNET_CKPT_DIR": ckpt_dir,
+                    "MXNET_CKPT_EVERY_N_STEPS": "5"})
+        env.update(extra_env)
+        return subprocess.run([sys.executable, worker, out, "2"],
+                              env=env, timeout=240)
+
+    ref = str(tmp_path / "ref.npz")
+    assert run(ref, str(tmp_path / "ckpt_a"), {}).returncode == 0
+
+    ckpt_b = str(tmp_path / "ckpt_b")
+    out_b = str(tmp_path / "resumed.npz")
+    preempted = run(out_b, ckpt_b,
+                    {"MXNET_CHAOS": "1",
+                     "MXNET_CHAOS_SIGTERM_AT_STEP": "7",
+                     "MXNET_CHAOS_ONLY_GEN": "0"})
+    assert preempted.returncode == 0          # clean handoff, not a crash
+    assert not os.path.exists(out_b)          # died before finishing
+    resumed = run(out_b, ckpt_b,
+                  {"MXNET_CHAOS": "1",
+                   "MXNET_CHAOS_SIGTERM_AT_STEP": "7",
+                   "MXNET_CHAOS_ONLY_GEN": "0",
+                   "MXNET_ELASTIC_RESTART": "1"})   # chaos gated off
+    assert resumed.returncode == 0
+
+    a, b = np.load(ref), np.load(out_b)
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
 def test_gang_restart_resumes_from_checkpoint(tmp_path):
     """Kill rank 0 mid-run (gen 0); the supervisor must restart the gang
     once and the second incarnation must resume from the last checkpoint,

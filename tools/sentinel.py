@@ -72,6 +72,9 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     "atlas_coverage_pct":           ("higher", 0.00, 5.0),
     "monitor_overhead_pct":         ("lower",  0.00, 1.0),
     "sampler_overhead_pct":         ("lower",  0.00, 1.0),
+    # donation-safe async checkpoints (ISSUE 13): amortized per-step cost
+    # of the live TrainCheckpointer; the acceptance bar is <3%
+    "checkpoint_overhead_pct":      ("lower",  0.00, 3.0),
     # cold-start currency (program_cache.py).  Lower is better; a warm
     # deploy (prefilled cache dir) improves 5x+ and always passes.  The
     # bands are generous because the COLD path is compile-time noise on
@@ -116,6 +119,7 @@ def _norm_bench_parsed(parsed: dict, source: str) -> dict:
     put("resnet50_step_spread_pct", parsed.get("step_spread_pct"))
     put("step_first_compile_seconds",
         parsed.get("step_first_compile_seconds"))
+    put("checkpoint_overhead_pct", parsed.get("checkpoint_overhead_pct"))
     lstm = parsed.get("lstm")
     if isinstance(lstm, dict) and "error" not in lstm:
         put("lstm_tokens_per_sec", lstm.get("value"))
