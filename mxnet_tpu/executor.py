@@ -320,6 +320,15 @@ class Executor:
         self.outputs_nd: List[Any] = []
         self._last_keys = None
         self._monitor = None
+        # mesh-sharded callers (serving mesh Predictor) set _mesh_sig —
+        # (mesh shape, sharding specs) — so forward programs specialised
+        # for one layout are never reused for another (PR 6 / GL001
+        # contract: everything that selects a program joins its cache
+        # key).  _program_prefix namespaces health.register_program names
+        # (e.g. "serving:<model>:b<bucket>:") so N models/buckets get N
+        # distinct /programz entries instead of overwriting "forward".
+        self._mesh_sig = None
+        self._program_prefix = ""
         self._grad_args = [n for n in self.arg_names
                            if grad_req.get(n, "null") != "null"]
 
@@ -362,8 +371,17 @@ class Executor:
                 placements, self._ctx.jax_device)
         return self._jitted[key]
 
+    def _mesh_key(self):
+        """Cache-key suffix for the bound mesh layout (empty off-mesh so
+        existing single-device keys are unchanged)."""
+        return (self._mesh_sig,) if self._mesh_sig is not None else ()
+
+    def _fwd_key(self, train: bool):
+        return ("fwd", bool(train)) + self._plan_env(train) \
+            + self._mesh_key()
+
     def _fwd_fn(self, train: bool):
-        key = ("fwd", train) + self._plan_env(train)
+        key = self._fwd_key(train)
         if key not in self._jitted:
             _program_cache.ensure_enabled()
             plan = self._plan(train)
@@ -395,9 +413,12 @@ class Executor:
             _program_cache.note_memory_hit()
         return self._jitted[key]
 
+    def _fwdbwd_key(self):
+        return ("fwdbwd",) + self._plan_env(True) + self._mesh_key()
+
     def _fwd_bwd_fn(self):
         """Single compiled program: forward + vjp-backward (+aux update)."""
-        key = ("fwdbwd",) + self._plan_env(True)
+        key = self._fwdbwd_key()
         if key not in self._jitted:
             _program_cache.ensure_enabled()
             plan = self._plan(True)
@@ -608,15 +629,17 @@ class Executor:
         # first_run marks the trace+compile invocation of this (mode,
         # shape-set) so recompiles stand out from steady-state iterations
         plan_env = self._plan_env_of(plan)
-        first_run = ("fwd", bool(is_train)) + plan_env not in self._jitted
+        first_run = self._fwd_key(is_train) not in self._jitted
         if _telemetry.enabled:
             # count per input-shape signature, not per _fwd_fn build: the
             # jitted fn silently recompiles on a new shape, and THAT is
             # the event a shape-bucketing layer must see (an env-flag
-            # toggle recompiles too — plan_env keeps the counter truthful)
+            # toggle recompiles too — plan_env keeps the counter truthful,
+            # and a mesh-layout change is a recompile the same way)
             skey = ("fwdsig", bool(is_train),
                     tuple(self.arg_dict[n].shape
-                          for n in self.arg_names)) + plan_env
+                          for n in self.arg_names)) + plan_env \
+                + self._mesh_key()
             if skey in self._jitted:
                 _PROG_HITS.labels(op="Executor::Forward").inc()
             else:
@@ -640,9 +663,9 @@ class Executor:
                 if first_run and _health.enabled:
                     # lowering-only analysis: the call below still owns
                     # the one and only compilation
-                    _health.register_program("forward", fwd,
-                                             (args, auxs, keys),
-                                             env=self._program_env(plan))
+                    _health.register_program(
+                        self._program_prefix + "forward", fwd,
+                        (args, auxs, keys), env=self._program_env(plan))
                 outs, new_aux = fwd(args, auxs, keys)
         if is_train:
             self._writeback_aux(new_aux)
@@ -667,15 +690,15 @@ class Executor:
             else self._keys(plan)
         args, auxs = self._gather()
         from . import profiler as _profiler
-        first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
+        first_run = self._fwdbwd_key() not in self._jitted
         with _profiler.span("Executor::BackwardDispatch", "executor",
                             histogram=_BWD_TIME,
                             args={"first_run": first_run}):
             fb = self._fwd_bwd_fn()
             if first_run and _health.enabled:
-                _health.register_program("fwdbwd", fb,
-                                         (args, auxs, keys, ogs),
-                                         env=self._program_env(plan))
+                _health.register_program(
+                    self._program_prefix + "fwdbwd", fb,
+                    (args, auxs, keys, ogs), env=self._program_env(plan))
             outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._apply_grads(grads)
         return
@@ -702,15 +725,15 @@ class Executor:
             ogs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
         from . import profiler as _profiler
-        first_run = ("fwdbwd",) + self._plan_env_of(plan) not in self._jitted
+        first_run = self._fwdbwd_key() not in self._jitted
         with _profiler.span("Executor::ForwardBackwardDispatch", "executor",
                             histogram=_FWDBWD_TIME,
                             args={"first_run": first_run}):
             fb = self._fwd_bwd_fn()
             if first_run and _health.enabled:
-                _health.register_program("fwdbwd", fb,
-                                         (args, auxs, keys, ogs),
-                                         env=self._program_env(plan))
+                _health.register_program(
+                    self._program_prefix + "fwdbwd", fb,
+                    (args, auxs, keys, ogs), env=self._program_env(plan))
             outs, new_aux, grads = fb(args, auxs, keys, ogs)
             self._writeback_aux(new_aux)
             self._apply_grads(grads)

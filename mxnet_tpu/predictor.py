@@ -9,6 +9,18 @@ the amalgamation/mobile builds.
 TPU-native: the bound graph compiles to ONE fused XLA inference program per
 input shape (the ``MXNET_PREDICT_ONLY`` engine fallback becomes simply "no
 gradient graph").
+
+Mesh-sharded inference: pass ``mesh=`` (a ``jax.sharding.Mesh``) and
+optionally ``sharding_rules=`` (a
+:class:`~mxnet_tpu.parallel.mesh.ShardingRules`; defaults to
+``megatron_rules`` when the mesh has a ``tp`` axis) and one large model
+spans every device in the mesh: parameters are ``device_put`` with their
+rule's ``NamedSharding``, inputs are replicated, and GSPMD partitions the
+single forward program — column-parallel FCs shard activations, row-
+parallel FCs insert the all-reduce, exactly the
+``parallel/tensor_parallel.py`` math without hand-written collectives.
+The mesh signature joins the executor's program cache key (PR 6 / GL001
+contract), so a (model, bucket, mesh) triple is one program.
 """
 from __future__ import annotations
 
@@ -43,10 +55,16 @@ class Predictor:
         or a path to a .params file.
     ctx : Context, optional
     input_shapes : dict of name -> shape
+    mesh : jax.sharding.Mesh, optional
+        Shard this predictor across the mesh (GSPMD tensor parallel).
+    sharding_rules : ShardingRules, optional
+        Parameter-name → PartitionSpec rules; defaults to
+        ``megatron_rules(mesh)`` when the mesh has a ``tp`` axis, else
+        fully replicated.
     """
 
     def __init__(self, symbol_json, params, ctx=None, input_shapes=None,
-                 dev_type=None, dev_id=0):
+                 dev_type=None, dev_id=0, mesh=None, sharding_rules=None):
         from . import context as _ctx_mod
         from . import ndarray as nd
         from . import symbol as sym_mod
@@ -54,6 +72,8 @@ class Predictor:
         if dev_type is not None:
             ctx = _ctx_mod.Context(dev_type, dev_id)
         self._ctx = ctx or _ctx_mod.current_context()
+        self._mesh = mesh
+        self._rules = self._default_rules(mesh, sharding_rules)
 
         if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
             with open(symbol_json) as f:
@@ -76,6 +96,15 @@ class Predictor:
             else:
                 self._arg_params[k] = v
         self._bind(input_shapes)
+
+    @staticmethod
+    def _default_rules(mesh, sharding_rules):
+        if mesh is None or sharding_rules is not None:
+            return sharding_rules
+        from .parallel.mesh import ShardingRules, megatron_rules
+        if "tp" in mesh.shape:
+            return megatron_rules(mesh)
+        return ShardingRules(mesh)
 
     def _bind(self, input_shapes):
         """Bind the (already parsed) symbol + params for these shapes."""
@@ -105,9 +134,41 @@ class Predictor:
                 # zero-filling e.g. BatchNorm moving_var would silently
                 # produce garbage inference — fail like the arg path does
                 raise MXNetError("missing auxiliary state %r" % name)
+        if self._mesh is not None:
+            self._shard_bindings(args, auxs, input_shapes)
         self._executor = self._symbol.bind(self._ctx, args, grad_req="null",
                                            aux_states=auxs)
+        if self._mesh is not None:
+            self._executor._mesh_sig = self._mesh_sig
         self._outputs = None
+
+    def _shard_bindings(self, args, auxs, input_shapes):
+        """Place every bound array on the mesh: params per the sharding
+        rules, inputs (and aux state) replicated.  Fresh NDArray wrappers
+        — the shared ``_arg_params`` objects are never mutated, so a
+        single-chip predictor over the same params stays untouched.
+        Also derives ``_mesh_sig``: (mesh axes/sizes, per-array spec) —
+        everything that selects the partitioned program."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .ndarray.ndarray import NDArray
+
+        replicated = NamedSharding(self._mesh, PartitionSpec())
+        self._replicated = replicated
+        specs = []
+        for pool in (args, auxs):
+            for name, arr in pool.items():
+                if name in input_shapes or pool is auxs:
+                    sh = replicated
+                else:
+                    sh = self._rules.sharding_for(name, arr.shape)
+                placed = jax.device_put(arr._data, sh)
+                pool[name] = NDArray(placed, self._ctx)
+                specs.append((name, str(sh.spec)))
+        self._mesh_sig = (
+            tuple(sorted((str(a), int(s))
+                         for a, s in self._mesh.shape.items())),
+            tuple(sorted(specs)))
 
     # ---- the C predict API surface ---------------------------------------
     def set_input(self, name, value):
@@ -124,7 +185,21 @@ class Predictor:
                              % (name, self._input_names))
         dst = self._executor.arg_dict[name]
         data = getattr(value, "_data", None)
-        if data is not None:                   # NDArray: stay on device
+        if self._mesh is not None:
+            # replicate the input across the mesh: GSPMD needs every
+            # operand of the partitioned program to carry a mesh sharding
+            # (mixing a single-device committed array with sharded params
+            # is an error, and an uncommitted one would recompile)
+            import jax
+            arr = data if data is not None \
+                else np.asarray(value, dtype=dst.dtype)
+            if tuple(arr.shape) != dst.shape:
+                raise MXNetError("input %r has shape %s, bound shape is %s"
+                                 % (name, tuple(arr.shape), dst.shape))
+            arr = jax.device_put(arr, self._replicated)
+            dst._data = arr if arr.dtype == dst.dtype \
+                else arr.astype(dst.dtype)
+        elif data is not None:                 # NDArray: stay on device
             if tuple(data.shape) != dst.shape:
                 raise MXNetError("input %r has shape %s, bound shape is %s"
                                  % (name, tuple(data.shape), dst.shape))
@@ -159,5 +234,30 @@ class Predictor:
         new._symbol = self._symbol
         new._arg_params = self._arg_params
         new._aux_params = self._aux_params
+        new._mesh = self._mesh
+        new._rules = self._rules
         new._bind(input_shapes)
         return new
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=True):
+        """Copy new weights into the bound executor (hot-swap path).
+
+        On a mesh predictor the copied values are re-``device_put`` to
+        each parameter's rule sharding afterwards — a plain elementwise
+        write would leave the array on GSPMD's choice of layout, and a
+        layout change would silently recompile the forward program on
+        the next request (exactly what the serving post-warmup-compile
+        contract forbids)."""
+        self._executor.copy_params_from(arg_params, aux_params,
+                                        allow_extra_params)
+        if self._mesh is None:
+            return
+        import jax
+        for name, arr in self._executor.arg_dict.items():
+            if name in self._input_names:
+                continue
+            sh = self._rules.sharding_for(name, arr.shape)
+            arr._data = jax.device_put(arr._data, sh)
+        for arr in self._executor.aux_dict.values():
+            arr._data = jax.device_put(arr._data, self._replicated)

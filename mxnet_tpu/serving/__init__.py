@@ -1,33 +1,44 @@
-"""Inference serving: dynamic batching model server with backpressure,
-deadlines, and hot-swap.
+"""Inference serving: a multi-model, SLO-aware gateway with dynamic
+batching, backpressure, deadlines, and hot-swap.
 
 The training side compiles one whole-step XLA program; this package is
 the inference mirror of that discipline.  A :class:`ModelServer` wraps a
 forward-only :class:`~mxnet_tpu.predictor.Predictor` per declared batch
 bucket (power-of-two padded batch sizes), coalesces concurrent requests
-in a bounded queue (:mod:`~mxnet_tpu.serving.batcher`), pads each batch
-to its bucket, and slices results back per request — so the steady-state
-compiled-program count is ``len(batch_buckets)``, not one per observed
-traffic shape.  Overload rejects at admission (backpressure), expired
-deadlines drop before execution, weights hot-swap atomically between
-batches, and a stdlib JSON endpoint (:mod:`~mxnet_tpu.serving.http`)
-serves it over HTTP.  See docs/serving.md.
+in a bounded queue, pads each batch to its bucket, and slices results
+back per request — so the steady-state compiled-program count is
+``len(batch_buckets)``, not one per observed traffic shape.  Scheduling
+is SLO-aware (:mod:`~mxnet_tpu.serving.scheduler`): requests carry a
+class (``realtime`` > ``standard`` > ``batch``), batches form by class
+priority with EDF inside a class, and admission control sheds the
+lowest class first as the queue saturates or health degrades (HTTP 429
++ Retry-After).  A :class:`ModelRegistry`
+(:mod:`~mxnet_tpu.serving.registry`) hosts N named models — independent
+ladders, warmup, and hot-swap — and a mesh-sharded Predictor
+(``mesh=``) spans one large model across local chips via GSPMD.
+Weights hot-swap atomically between batches, and a stdlib JSON endpoint
+(:mod:`~mxnet_tpu.serving.http`) serves it all over HTTP.  See
+docs/serving.md.
 
     from mxnet_tpu import serving
-    srv = serving.ModelServer(sym.tojson(), params,
-                              example_shapes={"data": (3, 224, 224)},
-                              max_batch_size=8).start()
-    out = srv.predict({"data": image})          # batched under the hood
-    port = serving.start_http_server(srv, port=8080)
+    reg = serving.ModelRegistry()
+    reg.register("m1", sym.tojson(), params,
+                 example_shapes={"data": (3, 224, 224)}, max_batch_size=8)
+    out = reg.predict({"data": image}, model="m1", slo_class="realtime",
+                      deadline_ms=50)
+    port = serving.start_http_server(reg, port=8080)
 """
 from __future__ import annotations
 
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       Request, ServerClosedError, ServingError, pow2_buckets)
+from .scheduler import SLO_CLASSES, AdmissionError, SloScheduler
 from .server import ModelServer, ServingConfig
+from .registry import ModelRegistry, UnknownModelError
 from .http import start_http_server, stop_http_server
 
-__all__ = ["ModelServer", "ServingConfig", "DynamicBatcher", "Request",
+__all__ = ["ModelServer", "ModelRegistry", "ServingConfig",
+           "DynamicBatcher", "SloScheduler", "Request", "SLO_CLASSES",
            "ServingError", "QueueFullError", "DeadlineExceededError",
-           "ServerClosedError", "pow2_buckets", "start_http_server",
-           "stop_http_server"]
+           "ServerClosedError", "AdmissionError", "UnknownModelError",
+           "pow2_buckets", "start_http_server", "stop_http_server"]

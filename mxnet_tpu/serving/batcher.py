@@ -69,17 +69,22 @@ class Request:
 
     ``inputs`` maps input name -> np.ndarray of shape ``(rows, *example)``;
     a request may carry several examples (``rows`` >= 1).  ``deadline``
-    is an absolute ``time.monotonic()`` instant or None.
+    is an absolute ``time.monotonic()`` instant or None.  ``slo_class``
+    is the scheduling class (see :mod:`~mxnet_tpu.serving.scheduler`);
+    the plain FIFO batcher ignores it.
     """
 
-    __slots__ = ("inputs", "rows", "deadline", "submit_t", "dequeue_t",
-                 "outcome", "flow_id", "_event", "_outputs", "_error")
+    __slots__ = ("inputs", "rows", "deadline", "slo_class", "submit_t",
+                 "dequeue_t", "outcome", "flow_id", "_event", "_outputs",
+                 "_error")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 slo_class: str = "standard"):
         self.inputs = inputs
         self.rows = int(rows)
         self.deadline = deadline
+        self.slo_class = slo_class
         self.submit_t = time.monotonic()
         self.dequeue_t = None
         self.outcome = None          # ok | rejected | deadline | error

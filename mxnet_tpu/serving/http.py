@@ -1,4 +1,5 @@
-"""Stdlib JSON inference endpoint over a :class:`ModelServer`.
+"""Stdlib JSON inference endpoint over a :class:`ModelServer` or a
+multi-model :class:`~mxnet_tpu.serving.registry.ModelRegistry`.
 
 Same pattern as ``telemetry/export.py``: ``http.server`` on daemon
 threads, loopback bind by default (the wire is unauthenticated JSON —
@@ -7,17 +8,23 @@ exposing it wider is an explicit operator choice via
 
 Routes::
 
-    POST /predict        {"inputs": {name: nested list}, "deadline_ms": n?}
+    POST /predict        {"inputs": {name: nested list}, "deadline_ms": n?,
+                          "model": "name"?, "slo_class": "realtime|standard|batch"?}
                          -> 200 {"outputs": [...], "rows": n}
     GET  /healthz        -> 200 {"status": "serving", ...verdict} when
                          healthy; 503 {"status": "degraded",
                          "causes": [...]} on queue saturation, post-warmup
                          compiles, or a high deadline-miss rate
-    GET  /stats          -> 200 server stats JSON
+    GET  /stats          -> 200 server (or per-model registry) stats JSON
+    GET  /models         -> 200 {"models": [names]} (registry only)
 
 Overload maps to status codes a load balancer understands: 503 for
-queue-full rejection and shutdown (retryable elsewhere), 504 for an
-expired deadline, 400 for malformed requests.
+queue-full rejection and shutdown (retryable elsewhere), **429 +
+Retry-After** when admission control sheds the request's SLO class, 504
+for an expired deadline, **404** for an unknown model name, **413** for
+a request body over ``MXNET_SERVING_MAX_BODY_BYTES`` (default 8 MiB —
+an unbounded read would let one client buffer arbitrary memory in the
+server), 400 for malformed requests.
 """
 from __future__ import annotations
 
@@ -25,8 +32,12 @@ import json
 import threading
 
 from ..base import get_env
+from .. import telemetry as _telemetry
 from .batcher import (DeadlineExceededError, QueueFullError,
                       ServerClosedError, ServingError)
+from .registry import ModelRegistry, UnknownModelError
+from .scheduler import AdmissionError
+from .server import _REQS
 
 __all__ = ["start_http_server", "stop_http_server"]
 
@@ -35,22 +46,31 @@ _server_thread = None
 _server_lock = threading.Lock()
 
 
-def start_http_server(model_server, port=None, host=None):
-    """Serve the inference endpoint for ``model_server`` on a daemon
-    thread; returns the bound port (``port=0`` picks a free one)."""
+def start_http_server(model_server, port=None, host=None,
+                      max_body_bytes=None):
+    """Serve the inference endpoint for ``model_server`` (a ModelServer
+    or a ModelRegistry) on a daemon thread; returns the bound port
+    (``port=0`` picks a free one)."""
     import http.server
 
     if port is None:
         port = get_env("MXNET_SERVING_PORT", 0, int)
     if host is None:
         host = get_env("MXNET_SERVING_HOST", "127.0.0.1")
+    if max_body_bytes is None:
+        max_body_bytes = get_env("MXNET_SERVING_MAX_BODY_BYTES",
+                                 8 << 20, int)
+    max_body_bytes = int(max_body_bytes)
+    is_registry = isinstance(model_server, ModelRegistry)
 
     class Handler(http.server.BaseHTTPRequestHandler):
-        def _reply(self, code, doc):
+        def _reply(self, code, doc, headers=None):
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -62,6 +82,8 @@ def start_http_server(model_server, port=None, host=None):
                     503 if doc.get("status") == "degraded" else 200, doc)
             elif path == "/stats":
                 self._reply(200, model_server.stats())
+            elif path == "/models" and is_registry:
+                self._reply(200, {"models": model_server.models()})
             else:
                 self.send_error(404)
 
@@ -71,17 +93,53 @@ def start_http_server(model_server, port=None, host=None):
                 self.send_error(404)
                 return
             try:
-                length = int(self.headers.get("Content-Length", 0))
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                self._reply(400, {"error": "bad Content-Length"})
+                return
+            if length > max_body_bytes:
+                # reject BEFORE reading: the bound is the whole point.
+                # The unread body makes the connection unreusable.
+                if _telemetry.enabled:
+                    _REQS.labels(outcome="too_large").inc()
+                self.close_connection = True
+                self._reply(413, {
+                    "error": "request body %d bytes > limit %d "
+                             "(MXNET_SERVING_MAX_BODY_BYTES)"
+                             % (length, max_body_bytes),
+                    "outcome": "too_large"})
+                return
+            try:
                 doc = json.loads(self.rfile.read(length) or b"{}")
                 inputs = doc["inputs"]
                 if not isinstance(inputs, dict):
                     raise ValueError("inputs must be an object")
                 deadline_ms = doc.get("deadline_ms")
+                slo_class = doc.get("slo_class") or "standard"
+                model = doc.get("model")
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": "bad request: %s" % e})
                 return
             try:
-                outs = model_server.predict(inputs, deadline_ms=deadline_ms)
+                if is_registry:
+                    outs = model_server.predict(
+                        inputs, model=model, deadline_ms=deadline_ms,
+                        slo_class=slo_class)
+                else:
+                    if model is not None and \
+                            model != getattr(model_server, "name", model):
+                        raise UnknownModelError(
+                            "unknown model %r (serving %r)"
+                            % (model, model_server.name))
+                    outs = model_server.predict(
+                        inputs, deadline_ms=deadline_ms,
+                        slo_class=slo_class)
+            except UnknownModelError as e:
+                self._reply(404, {"error": str(e)})
+            except AdmissionError as e:
+                self._reply(429, {"error": str(e), "outcome": "shed"},
+                            headers={"Retry-After":
+                                     "%.3f" % e.retry_after_s})
             except (QueueFullError, ServerClosedError) as e:
                 self._reply(503, {"error": str(e), "outcome": "rejected"})
             except DeadlineExceededError as e:

@@ -39,6 +39,7 @@ from .. import tracing as _tracing
 from .. import program_cache as _program_cache
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       Request, ServerClosedError, ServingError, pow2_buckets)
+from .scheduler import SLO_CLASSES, AdmissionError, SloScheduler
 
 __all__ = ["ServingConfig", "ModelServer"]
 
@@ -66,6 +67,21 @@ _WARMUP_TIME = _telemetry.gauge(
     "serving_warmup_seconds",
     "Wall time of the last warmup(): bucket-ladder trace+compile (cold) "
     "or program-cache restore (warm deploy)")
+_SHED = _telemetry.counter(
+    "serving_shed_total",
+    "Requests shed by SLO admission control (429), by class",
+    ("slo_class",))
+_ADMISSION_LEVEL = _telemetry.gauge(
+    "serving_admission_level",
+    "Current shed level: 0 admit all, 1 shed batch, 2 shed standard too")
+_SLO_REQS = _telemetry.counter(
+    "serving_slo_requests_total",
+    "Serving requests by SLO class and final outcome",
+    ("slo_class", "outcome"))
+_MODEL_REQS = _telemetry.counter(
+    "serving_model_requests_total",
+    "Serving requests by model and final outcome",
+    ("model", "outcome"))
 
 
 class ServingConfig:
@@ -74,7 +90,9 @@ class ServingConfig:
 
     def __init__(self, max_batch_size=None, batch_buckets=None,
                  batch_timeout_ms=None, queue_depth=None,
-                 default_deadline_ms=None, num_workers=None):
+                 default_deadline_ms=None, num_workers=None,
+                 shed_batch_at=None, shed_standard_at=None,
+                 retry_after_ms=None):
         if max_batch_size is None:
             max_batch_size = get_env("MXNET_SERVING_MAX_BATCH", 8, int)
         if batch_timeout_ms is None:
@@ -94,12 +112,24 @@ class ServingConfig:
                     int(b) for b in env_buckets.split(",") if b.strip())
             else:
                 batch_buckets = pow2_buckets(int(max_batch_size))
+        if shed_batch_at is None:
+            shed_batch_at = get_env("MXNET_SERVING_SHED_BATCH_AT", 0.5,
+                                    float)
+        if shed_standard_at is None:
+            shed_standard_at = get_env("MXNET_SERVING_SHED_STANDARD_AT",
+                                       0.8, float)
+        if retry_after_ms is None:
+            retry_after_ms = get_env("MXNET_SERVING_RETRY_AFTER_MS", 50.0,
+                                     float)
         self.max_batch_size = int(max_batch_size)
         self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
         self.batch_timeout_ms = float(batch_timeout_ms)
         self.queue_depth = int(queue_depth)
         self.default_deadline_ms = float(default_deadline_ms)
         self.num_workers = max(1, int(num_workers))
+        self.shed_batch_at = float(shed_batch_at)
+        self.shed_standard_at = float(shed_standard_at)
+        self.retry_after_ms = float(retry_after_ms)
 
 
 class ModelServer:
@@ -114,10 +144,20 @@ class ModelServer:
         batch dimension itself.
     config : ServingConfig, optional
         Extra keyword arguments build one (``max_batch_size=...`` etc.).
+    name : str
+        Model name — namespaces this server's /programz program entries
+        (``serving:<name>:b<bucket>:forward``) and its per-model metrics;
+        the key it registers under in a :class:`ModelRegistry`.
+    mesh, sharding_rules
+        Forwarded to :class:`~mxnet_tpu.predictor.Predictor`: shard this
+        model's parameters across the mesh (GSPMD tensor parallel); one
+        program per (model, bucket, mesh) — the mesh signature joins the
+        forward cache key.
     """
 
     def __init__(self, symbol_json, params, example_shapes,
                  ctx=None, config: Optional[ServingConfig] = None,
+                 name: str = "default", mesh=None, sharding_rules=None,
                  **config_kwargs):
         from ..predictor import Predictor
 
@@ -127,22 +167,36 @@ class ModelServer:
             raise ServingError("pass either config= or config kwargs, "
                                "not both")
         self.config = config
+        self.name = str(name)
+        self._mesh = mesh
         self._example_shapes = {k: tuple(int(d) for d in s)
                                 for k, s in dict(example_shapes).items()}
         if not self._example_shapes:
             raise ServingError("example_shapes must name at least one input")
-        self._batcher = DynamicBatcher(
+        self._batcher = SloScheduler(
             config.batch_buckets, config.max_batch_size,
-            config.batch_timeout_ms, config.queue_depth)
+            config.batch_timeout_ms, config.queue_depth,
+            shed_batch_at=config.shed_batch_at,
+            shed_standard_at=config.shed_standard_at,
+            retry_after_ms=config.retry_after_ms)
+        self._batcher.on_level_change = self._on_admission_level
+        self._admission_checked_at = 0.0
 
         # one predictor per bucket, sharing symbol/params via reshape
         buckets = self._batcher.buckets
         base = Predictor(symbol_json, params, ctx=ctx, input_shapes={
-            k: (buckets[-1],) + s for k, s in self._example_shapes.items()})
+            k: (buckets[-1],) + s for k, s in self._example_shapes.items()},
+            mesh=mesh, sharding_rules=sharding_rules)
         self._predictors = {buckets[-1]: base}
         for b in buckets[:-1]:
             self._predictors[b] = base.reshape(
                 {k: (b,) + s for k, s in self._example_shapes.items()})
+        for b, pred in self._predictors.items():
+            # distinct health/atlas program names per (model, bucket):
+            # N models on one process attribute cost side by side on
+            # /programz instead of overwriting one "forward" entry
+            pred._executor._program_prefix = "serving:%s:b%d:" \
+                % (self.name, b)
 
         self._swap_lock = threading.Lock()
         self._workers: List[threading.Thread] = []
@@ -196,8 +250,10 @@ class ModelServer:
             _WARMUP_TIME.set(self.warmup_seconds)
         from .. import runlog as _runlog
         _runlog.event("serving_warmup",
+                      model=self.name,
                       seconds=round(self.warmup_seconds, 6),
                       buckets=list(self._batcher.buckets),
+                      mesh=self._mesh_axes(),
                       program_cache=_program_cache.stats())
         # per-server baseline, not the global op_jit_cache counters (other
         # executors in the process would pollute a global delta): anything
@@ -253,41 +309,85 @@ class ModelServer:
             raise ServingError("request carries zero rows")
         return feed, rows
 
-    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Request:
+    def _update_admission(self):
+        """Rate-limited re-evaluation of the health verdict into the
+        scheduler's shed floor: a degraded server (post-warmup compiles,
+        deadline misses, saturation) sheds ``batch`` traffic even before
+        occupancy alone would."""
+        now = time.monotonic()
+        if now - self._admission_checked_at < 0.2:
+            return
+        self._admission_checked_at = now
+        causes = [c for c in self.health()["causes"] if c != "stopped"]
+        self._batcher.set_shed_floor(1 if causes else 0)
+
+    def _on_admission_level(self, level, prev, occupancy):
+        """Scheduler shed-level transition observer (called outside the
+        scheduler lock): gauge + a durable admission_state ledger event,
+        edge-triggered like the healthz flips it sits next to in
+        ``runlog merge`` timelines."""
+        if _telemetry.enabled:
+            _ADMISSION_LEVEL.set(level)
+        from .. import runlog as _runlog
+        _runlog.event("admission_state", model=self.name,
+                      level=int(level), prev_level=int(prev),
+                      occupancy=round(float(occupancy), 4),
+                      shedding=list(SLO_CLASSES[3 - level:]) if level else [])
+
+    def submit(self, inputs, deadline_ms: Optional[float] = None,
+               slo_class: str = "standard") -> Request:
         """Admit one request; returns a :class:`Request` future.
 
         Raises :class:`QueueFullError` when the bounded queue is full,
+        :class:`AdmissionError` when admission control is shedding
+        ``slo_class`` (HTTP 429; carries ``retry_after_s``),
         :class:`ServerClosedError` after shutdown, :class:`ServingError`
         on malformed inputs.  ``deadline_ms`` (or the configured
         ``MXNET_SERVING_DEADLINE_MS`` default) bounds end-to-end latency:
-        requests still queued past the deadline are dropped unexecuted.
+        requests still queued past the deadline are dropped unexecuted
+        (and order execution within a class — EDF).
         """
         feed, rows = self._validate(inputs)
+        if slo_class not in SLO_CLASSES:
+            raise ServingError("unknown slo_class %r (one of %s)"
+                               % (slo_class, list(SLO_CLASSES)))
         if deadline_ms is None and self.config.default_deadline_ms > 0:
             deadline_ms = self.config.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = Request(feed, rows, deadline)
+        req = Request(feed, rows, deadline, slo_class=slo_class)
         if _tracing.enabled:
             with _tracing.span("Serving::Submit", "serving",
-                               args={"rows": rows}) as sp:
+                               args={"rows": rows,
+                                     "slo_class": slo_class}) as sp:
                 req.flow_id = sp.span_id
                 sp.flow_out("serving_flow")
+        self._update_admission()
         try:
             self._batcher.put(req)
+        except AdmissionError as e:
+            req._fail(e, "shed")
+            if _telemetry.enabled:
+                _REQS.labels(outcome="shed").inc()
+                _SHED.labels(slo_class=slo_class).inc()
+                self._count_slo(req, "shed")
+            raise
         except (QueueFullError, ServerClosedError) as e:
             req._fail(e, "rejected")
             if _telemetry.enabled:
                 _REQS.labels(outcome="rejected").inc()
+                self._count_slo(req, "rejected")
             raise
         if _telemetry.enabled:
             _QUEUE_DEPTH.set(len(self._batcher))
         return req
 
-    def predict(self, inputs, deadline_ms=None, timeout=30.0):
+    def predict(self, inputs, deadline_ms=None, timeout=30.0,
+                slo_class: str = "standard"):
         """Synchronous convenience: submit + wait; returns the list of
         per-output arrays, each ``(rows, *out_shape)``."""
-        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(inputs, deadline_ms=deadline_ms,
+                           slo_class=slo_class).result(timeout)
 
     # -- hot swap ----------------------------------------------------------
     def swap_params(self, params, aux_params=None):
@@ -309,10 +409,16 @@ class ModelServer:
                 args[k] = v
         with self._swap_lock:
             for pred in self._predictors.values():
-                pred._executor.copy_params_from(
-                    args, auxs or None, allow_extra_params=True)
+                # predictor-level copy: re-pins mesh shardings so a swap
+                # on a mesh model can't shift a layout and force a
+                # post-warmup recompile
+                pred.copy_params_from(args, auxs or None,
+                                      allow_extra_params=True)
         if _telemetry.enabled:
             _SWAPS.inc()
+        from .. import runlog as _runlog
+        _runlog.event("model_hot_swap", model=self.name,
+                      params=len(args), aux=len(auxs))
 
     # -- execution ---------------------------------------------------------
     def _worker_loop(self):
@@ -387,10 +493,16 @@ class ModelServer:
             outs = pred.forward(**feed)
         return [o.asnumpy() for o in outs]
 
+    def _count_slo(self, req, outcome):
+        _SLO_REQS.labels(slo_class=getattr(req, "slo_class", "standard"),
+                         outcome=outcome).inc()
+        _MODEL_REQS.labels(model=self.name, outcome=outcome).inc()
+
     def _finish(self, req, error, outcome):
         self._recent_outcomes.append(outcome)
         if _telemetry.enabled:
             _REQS.labels(outcome=outcome).inc()
+            self._count_slo(req, outcome)
             _E2E_TIME.observe(time.monotonic() - req.submit_t)
         if error is None:
             req.outcome = "ok"
@@ -457,14 +569,32 @@ class ModelServer:
             **self.stats(),
         }
 
+    def _mesh_axes(self):
+        if self._mesh is None:
+            return None
+        return {str(a): int(s) for a, s in self._mesh.shape.items()}
+
+    def program_names(self) -> List[str]:
+        """This model's registered /programz entries
+        (``serving:<name>:b<bucket>:forward``) — per-model cost
+        attribution when N models share one process."""
+        from .. import health as _health
+        prefix = "serving:%s:" % self.name
+        return sorted(n for n in _health.programs() if n.startswith(prefix))
+
     def stats(self) -> Dict[str, object]:
         return {
+            "model": self.name,
             "buckets": list(self._batcher.buckets),
             "max_batch_size": self.config.max_batch_size,
             "batch_timeout_ms": self.config.batch_timeout_ms,
             "queue_depth": len(self._batcher),
             "queue_capacity": self.config.queue_depth,
             "rows_queued": self._batcher.rows_queued,
+            "queued_by_class": self._batcher.queued_by_class(),
+            "admission_level": self._batcher.level,
+            "mesh": self._mesh_axes(),
+            "programs": self.program_names(),
             "workers": len(self._workers),
             "started": self._started,
             "stopped": self._stopped,

@@ -1,0 +1,691 @@
+"""Multi-model serving gateway: SLO scheduler, ModelRegistry, mesh predictor.
+
+Covers the :class:`SloScheduler` in isolation (class priority, EDF within
+class, FIFO degeneration for deadline-less standard traffic, occupancy
+shedding thresholds batch -> standard -> queue-full, health shed floor,
+no-overtaking batch formation), the :class:`ModelRegistry` (two-model
+bit-identity, per-model /programz attribution, registry-wide zero
+post-warmup compiles, hot-swap of model A while model B serves under
+load, unregister routing), the mesh-sharded Predictor (bit-identical to
+single-chip on virtual devices, zero post-warmup compiles across mixed
+buckets), HTTP gateway routing (per-model routing, 404 unknown model,
+413 oversized body, 429 shed with Retry-After), and the 2-model +
+2-SLO-class acceptance scenario: under saturation batch traffic is shed
+*before* any realtime deadline is missed.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving, telemetry, tracing
+from mxnet_tpu import health as health_mod
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import (AdmissionError, DeadlineExceededError,
+                               ModelRegistry, ModelServer, QueueFullError,
+                               Request, ServingError, SloScheduler,
+                               UnknownModelError, SLO_CLASSES)
+
+S = mx.symbol
+
+
+def _mlp(seed=7):
+    """data (n, 8) -> FC16 relu -> FC5 softmax; fixed random params."""
+    x = S.var("data")
+    h = S.Activation(S.FullyConnected(x, num_hidden=16, name="fc1"),
+                     act_type="relu")
+    out = S.softmax(S.FullyConnected(h, num_hidden=5, name="fc2"),
+                    axis=1, name="prob")
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = out.infer_shape(data=(1, 8))
+    params = {n: nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+              for n, s in zip(out.list_arguments(), shapes) if n != "data"}
+    return out, params
+
+
+def _int_mlp(seed=3):
+    """Same MLP with small *integer-valued* float32 weights: every matmul
+    partial sum is exact in float32 regardless of reduction order, so a
+    mesh-partitioned forward must be bit-identical to single-chip."""
+    x = S.var("data")
+    h = S.Activation(S.FullyConnected(x, num_hidden=16, name="fc1"),
+                     act_type="relu")
+    out = S.FullyConnected(h, num_hidden=4, name="fc2")
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = out.infer_shape(data=(1, 8))
+    params = {n: nd.array(rng.randint(-2, 3, s).astype(np.float32))
+              for n, s in zip(out.list_arguments(), shapes) if n != "data"}
+    return out, params
+
+
+def _linear(scale):
+    """data (n, 8) -> FC4 no-bias with W = scale * ones."""
+    x = S.var("data")
+    out = S.FullyConnected(x, num_hidden=4, no_bias=True, name="fc")
+    params = {"fc_weight": nd.array(np.full((4, 8), scale, np.float32))}
+    return out, params
+
+
+def _tp_mesh(size=2):
+    import jax
+    from mxnet_tpu.parallel.mesh import make_mesh
+    devs = jax.devices()
+    if len(devs) < size:
+        pytest.skip("needs %d virtual devices" % size)
+    return make_mesh({"tp": size}, devices=devs[:size])
+
+
+def _req(rows=1, deadline=None, slo_class="standard"):
+    return Request({"data": np.zeros((rows, 8), np.float32)}, rows,
+                   deadline=deadline, slo_class=slo_class)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    yield
+    serving.stop_http_server()
+    telemetry.disable()
+    tracing.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# SloScheduler semantics (no model involved)
+# ---------------------------------------------------------------------------
+class TestSloScheduler:
+    def _sched(self, **kw):
+        kw.setdefault("batch_buckets", (1, 2, 4, 8))
+        kw.setdefault("max_batch_size", 8)
+        kw.setdefault("batch_timeout_ms", 0.0)
+        kw.setdefault("queue_depth", 16)
+        return SloScheduler(**kw)
+
+    def test_priority_classes_order_batches(self):
+        s = self._sched()
+        rb = _req(slo_class="batch")
+        rs = _req(slo_class="standard")
+        rr = _req(slo_class="realtime")
+        for r in (rb, rs, rr):          # submitted worst-first
+            s.put(r)
+        batch = s.get_batch()
+        assert batch == [rr, rs, rb]    # popped best-first
+
+    def test_edf_within_class(self):
+        s = self._sched()
+        now = time.monotonic()
+        late = _req(deadline=now + 9.0, slo_class="realtime")
+        soon = _req(deadline=now + 1.0, slo_class="realtime")
+        mid = _req(deadline=now + 5.0, slo_class="realtime")
+        for r in (late, soon, mid):
+            s.put(r)
+        assert s.get_batch() == [soon, mid, late]
+
+    def test_deadline_less_standard_is_fifo(self):
+        """Default-class deadline-less traffic degenerates to the old
+        FIFO batcher ordering exactly."""
+        s = self._sched()
+        reqs = [_req() for _ in range(6)]
+        for r in reqs:
+            s.put(r)
+        assert s.get_batch() == reqs
+
+    def test_no_overtaking_across_classes(self):
+        """A standard head that doesn't fit blocks batch-class traffic
+        behind it — lower classes never overtake a starving higher one."""
+        s = self._sched(max_batch_size=4, batch_buckets=(1, 2, 4))
+        first = _req(rows=3, slo_class="standard")
+        big = _req(rows=3, slo_class="standard")      # won't fit after first
+        sneaky = _req(rows=1, slo_class="batch")      # would fit; must wait
+        for r in (first, big, sneaky):
+            s.put(r)
+        assert s.get_batch() == [first]
+        assert s.get_batch() == [big, sneaky]
+
+    def test_occupancy_sheds_batch_then_standard(self):
+        s = self._sched(queue_depth=10)
+        for _ in range(5):                     # occupancy hits 0.5
+            s.put(_req())
+        with pytest.raises(AdmissionError) as ei:
+            s.put(_req(slo_class="batch"))
+        assert ei.value.retry_after_s > 0
+        assert s.level == 1
+        s.put(_req(slo_class="standard"))      # still admitted at level 1
+        for _ in range(2):
+            s.put(_req(slo_class="realtime"))  # occupancy hits 0.8
+        with pytest.raises(AdmissionError):
+            s.put(_req(slo_class="standard"))
+        assert s.level == 2
+        s.put(_req(slo_class="realtime"))      # realtime rides to the top
+        s.put(_req(slo_class="realtime"))
+        assert len(s) == 10
+        with pytest.raises(QueueFullError):
+            s.put(_req(slo_class="realtime"))  # genuinely full: hard reject
+
+    def test_shed_floor_from_health(self):
+        """A degraded server's shed floor sheds batch traffic even with
+        an empty queue; clearing the floor re-admits."""
+        s = self._sched()
+        assert s.level == 0
+        s.set_shed_floor(1)
+        assert s.level == 1
+        with pytest.raises(AdmissionError):
+            s.put(_req(slo_class="batch"))
+        s.put(_req(slo_class="standard"))
+        s.set_shed_floor(0)
+        s.put(_req(slo_class="batch"))
+        assert s.queued_by_class() == {"realtime": 0, "standard": 1,
+                                       "batch": 1}
+
+    def test_level_change_callback_fires_outside_lock(self):
+        seen = []
+
+        def observer(level, prev, occ):
+            # would deadlock if the scheduler still held its lock
+            seen.append((level, prev, len(s)))
+
+        s = self._sched(queue_depth=2)
+        s.on_level_change = observer
+        s.put(_req())
+        s.put(_req())                          # 1/2 = 0.5 -> level 1
+        assert seen and seen[-1][:2] == (1, 0)
+        s.get_batch()
+        s.put(_req())                          # back to 0 occupancy
+        assert seen[-1][:2] == (0, 1)
+
+    def test_drop_all_clears_every_class(self):
+        s = self._sched()
+        reqs = [_req(slo_class=c) for c in SLO_CLASSES for _ in range(2)]
+        for r in reqs:
+            s.put(r)
+        assert s.drop_all(lambda: ServingError("boom")) == 6
+        assert len(s) == 0 and s.rows_queued == 0
+        assert all(r.outcome == "error" for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry: N models, one gateway
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_two_models_bit_identical(self):
+        reg = ModelRegistry()
+        sym1, p1 = _mlp(seed=7)
+        sym2, p2 = _linear(2.0)
+        reg.register("mlp", sym1.tojson(), p1, {"data": (8,)},
+                     max_batch_size=4, batch_timeout_ms=1)
+        reg.register("lin", sym2.tojson(), p2, {"data": (8,)},
+                     max_batch_size=4, batch_timeout_ms=1)
+        try:
+            assert reg.models() == ["lin", "mlp"]
+            assert "mlp" in reg and len(reg) == 2
+            X = np.random.RandomState(0).uniform(-1, 1, (2, 8)) \
+                .astype(np.float32)
+            want1 = Predictor(sym1.tojson(), p1,
+                              input_shapes={"data": (2, 8)}) \
+                .forward(data=X)[0].asnumpy()
+            out1 = reg.predict({"data": X}, model="mlp")[0]
+            assert np.array_equal(out1, want1)
+            want2 = Predictor(sym2.tojson(), p2,
+                              input_shapes={"data": (2, 8)}) \
+                .forward(data=X)[0].asnumpy()
+            out2 = reg.predict({"data": X}, model="lin")[0]
+            assert np.array_equal(out2, want2)
+        finally:
+            reg.stop_all()
+
+    def test_unknown_duplicate_and_unregister(self):
+        reg = ModelRegistry()
+        sym, p = _linear(1.0)
+        reg.register("a", sym.tojson(), p, {"data": (8,)},
+                     max_batch_size=2, batch_timeout_ms=1)
+        try:
+            with pytest.raises(UnknownModelError):
+                reg.get("nope")
+            with pytest.raises(ServingError, match="already registered"):
+                reg.register("a", sym.tojson(), p, {"data": (8,)},
+                             max_batch_size=2)
+            # single model: name optional
+            out = reg.predict({"data": np.ones(8, np.float32)})
+            assert out[0].shape == (1, 4)
+            reg.register("b", sym.tojson(), p, {"data": (8,)},
+                         max_batch_size=2, batch_timeout_ms=1)
+            # two models: ambiguous routing must be loud
+            with pytest.raises(UnknownModelError, match="name required"):
+                reg.predict({"data": np.ones(8, np.float32)})
+            reg.unregister("b")
+            assert reg.models() == ["a"]
+            with pytest.raises(UnknownModelError):
+                reg.predict({"data": np.ones(8, np.float32)}, model="b")
+            with pytest.raises(UnknownModelError):
+                reg.unregister("b")
+        finally:
+            reg.stop_all()
+
+    def test_per_model_programz_attribution(self):
+        """Every (model, bucket) pair registers its own namespaced cost
+        entry on /programz — two models never overwrite each other."""
+        health_mod.enable()     # program registration is a health hook
+        health_mod.reset()
+        reg = ModelRegistry()
+        sym, p = _mlp()
+        reg.register("m1", sym.tojson(), p, {"data": (8,)},
+                     max_batch_size=2, batch_timeout_ms=1)
+        reg.register("m2", sym.tojson(), p, {"data": (8,)},
+                     max_batch_size=2, batch_timeout_ms=1)
+        try:
+            progs = health_mod.programs()
+            for m in ("m1", "m2"):
+                for b in (1, 2):
+                    assert "serving:%s:b%d:forward" % (m, b) in progs
+            assert reg.get("m1").program_names() == [
+                "serving:m1:b1:forward", "serving:m1:b2:forward"]
+            st = reg.stats()["models"]
+            assert st["m1"]["programs"] == reg.get("m1").program_names()
+            assert st["m2"]["model"] == "m2"
+        finally:
+            reg.stop_all()
+            health_mod.disable()
+            health_mod.reset()
+
+    def test_registry_zero_post_warmup_compiles(self):
+        """Mixed traffic over two warmed models compiles nothing: the
+        Executor::Forward miss counter is flat after both warmups."""
+        telemetry.enable()
+        reg = ModelRegistry()
+        for i, name in enumerate(("m1", "m2")):
+            sym, p = _mlp(seed=i)
+            reg.register(name, sym.tojson(), p, {"data": (8,)},
+                         max_batch_size=4, batch_timeout_ms=1)
+        try:
+            warm = telemetry.value("op_jit_cache_misses_total",
+                                   op="Executor::Forward")
+            rng = np.random.RandomState(1)
+            for i in range(12):
+                n = int(rng.choice([1, 2, 3, 4]))
+                X = rng.uniform(-1, 1, (n, 8)).astype(np.float32)
+                reg.predict({"data": X}, model=("m1", "m2")[i % 2])
+            after = telemetry.value("op_jit_cache_misses_total",
+                                    op="Executor::Forward")
+            assert after == warm, "post-warmup compiles: %d" % (after - warm)
+            for name in ("m1", "m2"):
+                assert reg.get(name).health()["post_warmup_compiles"] == 0
+        finally:
+            reg.stop_all()
+
+    def test_hot_swap_a_while_b_serves(self):
+        """Swap model A's weights repeatedly while model B takes traffic:
+        B's outputs never waver, A's outputs are always exactly one of
+        the two weight sets (atomic per batch)."""
+        reg = ModelRegistry()
+        sa, pa = _linear(1.0)
+        sb, pb = _linear(3.0)
+        reg.register("a", sa.tojson(), pa, {"data": (8,)},
+                     max_batch_size=4, batch_timeout_ms=1, queue_depth=64)
+        reg.register("b", sb.tojson(), pb, {"data": (8,)},
+                     max_batch_size=4, batch_timeout_ms=1, queue_depth=64)
+        X = np.ones((1, 8), np.float32)
+        errors, stop = [], threading.Event()
+
+        def client(model, valid):
+            while not stop.is_set():
+                try:
+                    out = reg.predict({"data": X}, model=model, timeout=30.0)
+                except ServingError as e:
+                    errors.append(repr(e))
+                    return
+                v = float(out[0][0, 0])
+                if not any(abs(v - w) < 1e-6 for w in valid):
+                    errors.append("%s: got %r want one of %r"
+                                  % (model, v, valid))
+                    return
+
+        threads = [threading.Thread(target=client, args=("a", (8.0, 16.0))),
+                   threading.Thread(target=client, args=("b", (24.0,)))]
+        for t in threads:
+            t.start()
+        w1 = {"fc_weight": np.full((4, 8), 1.0, np.float32)}
+        w2 = {"fc_weight": np.full((4, 8), 2.0, np.float32)}
+        try:
+            for i in range(30):
+                reg.swap_params("a", w2 if i % 2 == 0 else w1)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60.0)
+            reg.stop_all()
+        assert not errors, errors[:3]
+
+    def test_registry_health_namespaces_causes(self):
+        reg = ModelRegistry()
+        sym, p = _linear(1.0)
+        reg.register("a", sym.tojson(), p, {"data": (8,)},
+                     max_batch_size=2, batch_timeout_ms=1)
+        try:
+            doc = reg.health()
+            assert doc["status"] == "serving" and doc["causes"] == []
+            assert set(doc["models"]) == {"a"}
+        finally:
+            reg.stop_all()
+        doc = reg.health()          # registry empty now: nothing degraded
+        assert doc["status"] == "serving"
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded predictor (virtual devices via conftest XLA_FLAGS)
+# ---------------------------------------------------------------------------
+class TestMeshPredictor:
+    def test_mesh_parity_vs_single_chip(self):
+        """Integer-valued weights: the GSPMD-partitioned forward must be
+        bit-identical to the single-chip program for every bucket."""
+        mesh = _tp_mesh(2)
+        sym, params = _int_mlp()
+        X = np.random.RandomState(5).randint(-2, 3, (4, 8)) \
+            .astype(np.float32)
+        for n in (1, 2, 4):
+            single = Predictor(sym.tojson(), params,
+                               input_shapes={"data": (n, 8)})
+            sharded = Predictor(sym.tojson(), params,
+                                input_shapes={"data": (n, 8)}, mesh=mesh)
+            a = single.forward(data=X[:n])[0].asnumpy()
+            b = sharded.forward(data=X[:n])[0].asnumpy()
+            assert np.array_equal(a, b), "bucket %d diverged" % n
+
+    def test_mesh_sig_in_cache_key(self):
+        """Same symbol/shapes, different mesh -> different forward cache
+        keys (the PR 6 / GL001 mesh-signature contract)."""
+        mesh = _tp_mesh(2)
+        sym, params = _int_mlp()
+        plain = Predictor(sym.tojson(), params,
+                          input_shapes={"data": (2, 8)})
+        sharded = Predictor(sym.tojson(), params,
+                            input_shapes={"data": (2, 8)}, mesh=mesh)
+        assert sharded._executor._mesh_sig is not None
+        assert plain._executor._fwd_key(False) != \
+            sharded._executor._fwd_key(False)
+        axes = dict(sharded._executor._mesh_sig[0])
+        assert axes == {"tp": 2}
+
+    def test_mesh_server_zero_post_warmup_compiles(self):
+        """A mesh-sharded ModelServer under mixed-bucket traffic stays at
+        its warmup compile count, and its outputs match single-chip."""
+        telemetry.enable()
+        mesh = _tp_mesh(2)
+        sym, params = _int_mlp()
+        srv = ModelServer(sym.tojson(), params, example_shapes={"data": (8,)},
+                          name="meshy", mesh=mesh, max_batch_size=4,
+                          batch_timeout_ms=1)
+        srv.start()
+        # single-chip baselines compile BEFORE the warm snapshot: the
+        # Executor::Forward miss counter is process-global
+        baselines = {n: Predictor(sym.tojson(), params,
+                                  input_shapes={"data": (n, 8)})
+                     for n in (1, 2, 3, 4)}
+        for n, p in baselines.items():
+            p.forward(data=np.zeros((n, 8), np.float32))
+        try:
+            warm = telemetry.value("op_jit_cache_misses_total",
+                                   op="Executor::Forward")
+            rng = np.random.RandomState(9)
+            for _ in range(10):
+                n = int(rng.choice([1, 2, 3, 4]))
+                X = rng.randint(-2, 3, (n, 8)).astype(np.float32)
+                want = baselines[n].forward(data=X)[0].asnumpy()
+                out = srv.predict({"data": X})[0]
+                assert np.array_equal(out, want)
+            after = telemetry.value("op_jit_cache_misses_total",
+                                    op="Executor::Forward")
+            assert after == warm
+            assert srv.health()["post_warmup_compiles"] == 0
+            assert srv.stats()["mesh"] == {"tp": 2}
+        finally:
+            srv.stop()
+
+    def test_mesh_hot_swap_no_recompile(self):
+        """Swapping weights on a mesh server re-pins rule shardings; the
+        next request must neither recompile nor serve stale values."""
+        telemetry.enable()
+        mesh = _tp_mesh(2)
+        sym, params = _int_mlp()
+        srv = ModelServer(sym.tojson(), params, example_shapes={"data": (8,)},
+                          mesh=mesh, max_batch_size=2, batch_timeout_ms=1)
+        srv.start()
+        try:
+            new = {n: (p.asnumpy() * 2).astype(np.float32)
+                   for n, p in params.items()}
+            srv.swap_params(new)
+            X = np.ones((1, 8), np.float32)
+            # baseline compiles before the snapshot (global miss counter)
+            want = Predictor(sym.tojson(), {k: nd.array(v)
+                                            for k, v in new.items()},
+                             input_shapes={"data": (1, 8)}) \
+                .forward(data=X)[0].asnumpy()
+            warm = telemetry.value("op_jit_cache_misses_total",
+                                   op="Executor::Forward")
+            out = srv.predict({"data": X})[0]
+            assert np.array_equal(out, want)
+            assert telemetry.value("op_jit_cache_misses_total",
+                                   op="Executor::Forward") == warm
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP gateway
+# ---------------------------------------------------------------------------
+class TestGatewayHTTP:
+    def _post(self, port, doc, path="/predict", extra_headers=None):
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d%s" % (port, path), data=body,
+            headers={"Content-Type": "application/json",
+                     **(extra_headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    def _registry(self):
+        reg = ModelRegistry()
+        for name, scale in (("one", 1.0), ("two", 2.0)):
+            sym, p = _linear(scale)
+            reg.register(name, sym.tojson(), p, {"data": (8,)},
+                         max_batch_size=4, batch_timeout_ms=1)
+        return reg
+
+    def test_routes_by_model_name(self):
+        reg = self._registry()
+        port = serving.start_http_server(reg, port=0)
+        try:
+            doc = {"inputs": {"data": [1.0] * 8}}
+            status, out, _ = self._post(port, {**doc, "model": "one"})
+            assert status == 200 and out["outputs"][0][0][0] == 8.0
+            status, out, _ = self._post(port, {**doc, "model": "two"})
+            assert status == 200 and out["outputs"][0][0][0] == 16.0
+            # two models, no name -> must not guess
+            status, out, _ = self._post(port, doc)
+            assert status == 404 and "name required" in out["error"]
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/models" % port, timeout=30) as r:
+                assert json.loads(r.read())["models"] == ["one", "two"]
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/stats" % port, timeout=30) as r:
+                st = json.loads(r.read())
+            assert set(st["models"]) == {"one", "two"}
+        finally:
+            serving.stop_http_server()
+            reg.stop_all()
+
+    def test_unknown_model_is_404_not_500(self):
+        reg = self._registry()
+        port = serving.start_http_server(reg, port=0)
+        try:
+            status, out, _ = self._post(
+                port, {"inputs": {"data": [0.0] * 8}, "model": "ghost"})
+            assert status == 404 and "ghost" in out["error"]
+        finally:
+            serving.stop_http_server()
+            reg.stop_all()
+
+    def test_plain_server_rejects_foreign_model_name(self):
+        sym, p = _linear(1.0)
+        srv = ModelServer(sym.tojson(), p, {"data": (8,)}, name="solo",
+                          max_batch_size=2, batch_timeout_ms=1).start()
+        port = serving.start_http_server(srv, port=0)
+        try:
+            doc = {"inputs": {"data": [1.0] * 8}}
+            status, out, _ = self._post(port, {**doc, "model": "solo"})
+            assert status == 200
+            status, out, _ = self._post(port, {**doc, "model": "other"})
+            assert status == 404
+        finally:
+            serving.stop_http_server()
+            srv.stop()
+
+    def test_oversized_body_is_413_and_counted(self):
+        telemetry.enable()
+        reg = self._registry()
+        port = serving.start_http_server(reg, port=0, max_body_bytes=512)
+        try:
+            big = {"inputs": {"data": [1.0] * 8}, "model": "one",
+                   "pad": "x" * 4096}
+            status, out, _ = self._post(port, big)
+            assert status == 413 and out["outcome"] == "too_large"
+            assert "MXNET_SERVING_MAX_BODY_BYTES" in out["error"]
+            assert telemetry.value("serving_requests_total",
+                                   outcome="too_large") == 1
+            # server stays healthy for in-bounds traffic afterwards
+            status, out, _ = self._post(
+                port, {"inputs": {"data": [1.0] * 8}, "model": "one"})
+            assert status == 200
+        finally:
+            serving.stop_http_server()
+            reg.stop_all()
+
+    def test_shed_is_429_with_retry_after(self):
+        telemetry.enable()
+        reg = self._registry()
+        one = reg.get("one")
+        # pin the health-driven floor re-evaluation off so the forced
+        # floor below is what admission sees (white-box, deterministic)
+        one._admission_checked_at = time.monotonic() + 60.0
+        one._batcher.set_shed_floor(1)              # force degraded floor
+        port = serving.start_http_server(reg, port=0)
+        try:
+            status, out, headers = self._post(
+                port, {"inputs": {"data": [1.0] * 8}, "model": "one",
+                       "slo_class": "batch"})
+            assert status == 429 and out["outcome"] == "shed"
+            assert float(headers["Retry-After"]) > 0
+            assert telemetry.value("serving_shed_total",
+                                   slo_class="batch") == 1
+            # realtime unaffected on the same model; batch fine on model two
+            status, _, _ = self._post(
+                port, {"inputs": {"data": [1.0] * 8}, "model": "one",
+                       "slo_class": "realtime"})
+            assert status == 200
+            status, _, _ = self._post(
+                port, {"inputs": {"data": [1.0] * 8}, "model": "two",
+                       "slo_class": "batch"})
+            assert status == 200
+        finally:
+            serving.stop_http_server()
+            reg.stop_all()
+
+    def test_bad_slo_class_is_400(self):
+        reg = self._registry()
+        port = serving.start_http_server(reg, port=0)
+        try:
+            status, out, _ = self._post(
+                port, {"inputs": {"data": [1.0] * 8}, "model": "one",
+                       "slo_class": "vip"})
+            assert status == 400 and "slo_class" in out["error"]
+        finally:
+            serving.stop_http_server()
+            reg.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2 models + 2 SLO classes under saturation
+# ---------------------------------------------------------------------------
+class TestAcceptance:
+    def test_saturation_sheds_batch_before_deadline_miss(self):
+        """Deterministic saturation: fill the (unstarted) queue past the
+        batch shed threshold, observe batch traffic shed with 429
+        semantics while realtime is admitted, then start the workers and
+        verify every admitted realtime request completes within its
+        deadline — shedding happened, deadline misses did not."""
+        health_mod.enable()     # /programz attribution needs health hooks
+        health_mod.reset()
+        telemetry.enable()
+        reg = ModelRegistry()
+        for name in ("rt-model", "bulk-model"):
+            sym, p = _mlp(seed=len(name))
+            reg.register(name, sym.tojson(), p, {"data": (8,)},
+                         max_batch_size=4, batch_timeout_ms=1,
+                         queue_depth=8, start=False)
+        srv = reg.get("rt-model")
+        srv.warmup()                        # compile, but no workers yet
+        reg.get("bulk-model").start()
+        X = np.zeros((1, 8), np.float32)
+        try:
+            admitted = []
+            for _ in range(4):              # 4/8 = 50%: shed level 1
+                admitted.append(srv.submit({"data": X}, deadline_ms=30000,
+                                           slo_class="realtime"))
+            with pytest.raises(AdmissionError):
+                srv.submit({"data": X}, slo_class="batch")
+            assert srv._batcher.level == 1
+            for _ in range(3):              # 7/8 = 87.5%: shed level 2
+                admitted.append(srv.submit({"data": X}, deadline_ms=30000,
+                                           slo_class="standard"))
+            with pytest.raises(AdmissionError):
+                srv.submit({"data": X}, slo_class="standard")
+            admitted.append(srv.submit({"data": X}, deadline_ms=30000,
+                                       slo_class="realtime"))
+            with pytest.raises(QueueFullError):
+                srv.submit({"data": X}, slo_class="realtime")
+            assert srv.stats()["queued_by_class"] == {
+                "realtime": 5, "standard": 3, "batch": 0}
+            # saturated model sheds; its neighbor still takes batch work
+            reg.predict({"data": X}, model="bulk-model", slo_class="batch")
+
+            srv.start(warmup=False)         # drain: workers come up
+            for r in admitted:
+                r.result(timeout=60.0)
+            assert all(r.outcome == "ok" for r in admitted)
+            # shed happened, deadline misses did not
+            assert telemetry.value("serving_shed_total",
+                                   slo_class="batch") == 1
+            assert telemetry.value("serving_shed_total",
+                                   slo_class="standard") == 1
+            assert telemetry.value("serving_slo_requests_total",
+                                   slo_class="realtime", outcome="ok") == 5
+            assert telemetry.value("serving_requests_total",
+                                   outcome="deadline") == 0
+            assert telemetry.value("serving_model_requests_total",
+                                   model="rt-model", outcome="ok") == 8
+            assert telemetry.value("serving_model_requests_total",
+                                   model="bulk-model", outcome="ok") == 1
+            # both models visible, separately, on /programz
+            progs = set()
+            for name in ("rt-model", "bulk-model"):
+                names = reg.get(name).program_names()
+                assert names, "no /programz entries for %s" % name
+                progs.update(names)
+            assert any(p.startswith("serving:rt-model:") for p in progs)
+            assert any(p.startswith("serving:bulk-model:") for p in progs)
+        finally:
+            reg.stop_all()
+            health_mod.disable()
+            health_mod.reset()

@@ -2,27 +2,40 @@
 """Serving load generator: dynamic batching vs the serial Predictor.
 
 Measures what the serving layer is *for*: request throughput and tail
-latency under concurrency.  Three scenarios over the same model:
+latency under concurrency.  Four scenarios over the same model:
 
 - **serial** — one thread calling ``Predictor.forward`` per request: the
   baseline an embedder gets without the serving layer.
 - **closed** — N closed-loop clients issuing back-to-back requests into a
   :class:`ModelServer` (each client waits for its response before sending
-  the next): measures coalescing gain at saturation.
+  the next): measures coalescing gain at saturation (and doubles as the
+  capacity estimate the sweep scales from).
 - **open** — Poisson arrivals at a target rate submitted asynchronously:
   measures tail latency and rejection behaviour at a fixed offered load
   (closed-loop self-throttles and can't show overload).
+- **sweep** — open-loop Poisson points at multiples of measured capacity,
+  up to >10x, with a mixed SLO-class workload (realtime with a deadline,
+  standard, batch): the saturation curve (offered vs achieved QPS) plus
+  per-class p50/p99 and shed rate at every point.  The story it must
+  tell: past saturation the scheduler sheds ``batch``/``standard`` with
+  429s while realtime latency stays bounded — overload degrades the
+  cheap traffic, not the tail.
 
 Reports p50/p90/p99/mean end-to-end latency (ms), throughput (req/s and
 rows/s), realized mean batch size, padding overhead, and the compiled
 program count (``op_jit_cache_misses_total`` for ``Executor::Forward``) —
-one JSON document on stdout (or ``--out``).
+one JSON document on stdout (or ``--out``).  ``--history-out`` also
+writes the canonical sentinel round (``serving_p99_ms_realtime``,
+``serving_shed_rate_overload``, ...) for ``bench_history/``.
 
 Run:  python tools/bench_serving.py [--smoke] [--out results.json]
+      python tools/bench_serving.py --smoke \\
+          --history-out bench_history/serving_r14.canonical.json
 """
 import argparse
 import json
 import os
+import queue
 import sys
 import threading
 import time
@@ -37,7 +50,8 @@ import numpy as np  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import nd, telemetry  # noqa: E402
 from mxnet_tpu.predictor import Predictor  # noqa: E402
-from mxnet_tpu.serving import ModelServer, ServingError  # noqa: E402
+from mxnet_tpu.serving import (AdmissionError, ModelServer,  # noqa: E402
+                               QueueFullError, ServingError)
 
 S = mx.symbol
 
@@ -201,6 +215,170 @@ def bench_open(server, in_dim, rate_rps, duration_s, deadline_ms):
             "padding_rows": int(pad() - p0), **percentiles(lat)}
 
 
+#: SLO-class workload mix for the saturation sweep: (class, share of
+#: arrivals, carries the realtime deadline?).  30/40/30 is the classic
+#: "interactive + default + offline backfill" blend.
+CLASS_MIX = (("realtime", 0.30, True),
+             ("standard", 0.40, False),
+             ("batch", 0.30, False))
+
+
+def bench_open_slo(server, in_dim, rate_rps, duration_s, rt_deadline_ms,
+                   collectors_per_class=8):
+    """One open-loop Poisson point with the CLASS_MIX workload.
+
+    Arrivals never self-throttle (submission is non-blocking; waiting
+    happens on small collector pools — at most queue_depth + one batch
+    of requests are ever in flight, so the pools keep up and a thread
+    per request at 12x capacity is avoided).  One pool **per SLO class**:
+    the scheduler executes classes out of submission order, so a shared
+    pool would head-of-line block on a deprioritized batch request while
+    completed realtime responses queue behind it, inflating the measured
+    realtime tail.  Within one class completion order tracks submission
+    order (EDF with a uniform deadline offset == FIFO), so per-class
+    pools measure true latency.  Returns offered/achieved QPS,
+    shed/reject rates, and per-class outcome counts + p50/p99.
+    """
+    rng = np.random.RandomState(int(rate_rps) % 7919 + 5)
+    X = rng.uniform(-1, 1, (64, in_dim)).astype(np.float32)
+    classes = [c for c, _, _ in CLASS_MIX]
+    shares = np.asarray([s for _, s, _ in CLASS_MIX])
+    shares = shares / shares.sum()
+    rt_deadline = {c: (rt_deadline_ms if dl else None)
+                   for c, _, dl in CLASS_MIX}
+    lock = threading.Lock()
+    lat = {c: [] for c in classes}
+    outcomes = {c: {"ok": 0, "shed": 0, "rejected": 0, "deadline": 0,
+                    "error": 0} for c in classes}
+    done_q = {c: queue.Queue() for c in classes}
+
+    def collect(q):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            req, t_submit, cls = item
+            try:
+                req.result(120.0)
+                dt = time.perf_counter() - t_submit
+                with lock:
+                    outcomes[cls]["ok"] += 1
+                    lat[cls].append(dt)
+            except ServingError:
+                out = req.outcome if req.outcome in outcomes[cls] \
+                    else "error"
+                with lock:
+                    outcomes[cls][out] += 1
+
+    pool = [threading.Thread(target=collect, args=(done_q[c],), daemon=True)
+            for c in classes for _ in range(collectors_per_class)]
+    for t in pool:
+        t.start()
+    t0 = time.perf_counter()
+    end = t0 + duration_s
+    n = 0
+    next_t = t0
+    while True:
+        now = time.perf_counter()
+        if now >= end:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.001))
+            continue
+        next_t += rng.exponential(1.0 / rate_rps)
+        cls = classes[int(rng.choice(len(classes), p=shares))]
+        t_submit = time.perf_counter()
+        try:
+            req = server.submit({"data": X[n % len(X)]},
+                                deadline_ms=rt_deadline[cls],
+                                slo_class=cls)
+        except AdmissionError:
+            with lock:
+                outcomes[cls]["shed"] += 1
+            continue
+        except QueueFullError:
+            with lock:
+                outcomes[cls]["rejected"] += 1
+            continue
+        except ServingError:
+            with lock:
+                outcomes[cls]["error"] += 1
+            continue
+        finally:
+            n += 1
+        done_q[cls].put((req, t_submit, cls))
+    for c in classes:
+        for _ in range(collectors_per_class):
+            done_q[c].put(None)
+    for t in pool:
+        t.join(120.0)
+    wall = time.perf_counter() - t0
+    ok = sum(o["ok"] for o in outcomes.values())
+    shed = sum(o["shed"] for o in outcomes.values())
+    rejected = sum(o["rejected"] for o in outcomes.values())
+    per_class = {}
+    for c in classes:
+        per_class[c] = {"outcomes": dict(outcomes[c]), **percentiles(lat[c])}
+    return {"offered_rps": round(rate_rps, 1), "duration_s": duration_s,
+            "submitted": n,
+            "achieved_rps": round(ok / wall, 1),
+            "shed_rate": round(shed / max(n, 1), 4),
+            "reject_rate": round(rejected / max(n, 1), 4),
+            "classes": per_class}
+
+
+def bench_sweep(server, in_dim, capacity_rps, multiples, point_duration_s,
+                rt_deadline_ms):
+    """The saturation curve: one open-loop SLO point per capacity
+    multiple (the last well past 10x), worst-case offered load last so
+    earlier points aren't polluted by a saturated queue."""
+    points = []
+    for mult in multiples:
+        rate = max(capacity_rps * mult, 1.0)
+        pt = bench_open_slo(server, in_dim, rate, point_duration_s,
+                            rt_deadline_ms)
+        pt["capacity_multiple"] = mult
+        points.append(pt)
+        # let the queue fully drain between points: each point measures
+        # its own offered load, not the previous point's backlog
+        while len(server._batcher):
+            time.sleep(0.01)
+    return points
+
+
+def canonical_round(doc, round_name, source):
+    """The sentinel-canonical round document for ``bench_history/``."""
+    sat = doc["sweep"][-1]
+    rt = sat["classes"]["realtime"]
+    metrics = {}
+    if rt.get("p99_ms") is not None:
+        metrics["serving_p99_ms_realtime"] = round(rt["p99_ms"], 2)
+    metrics["serving_shed_rate_overload"] = sat["shed_rate"]
+    metrics["serving_throughput_rps"] = doc["closed"]["throughput_rps"]
+    if doc.get("warmup_seconds") is not None:
+        metrics["serving_warmup_seconds"] = round(doc["warmup_seconds"], 3)
+    metrics["post_warmup_compiles"] = doc.get("post_warmup_compiles", 0)
+    return {
+        "round": round_name,
+        "source": source,
+        "kind": "serving_gateway",
+        "metrics": metrics,
+        "context": {
+            "platform": "cpu",
+            "capacity_rps": doc["closed"]["throughput_rps"],
+            "overload_offered_rps": sat["offered_rps"],
+            "overload_achieved_rps": sat["achieved_rps"],
+            "capacity_multiple": sat["capacity_multiple"],
+            "class_mix": {c: s for c, s, _ in CLASS_MIX},
+            "rt_deadline_ms": doc["config"].get("rt_deadline_ms"),
+            "note": "realtime p99 + shed rate at the >10x-capacity "
+                    "open-loop point; shedding (429) is the designed "
+                    "overload response — shed_rate collapsing to 0 "
+                    "under 12x load means admission control broke",
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--in-dim", type=int, default=64)
@@ -219,13 +397,27 @@ def main():
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="open-loop per-request deadline (0 = none)")
     ap.add_argument("--queue-depth", type=int, default=512)
+    ap.add_argument("--sweep-multiples", default="0.5,1,2,5,10,12",
+                    help="capacity multiples for the saturation sweep "
+                         "('' skips the sweep)")
+    ap.add_argument("--sweep-duration", type=float, default=4.0,
+                    help="open-loop duration per sweep point (s)")
+    ap.add_argument("--rt-deadline-ms", type=float, default=200.0,
+                    help="realtime-class deadline in the sweep")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny counts: CI-sized sanity run")
     ap.add_argument("--out", help="write the JSON document here too")
+    ap.add_argument("--history-out",
+                    help="write the canonical sentinel round here "
+                         "(e.g. bench_history/serving_r14.canonical.json)")
+    ap.add_argument("--round", default="r14",
+                    help="round name stamped on --history-out")
     args = ap.parse_args()
     if args.smoke:
         args.requests, args.clients = 20, 4
         args.rate, args.duration = 100.0, 1.0
+        args.sweep_multiples = "1,12"
+        args.sweep_duration = 1.0
 
     telemetry.enable()
     sym, params = build_model(args.in_dim, args.hidden, args.classes)
@@ -235,7 +427,9 @@ def main():
                      "classes": args.classes},
            "config": {"max_batch": args.max_batch,
                       "batch_timeout_ms": args.timeout_ms,
-                      "clients": args.clients}}
+                      "clients": args.clients,
+                      "queue_depth": args.queue_depth,
+                      "rt_deadline_ms": args.rt_deadline_ms}}
 
     doc["serial"] = bench_serial(sym, params, args.in_dim, args.requests)
 
@@ -256,6 +450,16 @@ def main():
         if args.rate > 0:
             doc["open"] = bench_open(server, args.in_dim, args.rate,
                                      args.duration, args.deadline_ms)
+        multiples = [float(m) for m in args.sweep_multiples.split(",")
+                     if m.strip()]
+        if multiples:
+            capacity = max(doc["closed"]["throughput_rps"], 1.0)
+            doc["sweep"] = bench_sweep(server, args.in_dim, capacity,
+                                       multiples, args.sweep_duration,
+                                       args.rt_deadline_ms)
+        doc["post_warmup_compiles"] = telemetry.value(
+            "op_jit_cache_misses_total",
+            op="Executor::Forward") - m0 - doc["warmup_compiles"]
     finally:
         server.stop()
 
@@ -270,6 +474,16 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.history_out:
+        if "sweep" not in doc:
+            raise SystemExit("--history-out needs the sweep "
+                             "(--sweep-multiples was empty)")
+        rnd = canonical_round(doc, args.round,
+                              "tools/bench_serving.py --smoke" if args.smoke
+                              else "tools/bench_serving.py")
+        with open(args.history_out, "w") as f:
+            json.dump(rnd, f, indent=1, sort_keys=True)
+            f.write("\n")
 
 
 if __name__ == "__main__":

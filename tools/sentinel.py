@@ -68,6 +68,15 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     "multichip_scaling_efficiency": ("higher", 0.15, 0.0),
     "serving_p99_ms":               ("lower",  0.20, 0.0),
     "serving_throughput_rps":       ("higher", 0.10, 0.0),
+    # SLO gateway (ISSUE 14): realtime tail at the >10x-capacity
+    # open-loop point.  Absolute slack because the CPU box's batch
+    # timing wobbles tens of ms run to run; a realtime tail that grows
+    # past band means admission control stopped protecting the class.
+    "serving_p99_ms_realtime":      ("lower",  0.30, 25.0),
+    # shed rate at 12x offered load: HIGHER is healthy (overload is
+    # absorbed as explicit 429s).  A collapse toward 0 under the same
+    # overload means shedding broke and the tail is eating it.
+    "serving_shed_rate_overload":   ("higher", 0.00, 0.25),
     "post_warmup_compiles":         ("lower",  0.00, 0.0),
     "atlas_coverage_pct":           ("higher", 0.00, 5.0),
     "monitor_overhead_pct":         ("lower",  0.00, 1.0),
@@ -180,6 +189,37 @@ def _norm_serving(doc: dict, source: str) -> dict:
             "kind": "serving", "metrics": metrics, "context": {}}
 
 
+def _norm_serving_gateway(doc: dict, source: str) -> dict:
+    """tools/bench_serving.py output with the SLO saturation sweep: the
+    gated metrics come from the worst (last) sweep point."""
+    metrics: Dict[str, float] = {}
+    ctx: Dict[str, object] = {}
+    closed = doc.get("closed") or {}
+    v = _num(closed.get("throughput_rps"))
+    if v is not None:
+        metrics["serving_throughput_rps"] = v
+    v = _num(doc.get("warmup_seconds"))
+    if v is not None:
+        metrics["serving_warmup_seconds"] = v
+    v = _num(doc.get("post_warmup_compiles"))
+    if v is not None:
+        metrics["post_warmup_compiles"] = v
+    sweep = doc.get("sweep") or []
+    if sweep:
+        sat = sweep[-1]
+        v = _num(sat.get("shed_rate"))
+        if v is not None:
+            metrics["serving_shed_rate_overload"] = v
+        rt = (sat.get("classes") or {}).get("realtime") or {}
+        v = _num(rt.get("p99_ms"))
+        if v is not None:
+            metrics["serving_p99_ms_realtime"] = v
+        ctx["overload_offered_rps"] = sat.get("offered_rps")
+        ctx["capacity_multiple"] = sat.get("capacity_multiple")
+    return {"round": _round_of(source), "source": os.path.basename(source),
+            "kind": "serving_gateway", "metrics": metrics, "context": ctx}
+
+
 def _norm_ledger(path: str) -> dict:
     """A runlog JSONL: fold every bench_result / healthz event into one
     candidate round (the run's final state wins per metric)."""
@@ -244,6 +284,8 @@ def normalize(doc, source: str = "<inline>") -> dict:
         return _norm_bench_parsed(doc["parsed"], source)
     if "scaling_efficiency" in doc or "n_devices" in doc:
         return _norm_multichip(doc, source)
+    if doc.get("bench") == "serving" or "sweep" in doc:
+        return _norm_serving_gateway(doc, source)
     if "p99_ms" in doc or "latency_p99_ms" in doc or \
             "throughput_rps" in doc:
         return _norm_serving(doc, source)
