@@ -8,6 +8,12 @@ reference's contracts.  See SURVEY.md for the blueprint.
 """
 __version__ = "0.1.0"
 
+# the lock-order sanitizer (MXNET_LOCKCHECK=1) must patch threading
+# BEFORE any submodule import so module-level locks are instrumented;
+# locksmith is stdlib-only for exactly this reason
+from . import locksmith as _locksmith
+_locksmith.install()
+
 # memory-pool env knobs must translate to XLA client settings BEFORE the
 # first backend init (storage manager N2; no-op if jax already started)
 from .storage import apply_pool_env as _apply_pool_env

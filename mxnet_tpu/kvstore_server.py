@@ -277,6 +277,8 @@ def _check_trace_ctx(tc):
     unknown = set(tc) - _TC_KEYS
     if unknown:
         _frame_error("unknown trace-context keys %s" % sorted(unknown))
+    if set(tc) != _TC_KEYS:
+        _frame_error("trace context missing fields")
     for k, v in tc.items():
         if not isinstance(v, str) or not v or len(v) > _TC_MAX_LEN:
             _frame_error("trace-context field %r malformed or oversized" % k)

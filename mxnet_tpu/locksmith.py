@@ -1,0 +1,356 @@
+"""Runtime lock-order sanitizer (``MXNET_LOCKCHECK=1``).
+
+The graftlint lock model (GL003) is static and conservative: it sees
+every ``threading.Lock``/``RLock`` construction site in the package and
+the acquisition ORDER it can prove, but it cannot see locks taken
+through unresolvable indirection.  This module is the dynamic half of
+that contract: with ``MXNET_LOCKCHECK=1`` in the environment,
+``threading.Lock`` and ``threading.RLock`` constructions *inside the
+mxnet_tpu package* return instrumented locks that record, per thread,
+the set of locks held at every acquisition.  That yields the observed
+lock-acquisition graph, which is
+
+- checked **live** for cycles on every new edge (an ABBA order observed
+  at runtime is reported the moment the second ordering appears — no
+  actual deadlock needed, the interleaving just has to exist), and
+- **diffed at exit** against the static graph from
+  ``python -m tools.graftlint --dump-lock-graph``.
+
+Exit-diff failure semantics (``report()["ok"]``):
+
+- ``cycles``       — dynamic ABBA: two locks acquired in both orders.
+- ``inversions``   — an observed edge (a, b) where the static graph
+  proved (b, a) and never saw (a, b): runtime contradicts the model.
+- ``unknown_locks`` — a lock constructed at a source site the static
+  model has no entry for: the lint's site table is incomplete.
+
+``uncovered_edges`` (observed edges the static walk never derived) are
+reported for information but are NOT a failure: the static resolver
+skips unresolvable callees on purpose, so observed ⊆ static does not
+hold in general — only the three contradictions above do.
+
+Install happens in ``mxnet_tpu/__init__.py`` *before* any submodule
+import so module-level locks are instrumented too.  Everything here is
+stdlib-only: importing anything from mxnet_tpu at install time would
+create locks before the patch is in place.
+
+Knobs: ``MXNET_LOCKCHECK`` (enable), ``MXNET_LOCKCHECK_REPORT``
+(directory; each process appends ``lockcheck-<pid>.json`` at exit —
+a directory, not a file, because the chaos harness forks workers that
+inherit the environment and must not clobber each other's reports),
+``MXNET_LOCKCHECK_STATIC`` (path to a pre-dumped ``--dump-lock-graph``
+JSON; without it the exit hook builds the static graph by importing
+tools.graftlint, which costs a few seconds).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+
+__all__ = ["install", "installed", "report", "reset"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_PKG_DIR)
+
+_installed = False
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+# the registry mutex is a REAL lock created before patching and is a
+# leaf: nothing is ever acquired while holding it, so it cannot take
+# part in any ordering it is policing
+_mu = threading.Lock()
+_held = threading.local()             # .stack: list of site keys
+_sites = {}                           # site key -> {"kind", "rel", "line"}
+_edges = {}                           # (a, b) -> {"thread", "count"}
+_cycles = []                          # [{"chain": [...], "thread": ...}]
+
+
+def _site_key(rel: str, line: int) -> str:
+    return "%s:%d" % (rel, line)
+
+
+def _stack():
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+def _find_cycle(start: str) -> list:
+    """DFS from ``start`` over the observed edge graph; the edge closing
+    a cycle through ``start`` was just inserted."""
+    adj = {}
+    for a, b in _edges:
+        adj.setdefault(a, []).append(b)
+    path, seen = [start], {start}
+
+    def walk(node):
+        for nxt in adj.get(node, ()):
+            if nxt == start:
+                return True
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            path.append(nxt)
+            if walk(nxt):
+                return True
+            path.pop()
+        return False
+
+    return path + [start] if walk(start) else []
+
+
+def _on_acquired(site: str) -> None:
+    st = _stack()
+    new_cycle = None
+    with _mu:
+        for holder in st:
+            if holder == site:
+                continue            # re-entrant / same-site family
+            edge = (holder, site)
+            rec = _edges.get(edge)
+            if rec is not None:
+                rec["count"] += 1
+                continue
+            _edges[edge] = {"thread": threading.current_thread().name,
+                            "count": 1}
+            cyc = _find_cycle(site)
+            if cyc:
+                new_cycle = {"chain": cyc,
+                             "thread": threading.current_thread().name}
+                _cycles.append(new_cycle)
+    st.append(site)
+    if new_cycle is not None:
+        sys.stderr.write(
+            "mxnet_tpu.locksmith: lock-order cycle observed: %s "
+            "(thread %s)\n" % (" -> ".join(new_cycle["chain"]),
+                               new_cycle["thread"]))
+
+
+def _on_released(site: str) -> None:
+    st = _stack()
+    # remove the LAST occurrence: release order is not enforced to be
+    # stack order (hand-over-hand locking releases the outer lock first)
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] == site:
+            del st[i]
+            break
+
+
+class _TracedLock:
+    """Order-tracking wrapper over a real Lock/RLock.  API-compatible
+    with both, including the private Condition protocol so
+    ``threading.Condition(traced_lock)`` keeps working."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _on_acquired(self._site)
+        return got
+
+    def release(self):
+        self._inner.release()
+        _on_released(self._site)
+
+    def locked(self):
+        fn = getattr(self._inner, "locked", None)
+        return fn() if fn is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<locksmith %r wrapping %r>" % (self._site, self._inner)
+
+    # -- Condition protocol ------------------------------------------
+    def _release_save(self):
+        saver = getattr(self._inner, "_release_save", None)
+        state = saver() if saver is not None else self._inner.release()
+        _on_released(self._site)
+        return state
+
+    def _acquire_restore(self, state):
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        _on_acquired(self._site)
+
+    def _is_owned(self):
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+def _caller_site():
+    """(site key, register) for the frame constructing the lock; None
+    when the construction is outside the package (stdlib internals,
+    user code) and must stay untraced."""
+    try:
+        frame = sys._getframe(2)
+    except ValueError:          # pragma: no cover - no caller frame
+        return None
+    fname = frame.f_code.co_filename
+    try:
+        apath = os.path.abspath(fname)
+    except (OSError, ValueError):  # pragma: no cover
+        return None
+    if not apath.startswith(_PKG_DIR + os.sep) and apath != _PKG_DIR:
+        return None
+    rel = os.path.relpath(apath, _ROOT).replace(os.sep, "/")
+    if rel.endswith("locksmith.py"):
+        return None
+    return _site_key(rel, frame.f_lineno)
+
+
+def _traced_factory(real, kind):
+    def factory(*args, **kwargs):
+        inner = real(*args, **kwargs)
+        site = _caller_site()
+        if site is None:
+            return inner
+        with _mu:
+            if site not in _sites:
+                rel, _, line = site.rpartition(":")
+                _sites[site] = {"kind": kind, "rel": rel,
+                                "line": int(line)}
+        return _TracedLock(inner, site)
+    factory.__name__ = kind
+    return factory
+
+
+# -- static graph ------------------------------------------------------
+def _load_static_graph():
+    """The ``--dump-lock-graph`` JSON: from MXNET_LOCKCHECK_STATIC when
+    set, else computed by importing the linter.  None when neither
+    works (the exit diff is then skipped, not failed)."""
+    path = os.environ.get("MXNET_LOCKCHECK_STATIC")
+    if path:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+    if not os.path.isdir(os.path.join(_ROOT, "tools", "graftlint")):
+        return None
+    try:
+        if _ROOT not in sys.path:
+            sys.path.insert(0, _ROOT)
+        from tools.graftlint import Project
+        from tools.graftlint.dataflow import lock_graph
+        return lock_graph(Project(_ROOT))
+    except Exception:
+        return None
+
+
+def _diff_static(static):
+    """Contradictions between the observed graph and the static one."""
+    diff = {"cycles": list(_cycles), "inversions": [],
+            "unknown_locks": [], "uncovered_edges": []}
+    if static is None:
+        return diff, False
+    static_sites = set(static.get("sites", {}))
+    site_lid = dict(static.get("sites", {}))
+    static_edges = {tuple(e) for e in static.get("edges", [])}
+    for site in sorted(_sites):
+        if site not in static_sites:
+            diff["unknown_locks"].append(site)
+    for a, b in sorted(_edges):
+        la, lb = site_lid.get(a), site_lid.get(b)
+        if la is None or lb is None or la == lb:
+            continue
+        if (la, lb) in static_edges:
+            continue
+        if (lb, la) in static_edges:
+            diff["inversions"].append([la, lb])
+        else:
+            diff["uncovered_edges"].append([la, lb])
+    return diff, True
+
+
+def report():
+    """Observed graph + static diff.  ``ok`` is False on any cycle,
+    inversion or unknown lock site (uncovered edges are informational —
+    see the module docstring for why)."""
+    static = _load_static_graph()
+    with _mu:
+        snap_sites = {k: dict(v) for k, v in _sites.items()}
+        snap_edges = [[a, b, _edges[(a, b)]["count"]]
+                      for a, b in sorted(_edges)]
+    diff, had_static = _diff_static(static)
+    ok = not (diff["cycles"] or diff["inversions"] or
+              diff["unknown_locks"])
+    return {"version": 1, "pid": os.getpid(),
+            "enabled": _installed, "static_graph": had_static,
+            "sites": snap_sites, "edges": snap_edges,
+            "diff": diff, "ok": ok}
+
+
+def reset():
+    """Drop all observed state (test isolation)."""
+    with _mu:
+        _sites.clear()
+        _edges.clear()
+        del _cycles[:]
+    _held.stack = []
+
+
+def _exit_report():   # pragma: no cover - exercised via subprocess tests
+    rep = report()
+    out_dir = os.environ.get("MXNET_LOCKCHECK_REPORT")
+    if out_dir:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir,
+                                "lockcheck-%d.json" % os.getpid())
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(rep, fh, indent=2, sort_keys=True)
+        except OSError as exc:
+            sys.stderr.write("mxnet_tpu.locksmith: cannot write report: "
+                             "%s\n" % exc)
+    if not rep["ok"]:
+        sys.stderr.write(
+            "mxnet_tpu.locksmith: FAIL — %d cycle(s), %d inversion(s), "
+            "%d unknown lock site(s)\n"
+            % (len(rep["diff"]["cycles"]), len(rep["diff"]["inversions"]),
+               len(rep["diff"]["unknown_locks"])))
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install() -> bool:
+    """Patch ``threading.Lock``/``RLock`` when ``MXNET_LOCKCHECK`` is
+    truthy.  Idempotent; returns whether the sanitizer is active."""
+    global _installed
+    if _installed:
+        return True
+    if os.environ.get("MXNET_LOCKCHECK", "0").lower() in \
+            ("", "0", "false", "off"):
+        return False
+    threading.Lock = _traced_factory(_real_lock, "Lock")
+    threading.RLock = _traced_factory(_real_rlock, "RLock")
+    _installed = True
+    atexit.register(_exit_report)
+    return True

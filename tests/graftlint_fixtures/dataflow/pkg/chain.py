@@ -1,0 +1,31 @@
+"""Dataflow-core fixture: a 3-hop env-key taint chain (top -> hop1 ->
+hop2 -> read_env) and a with-statement lock alias (lk = _lk_a) whose
+held set must order _lk_a before _lk_b."""
+import os
+import threading
+
+_lk_a = threading.Lock()
+_lk_b = threading.Lock()
+
+
+def read_env(key):
+    return os.environ.get(key)
+
+
+def hop2(k):
+    return read_env(k)
+
+
+def hop1(name):
+    return hop2(name)
+
+
+def top():
+    return hop1("MXNET_FIX_CHAIN")
+
+
+def locked():
+    lk = _lk_a
+    with lk:
+        with _lk_b:
+            pass

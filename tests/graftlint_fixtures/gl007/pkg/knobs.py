@@ -1,0 +1,24 @@
+"""GL007 fixture: four doc-table failure modes + two silent reads.
+
+- MXNET_FIX_OK       documented, default matches        -> silent
+- MXNET_FIX_MISSING  read here, no doc row              -> undocumented
+- (MXNET_FIX_GONE)   doc row, no read anywhere          -> ghost
+- MXNET_FIX_DRIFT    doc default 3, code default 2      -> default-drift
+- MXNET_FIX_MODDRIFT doc says pkg.other, read is here   -> module-drift
+- MXNET_FIX_TAINTED  routed through a keyed accessor    -> silent
+  (the env-taint pass must materialize it at the _knob call site)
+"""
+import os
+
+OK = os.environ.get("MXNET_FIX_OK", "1")
+MISSING = os.environ.get("MXNET_FIX_MISSING", "0")
+DRIFT = os.environ.get("MXNET_FIX_DRIFT", "2")
+MODDRIFT = os.environ.get("MXNET_FIX_MODDRIFT", "x")
+
+
+def _knob(key, default=None):
+    return os.environ.get(key, default)
+
+
+def tainted():
+    return _knob("MXNET_FIX_TAINTED")

@@ -1,0 +1,69 @@
+"""GL008 fixture: thread discipline good/bad pairs.
+
+Good: daemon ctor kwarg, joined local, self-daemonizing subclass,
+late ``x.daemon = True``.  Bad: fire-and-forget non-daemon ctor
+(unjoined), joined-but-hangable target (timeout-less queue.get), and a
+non-daemon Thread subclass whose ``run`` reaches the same hang.
+"""
+import queue
+import threading
+
+_q = queue.Queue()
+
+
+def work():
+    pass
+
+
+def drain():
+    while True:
+        item = _q.get()
+        if item is None:
+            break
+
+
+def spawn_daemon():
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+
+
+def spawn_joined():
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(timeout=5)
+
+
+def spawn_late_daemon():
+    ld = threading.Thread(target=work)
+    ld.daemon = True
+    ld.start()
+
+
+def spawn_bad():
+    t2 = threading.Thread(target=work)
+    t2.start()
+
+
+def spawn_hang():
+    h = threading.Thread(target=drain)
+    h.start()
+    h.join(timeout=5)
+
+
+class GoodWorker(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+
+    def run(self):
+        work()
+
+
+class BadWorker(threading.Thread):
+    def run(self):
+        drain()
+
+
+def spawn_subclasses():
+    GoodWorker().start()
+    w = BadWorker()
+    w.start()

@@ -1,0 +1,24 @@
+"""GL009 fixture, client half: cmd literals via ``self._rpc``, a
+health-context dict drifted against the server's key table, and a
+replay-guarded op set drifted against the server's ``_MUTATING``."""
+
+_SEQ_OPS = frozenset(("push", "extra_op"))
+
+
+class Client:
+    def _rpc(self, cmd, **kw):
+        return cmd, kw
+
+    def push(self, key, value):
+        return self._rpc("push", key=key, value=value)
+
+    def pull(self, key):
+        return self._rpc("pull", key=key)
+
+    def renamed(self):
+        # server side was renamed; nothing compares against this cmd
+        return self._rpc("renamed_cmd")
+
+    def heartbeat(self):
+        health_ctx = {"r": 1, "extra": 2}
+        return self._rpc("push", health_ctx=health_ctx)

@@ -1,0 +1,55 @@
+"""GL009 fixture, server half: dispatch literals, wrapper-key
+pack/parse sets, and context validators — one complete
+(``_check_health_ctx``), one missing its completeness check
+(``_check_trace_ctx``)."""
+
+_TC_KEYS = frozenset(("t", "s"))
+_HC_KEYS = frozenset(("r", "st"))
+_MUTATING = frozenset(("push",))
+
+
+def _frame_error(msg):
+    raise ValueError(msg)
+
+
+def _check_trace_ctx(tc):
+    if set(tc) - _TC_KEYS:
+        _frame_error("unknown trace keys")
+    return tc
+
+
+def _check_health_ctx(hc):
+    if set(hc) - _HC_KEYS:
+        _frame_error("unknown health keys")
+    if set(hc) != _HC_KEYS:
+        _frame_error("missing health keys")
+    return hc
+
+
+def _pack_payload(node, trace_ctx=None, health_ctx=None):
+    node = {"m": node}
+    if trace_ctx:
+        node["tc"] = dict(trace_ctx)
+    if health_ctx:
+        node["h"] = dict(health_ctx)
+    node["dbg"] = {}
+    return node
+
+
+def _parse_payload(hdr):
+    extra = set(hdr) - {"m", "tc", "h", "zz"}
+    if extra:
+        _frame_error("unknown wrapper keys")
+    tc = _check_trace_ctx(hdr["tc"]) if "tc" in hdr else None
+    hc = _check_health_ctx(hdr["h"]) if "h" in hdr else None
+    return hdr["m"], tc, hc
+
+
+def handle(cmd, payload):
+    if cmd == "push":
+        return "ok"
+    if cmd == "pull":
+        return "ok"
+    if cmd == "dead_cmd":
+        return "ok"
+    _frame_error("unknown command")

@@ -1,0 +1,9 @@
+"""GL009 fixture: the trace context the client sends rides in from
+``flow_out`` — its dict keys are the client half of the ``tc`` wire
+contract (the "x" key is the drift)."""
+
+
+def flow_out(span):
+    if span is None:
+        return {"t": "0", "s": "0"}
+    return {"t": span.trace_id, "s": span.span_id, "x": span.extra}

@@ -1,0 +1,16 @@
+"""GL010 fixture: one documented emit, one undocumented emit, one
+dynamic-name emit (flagged — only runlog.py's own shims may forward a
+parameterized name)."""
+from . import runlog as _runlog
+
+
+def good(step):
+    _runlog.event("fixture_documented", step=step)
+
+
+def bad(step):
+    _runlog.event("fixture_undocumented", step=step)
+
+
+def dynamic(name):
+    _runlog.event(name)

@@ -1,0 +1,39 @@
+"""GL011 fixture: callbacks under a lock (bad) vs snapshot-then-fire
+(good) vs an in-project callee with a hook-shaped name (analysed for
+real, not assumed hostile)."""
+import threading
+
+
+class Sched:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+        self._level = 0
+
+    def register(self, cb):
+        with self._lock:
+            self._callbacks.append(cb)
+
+    def fire_bad(self, level):
+        with self._lock:
+            self._level = level
+            for cb in self._callbacks:
+                cb(level)
+
+    def fire_hook_bad(self, hook):
+        with self._lock:
+            hook(self._level)
+
+    def fire_good(self, level):
+        with self._lock:
+            self._level = level
+            cbs = list(self._callbacks)
+        for cb in cbs:
+            cb(level)
+
+    def _refresh_hook(self):
+        return self._level
+
+    def fire_internal_ok(self):
+        with self._lock:
+            self._refresh_hook()

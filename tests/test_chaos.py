@@ -15,8 +15,9 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import chaos, nd
 from mxnet_tpu.kvstore import backoff_delay
-from mxnet_tpu.kvstore_server import (KVStoreServer, _pack_payload,
-                                      _parse_payload, recv_msg, send_msg)
+from mxnet_tpu.kvstore_server import (KVStoreServer, _check_trace_ctx,
+                                      _pack_payload, _parse_payload,
+                                      recv_msg, send_msg)
 from mxnet_tpu.parallel.elastic import ElasticRunner
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -70,6 +71,24 @@ def test_replayed_push_frame_applies_once(monkeypatch):
         s.close()
     finally:
         srv.shutdown()
+
+
+def test_trace_ctx_missing_fields_rejected_loudly():
+    """GL009 (wire-contract lint) caught _check_trace_ctx rejecting
+    unknown keys but never checking completeness: a frame with a
+    half-built trace context sailed through validation.  Missing fields
+    must be a loud frame error like every other framing violation."""
+    assert _check_trace_ctx({"t": "a" * 8, "s": "b" * 8}) == \
+        {"t": "a" * 8, "s": "b" * 8}
+    for tc in ({}, {"t": "a" * 8}, {"s": "b" * 8}):
+        with pytest.raises(mx.base.MXNetError):
+            _check_trace_ctx(tc)
+    # end to end: the packed frame with the incomplete context is
+    # rejected at parse, not silently accepted
+    payload = _pack_payload(["push", "w", np.zeros(2, np.float32)],
+                            trace_ctx={"t": "a" * 8})
+    with pytest.raises(mx.base.MXNetError):
+        _parse_payload(payload)
 
 
 def test_corrupted_header_rejected_loudly():

@@ -1,0 +1,216 @@
+"""Runtime lock-order sanitizer tests (mxnet_tpu/locksmith.py).
+
+In-process: hand-built traced locks exercise the edge recorder and the
+live ABBA detector on a deadlock-free interleaving (the two orders just
+have to EXIST — sequentially in one thread is enough), and the
+static-graph diff semantics (ok edge / inversion / unknown site).
+
+Subprocess: the chaos and serving probes run under ``MXNET_LOCKCHECK=1``
+with the static graph pre-dumped (``--dump-lock-graph``) so the exit
+hook doesn't re-parse the tree per process; every per-pid report must
+come back ok — zero cycles, zero inversions, zero unknown lock sites.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from mxnet_tpu import locksmith  # noqa: E402
+
+SITE_A = "mxnet_tpu/fake.py:10"
+SITE_B = "mxnet_tpu/fake.py:20"
+
+
+@pytest.fixture(autouse=True)
+def clean_state(tmp_path, monkeypatch):
+    # point the report's static-diff at a tiny pre-dumped graph so no
+    # in-process test pays the full-tree parse in _load_static_graph
+    path = tmp_path / "default_static.json"
+    path.write_text(json.dumps(_static_graph([["la", "lb"]])))
+    monkeypatch.setenv("MXNET_LOCKCHECK_STATIC", str(path))
+    locksmith.reset()
+    yield
+    locksmith.reset()
+
+
+def _traced(site):
+    with locksmith._mu:
+        locksmith._sites.setdefault(
+            site, {"kind": "Lock", "rel": site.rsplit(":", 1)[0],
+                   "line": int(site.rsplit(":", 1)[1])})
+    return locksmith._TracedLock(threading.Lock(), site)
+
+
+def _static_graph(edges):
+    return {"version": 1,
+            "locks": {"la": {}, "lb": {}},
+            "sites": {SITE_A: "la", SITE_B: "lb"},
+            "edges": edges}
+
+
+class TestAbbaDetection:
+    def test_abba_detected_without_deadlock(self, capsys):
+        """A -> B then B -> A, sequentially in one thread: no deadlock
+        ever happens, but both orders now exist — the live detector must
+        record the cycle the moment the second edge is inserted."""
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        with a:
+            with b:
+                pass
+        assert not locksmith._cycles
+        with b:
+            with a:
+                pass
+        assert len(locksmith._cycles) == 1
+        chain = locksmith._cycles[0]["chain"]
+        assert chain[0] == chain[-1]
+        assert {SITE_A, SITE_B} <= set(chain)
+        rep = locksmith.report()
+        assert not rep["ok"]
+        assert rep["diff"]["cycles"]
+
+    def test_consistent_order_is_clean(self):
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert not locksmith._cycles
+        assert [e[:2] for e in locksmith.report()["edges"]] == \
+            [[SITE_A, SITE_B]]
+
+    def test_hand_over_hand_release_order(self):
+        """Releasing the OUTER lock first must not corrupt the held
+        stack: the next acquisition only sees B held, so no A-edge."""
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        a.acquire()
+        b.acquire()
+        a.release()              # outer released first
+        c = _traced("mxnet_tpu/fake.py:30")
+        c.acquire()
+        c.release()
+        b.release()
+        edges = {tuple(e[:2]) for e in locksmith.report()["edges"]}
+        assert (SITE_B, "mxnet_tpu/fake.py:30") in edges
+        assert (SITE_A, "mxnet_tpu/fake.py:30") not in edges
+
+
+class TestStaticDiff:
+    def _report_against(self, edges, tmp_path, monkeypatch):
+        path = tmp_path / "static.json"
+        path.write_text(json.dumps(_static_graph(edges)))
+        monkeypatch.setenv("MXNET_LOCKCHECK_STATIC", str(path))
+        return locksmith.report()
+
+    def test_edge_in_static_graph_ok(self, tmp_path, monkeypatch):
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        with a:
+            with b:
+                pass
+        rep = self._report_against([["la", "lb"]], tmp_path, monkeypatch)
+        assert rep["static_graph"]
+        assert rep["ok"], rep["diff"]
+        assert not rep["diff"]["uncovered_edges"]
+
+    def test_inverted_edge_fails(self, tmp_path, monkeypatch):
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        with b:
+            with a:
+                pass
+        rep = self._report_against([["la", "lb"]], tmp_path, monkeypatch)
+        assert rep["diff"]["inversions"] == [["lb", "la"]]
+        assert not rep["ok"]
+
+    def test_uncovered_edge_is_informational(self, tmp_path, monkeypatch):
+        a, b = _traced(SITE_A), _traced(SITE_B)
+        with a:
+            with b:
+                pass
+        rep = self._report_against([], tmp_path, monkeypatch)
+        assert rep["diff"]["uncovered_edges"] == [["la", "lb"]]
+        assert rep["ok"]     # observed ⊆ static does not hold in general
+
+    def test_unknown_site_fails(self, tmp_path, monkeypatch):
+        rogue = _traced("mxnet_tpu/rogue.py:1")
+        with rogue:
+            pass
+        rep = self._report_against([], tmp_path, monkeypatch)
+        assert rep["diff"]["unknown_locks"] == ["mxnet_tpu/rogue.py:1"]
+        assert not rep["ok"]
+
+
+def test_install_gated_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_LOCKCHECK", raising=False)
+    assert not locksmith.installed()
+    assert locksmith.install() is False
+    assert threading.Lock is locksmith._real_lock
+
+
+# ---------------------------------------------------------------------------
+# probes under the sanitizer: empty static-vs-dynamic diff
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def static_graph_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("lockcheck") / "lockgraph.json"
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--dump-lock-graph"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    graph = json.loads(out.stdout)
+    assert graph["version"] == 1 and graph["sites"]
+    path.write_text(out.stdout)
+    return str(path)
+
+
+def _run_probe(script, tmp_path, static_graph_file, timeout):
+    report_dir = str(tmp_path / "lockrep")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXNET_LOCKCHECK": "1",
+        "MXNET_LOCKCHECK_STATIC": static_graph_file,
+        "MXNET_LOCKCHECK_REPORT": report_dir,
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, out.stdout + out.stderr
+    reports = []
+    for name in sorted(os.listdir(report_dir)):
+        with open(os.path.join(report_dir, name)) as fh:
+            reports.append(json.load(fh))
+    assert reports, "no lockcheck reports written"
+    return reports
+
+
+def _assert_clean(reports):
+    for rep in reports:
+        assert rep["enabled"] and rep["static_graph"]
+        assert rep["sites"], "sanitizer saw no instrumented locks"
+        diff = rep["diff"]
+        assert rep["ok"], diff
+        assert diff["cycles"] == []
+        assert diff["inversions"] == []
+        assert diff["unknown_locks"] == []
+
+
+def test_chaos_probe_clean_under_lockcheck(tmp_path, static_graph_file):
+    """Every process of the chaos probe (supervisor + forked gang) must
+    exit with an empty static-vs-dynamic lock diff."""
+    reports = _run_probe("chaos_probe.py", tmp_path, static_graph_file,
+                         timeout=180)
+    assert len(reports) > 1, "expected reports from the forked gang too"
+    _assert_clean(reports)
+
+
+def test_serving_probe_clean_under_lockcheck(tmp_path, static_graph_file):
+    reports = _run_probe("serving_probe.py", tmp_path, static_graph_file,
+                         timeout=120)
+    _assert_clean(reports)

@@ -6,6 +6,17 @@ Checks (see docs/lint.md):
   GL003  lock discipline: consistent order, no blocking under hot locks
   GL004  donation contract: donate_argnums pairs with pool/audit
   GL005  metric registry: telemetry names match docs/observability.md
+  GL006  named scopes on telemetry/profiling blocks
+  GL007  env-knob registry: MXNET_* reads match docs/knobs.md
+  GL008  thread discipline: every thread daemon or provably joined
+  GL009  kvstore wire contract: client and server halves match
+  GL010  runlog events: emitted names match the documented table
+  GL011  lock-callback discipline: no callbacks invoked under a lock
+
+GL001-GL003 and GL011 run over a shared interprocedural dataflow core
+(tools/graftlint/dataflow.py): call-graph reachability with env-key
+taint propagation and a held-lock-set lock model, built once per
+Project and reused across checks.
 
 Run: ``python -m tools.graftlint`` (see --help).
 """
@@ -76,6 +87,9 @@ def run_checks(project: Project, checks: Optional[Sequence[str]] = None,
                     "no-reason:%s" % ",".join(sorted(sup.codes))))
 
     new, old, stale = split_by_baseline(kept, baseline or [])
+    # a baseline entry can only be judged stale by the check that owns
+    # it — subset runs must not flag the other checks' entries
+    stale = [fp for fp in stale if fp.split("|", 1)[0] in selected]
     result.findings = sorted(new, key=lambda f: (f.path, f.line, f.code))
     result.baselined = sorted(old, key=lambda f: (f.path, f.line, f.code))
     result.stale_baseline = stale

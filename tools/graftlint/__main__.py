@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -24,18 +25,78 @@ def _find_root(start: Path) -> Path:
     return cur
 
 
+def _changed_paths(root: Path, ref: str):
+    """Repo-relative posix paths changed vs ``ref`` plus untracked files,
+    or None when git fails (not a repo, bad ref)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=str(root), capture_output=True, text=True, timeout=30)
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=str(root), capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    paths = {p.strip() for p in diff.stdout.splitlines() if p.strip()}
+    for line in status.stdout.splitlines():
+        if len(line) > 3:
+            paths.add(line[3:].split(" -> ")[-1].strip().strip('"'))
+    return paths
+
+
+def _sarif(result, root: Path) -> dict:
+    """SARIF 2.1.0 log: one run, one rule per check, findings (non-
+    baselined, non-suppressed) as results with the line-free fingerprint
+    so SARIF-aware CI dedups across line churn like the baseline does."""
+    rules = [{"id": code,
+              "shortDescription": {"text": DESCRIPTIONS[code]},
+              "helpUri": "docs/lint.md"}
+             for code in sorted(ALL_CHECKS)]
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {"primary": f.fingerprint},
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "graftlint",
+                                "informationUri": "docs/lint.md",
+                                "rules": rules}},
+            "originalUriBaseIds": {"SRCROOT": {"uri": root.as_uri() + "/"}},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="whole-program static analyzer for mxnet_tpu's "
-                    "jit-cache, tracer-purity, lock, donation and metric "
-                    "contracts (docs/lint.md)")
+                    "jit-cache, tracer-purity, lock, donation, metric, "
+                    "env-knob, thread, wire and runlog contracts "
+                    "(docs/lint.md)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: auto-detect)")
     ap.add_argument("--checks", default=None,
                     help="comma-separated subset, e.g. GL001,GL003 "
                          "(default: all)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: tools/graftlint/"
                          "baseline.json)")
@@ -44,6 +105,19 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write all current findings to the baseline file "
                          "and exit 0")
+    ap.add_argument("--changed-only", metavar="GIT_REF", default=None,
+                    help="report only findings in files changed vs the "
+                         "given git ref (plus untracked files); the "
+                         "analysis itself stays whole-program, and stale-"
+                         "baseline enforcement is skipped")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate the table in docs/knobs.md from the "
+                         "tree's MXNET_* reads (preserves the description "
+                         "column) and exit")
+    ap.add_argument("--dump-lock-graph", action="store_true",
+                    help="print the static lock-acquisition graph as JSON "
+                         "(consumed by the MXNET_LOCKCHECK runtime "
+                         "sanitizer) and exit")
     ap.add_argument("--smoke", action="store_true",
                     help="one-line summary only (for the verify recipe)")
     ap.add_argument("--list-checks", action="store_true")
@@ -70,6 +144,29 @@ def main(argv=None) -> int:
 
     try:
         project = Project(root)
+    except ValueError as exc:
+        print("graftlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    if args.dump_lock_graph:
+        from .dataflow import lock_graph
+        print(json.dumps(lock_graph(project), indent=2, sort_keys=True))
+        return 0
+
+    if args.write_knobs:
+        from .checks.gl007_env_knobs import render_knobs_md
+        knobs_path = root / "docs" / "knobs.md"
+        existing = knobs_path.read_text(encoding="utf-8") \
+            if knobs_path.exists() else None
+        knobs_path.parent.mkdir(parents=True, exist_ok=True)
+        knobs_path.write_text(render_knobs_md(project, existing),
+                              encoding="utf-8")
+        from .checks.gl007_env_knobs import collect_env_knobs
+        print("graftlint: wrote %d knobs to %s"
+              % (len(collect_env_knobs(project)), knobs_path))
+        return 0
+
+    try:
         result = run_checks(project, checks=checks, baseline=baseline)
     except ValueError as exc:
         print("graftlint: %s" % exc, file=sys.stderr)
@@ -81,6 +178,16 @@ def main(argv=None) -> int:
         print("graftlint: wrote %d fingerprints to %s"
               % (len(result.all_raw), baseline_path))
         return 0
+
+    if args.changed_only is not None:
+        changed = _changed_paths(root, args.changed_only)
+        if changed is None:
+            print("graftlint: cannot resolve changed files vs %r "
+                  "(not a git checkout, or bad ref)" % args.changed_only,
+                  file=sys.stderr)
+            return 2
+        result.findings = [f for f in result.findings if f.path in changed]
+        result.stale_baseline = []
 
     elapsed = time.time() - t0
     summary = ("graftlint: %d finding(s), %d baselined, %d suppressed, "
@@ -108,6 +215,8 @@ def main(argv=None) -> int:
                 "seconds": round(elapsed, 3),
             },
         }, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(_sarif(result, root), indent=2))
     elif args.smoke:
         print(summary)
     else:

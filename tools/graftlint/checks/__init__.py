@@ -3,13 +3,17 @@ from __future__ import annotations
 
 from . import (gl001_env_cache_key, gl002_tracer_purity,
                gl003_lock_discipline, gl004_donation, gl005_metric_registry,
-               gl006_named_scope)
+               gl006_named_scope, gl007_env_knobs, gl008_thread_discipline,
+               gl009_wire_contract, gl010_runlog_events, gl011_lock_callbacks)
 
 ALL_CHECKS = {
     mod.CODE: mod
     for mod in (gl001_env_cache_key, gl002_tracer_purity,
                 gl003_lock_discipline, gl004_donation,
-                gl005_metric_registry, gl006_named_scope)
+                gl005_metric_registry, gl006_named_scope,
+                gl007_env_knobs, gl008_thread_discipline,
+                gl009_wire_contract, gl010_runlog_events,
+                gl011_lock_callbacks)
 }
 
 DESCRIPTIONS = {mod.CODE: mod.TITLE for mod in ALL_CHECKS.values()}

@@ -11,26 +11,15 @@ declaration widens every cache key for nothing).
 from __future__ import annotations
 
 from ..core import Finding, Project, fn_qual
+from ..dataflow import function_env_reads, reachable_env_reads
 
 CODE = "GL001"
 TITLE = "env-cache-key: traced env reads must be declared in the cache key"
 
-
-def _collect_reads(project: Project, root):
-    """{key: (rel, line)} + [(rel, line, qual)] dynamic reads reachable
-    from ``root``."""
-    reads = {}
-    dynamic = []
-    for g in project.reachable([root]):
-        scope = getattr(g, "_gl", None)
-        if scope is None:
-            continue
-        for er in project.facts(g).env_reads:
-            if er.key is None:
-                dynamic.append((scope.mod.rel, er.line, fn_qual(g)))
-            else:
-                reads.setdefault(er.key, (scope.mod.rel, er.line))
-    return reads, dynamic
+# interprocedural reachable-reads collection (env-key taint included:
+# a literal key passed through any chain of keyed accessors counts as a
+# read at the outermost call site) lives in ..dataflow
+_collect_reads = reachable_env_reads
 
 
 def run(project: Project):
@@ -81,7 +70,7 @@ def run(project: Project):
         read_anywhere = set()
         for mod in project.modules.values():
             for fn in mod.functions.values():
-                for er in project.facts(fn).env_reads:
+                for er in function_env_reads(project, fn):
                     if er.key is not None:
                         read_anywhere.add(er.key)
         for key in sorted(step_keys):

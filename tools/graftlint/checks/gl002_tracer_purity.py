@@ -14,6 +14,7 @@ Environ reads are exempted for roots that have a declaration mechanism
 from __future__ import annotations
 
 from ..core import Finding, Project, fn_qual
+from ..dataflow import function_env_reads
 
 CODE = "GL002"
 TITLE = "tracer purity: no host side effects reachable from traced code"
@@ -63,7 +64,7 @@ def run(project: Project):
                     "bump:%s:%s" % (gq, b.metric or b.instrument)),
                     root_desc)
             if id(root) not in env_exempt_ids:
-                for er in facts.env_reads:
+                for er in function_env_reads(project, g):
                     emit(Finding(
                         CODE, gmod.rel, er.line,
                         "environ read %s inside traced code (reached from "

@@ -1,8 +1,12 @@
 """GL003: lock-order and blocking-under-lock discipline.
 
-Builds the lock-acquisition graph over every ``threading.Lock`` /
-``RLock`` / ``Condition`` site in the tree (``with`` statements plus a
-transitive walk through resolvable callees).  Two findings:
+Runs over the shared interprocedural lock model in
+:mod:`tools.graftlint.dataflow` (one :class:`LockAnalysis` per project,
+reused by GL011 and the ``--dump-lock-graph`` export / runtime
+sanitizer).  The model builds the lock-acquisition graph over every
+``threading.Lock`` / ``RLock`` / ``Condition`` site in the tree
+(``with`` statements plus a transitive walk through resolvable callees,
+local aliases like ``lk = self._lock`` included).  Two findings:
 
 - **order**: lock pair acquired in both orders somewhere in the tree — a
   potential ABBA deadlock.
@@ -14,294 +18,23 @@ transitive walk through resolvable callees).  Two findings:
   never wait on the device or the network.
 
 Lock identity is static: ``module.Class.attr`` for instance locks,
-``module.name`` for module globals.  ``Condition(lock)`` aliases the
+``module.name`` for module globals, an anonymous family id for locks
+created dynamically (dict-of-locks).  ``Condition(lock)`` aliases the
 wrapped lock; ``Condition.wait`` releases it, so ``wait`` is deliberately
-not in the blocking set.  Unresolvable lock expressions (dict-of-locks,
-``with self._lock_for(k)``) are skipped, never guessed.
+not in the blocking set.  Unresolvable lock expressions are skipped,
+never guessed.
 """
 from __future__ import annotations
 
-import ast
-from typing import Dict, List, Optional, Set, Tuple
-
-from ..core import Finding, Project, _dotted, fn_qual
+from ..core import Finding, Project
+from ..dataflow import lock_analysis
 
 CODE = "GL003"
 TITLE = "lock discipline: consistent order, no blocking under hot locks"
 
-_BLOCKING_ATTRS = {
-    "asnumpy": ".asnumpy() host sync",
-    "block_until_ready": "block_until_ready device sync",
-    "wait_to_read": "wait_to_read device sync",
-    "recv": "socket recv",
-    "recv_into": "socket recv",
-    "recvfrom": "socket recv",
-    "recv_msg": "socket recv",
-    "recv_msg_full": "socket recv",
-    "accept": "socket accept",
-}
-
-# default: modules whose locks guard hot paths; overridable for fixtures
-_DEFAULT_SCOPE = ("telemetry", "engine", "serving", "health")
-
-_MAX_DEPTH = 8
-
-
-def _blocking_kind(site) -> Optional[str]:
-    chain, canon, call = site.chain, site.canon or "", site.node
-    if not chain:
-        return None
-    last = chain[-1]
-    if last in _BLOCKING_ATTRS:
-        return _BLOCKING_ATTRS[last]
-    if canon == "time.sleep":
-        return "time.sleep"
-    if last == "get" and len(chain) > 1 and not call.args and \
-            not any(kw.arg in ("timeout", "block") for kw in call.keywords):
-        return "queue.get() without timeout"
-    if last == "join" and len(chain) > 1 and not call.args and \
-            not call.keywords:
-        return "join() without timeout"
-    return None
-
-
-class _Summary:
-    __slots__ = ("acquires", "blocking")
-
-    def __init__(self):
-        self.acquires: Set[str] = set()
-        # (kind, rel, line, qual) of blocking sites in fn + callees
-        self.blocking: List[Tuple[str, str, int, str]] = []
-
-
-class _Analysis:
-    def __init__(self, project: Project):
-        self.project = project
-        self.locks: Dict[str, str] = {}           # lock id -> kind
-        self.cond_alias: Dict[str, str] = {}      # condition id -> lock id
-        self.summaries: Dict[int, _Summary] = {}
-        self.in_progress: Set[int] = set()
-        # (a, b) -> (rel, line, qual) first site acquiring b while holding a
-        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
-        self.blocking_findings: List[Finding] = []
-        self.scope = tuple(project.config.get(
-            "lock_scope_modules", _DEFAULT_SCOPE))
-
-    # -- lock definition table -------------------------------------------
-    def collect_locks(self):
-        pending_conds = []
-        for mod in self.project.modules.values():
-            # module-level globals
-            for node in mod.tree.body:
-                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name):
-                    kind = self._ctor_kind(mod, node.value)
-                    if kind:
-                        lid = "%s.%s" % (mod.name, node.targets[0].id)
-                        self._add(lid, kind, mod, node.value, pending_conds)
-            # self.X = threading.Lock() inside methods
-            for fn in mod.functions.values():
-                scope = fn._gl
-                if scope.cls is None:
-                    continue
-                for node in _own_nodes(fn):
-                    if not isinstance(node, ast.Assign) or \
-                            len(node.targets) != 1:
-                        continue
-                    tgt = node.targets[0]
-                    if not (isinstance(tgt, ast.Attribute) and
-                            isinstance(tgt.value, ast.Name) and
-                            tgt.value.id == "self"):
-                        continue
-                    kind = self._ctor_kind(mod, node.value)
-                    if kind:
-                        lid = "%s.%s.%s" % (mod.name, scope.cls, tgt.attr)
-                        self._add(lid, kind, mod, node.value, pending_conds)
-        # resolve Condition(self.X) aliases now the lock table is complete
-        for lid, mod, call in pending_conds:
-            if call.args:
-                arg = call.args[0]
-                if isinstance(arg, ast.Attribute) and \
-                        isinstance(arg.value, ast.Name) and \
-                        arg.value.id == "self":
-                    owner = lid.rsplit(".", 1)[0]
-                    target = "%s.%s" % (owner, arg.attr)
-                    if target in self.locks:
-                        self.cond_alias[lid] = target
-                        continue
-            self.locks.setdefault(lid, "Condition")
-
-    def _add(self, lid, kind, mod, value, pending_conds):
-        if kind == "Condition":
-            pending_conds.append((lid, mod, value))
-        else:
-            self.locks[lid] = kind
-
-    def _ctor_kind(self, mod, value) -> Optional[str]:
-        if not isinstance(value, ast.Call):
-            return None
-        chain = _dotted(value.func)
-        if not chain or chain[-1] not in ("Lock", "RLock", "Condition"):
-            return None
-        canon = self.project.canonical(mod, chain) or ""
-        if "threading" in canon or chain[0] in ("threading", "_threading") \
-                or len(chain) == 1:
-            return chain[-1]
-        return None
-
-    # -- acquisition resolution ------------------------------------------
-    def acquire_id(self, mod, scope, expr) -> Optional[str]:
-        lid = None
-        if isinstance(expr, ast.Attribute) and \
-                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
-                and scope is not None and scope.cls is not None:
-            lid = "%s.%s.%s" % (mod.name, scope.cls, expr.attr)
-        elif isinstance(expr, ast.Name):
-            if expr.id in mod.from_imports:
-                src, attr = mod.from_imports[expr.id]
-                lid = "%s.%s" % (src, attr)
-            else:
-                lid = "%s.%s" % (mod.name, expr.id)
-        elif isinstance(expr, ast.Attribute) and \
-                isinstance(expr.value, ast.Name):
-            base = expr.value.id
-            if base in mod.imports:
-                lid = "%s.%s" % (mod.imports[base], expr.attr)
-        if lid is None:
-            return None
-        lid = self.cond_alias.get(lid, lid)
-        return lid if lid in self.locks else None
-
-    def in_scope(self, lock_id: str) -> bool:
-        modpart = lock_id.lower()
-        return any(s in modpart for s in self.scope)
-
-    # -- per-function summaries ------------------------------------------
-    def summarize(self, fn, depth=0) -> _Summary:
-        cached = self.summaries.get(id(fn))
-        if cached is not None:
-            return cached
-        s = _Summary()
-        if depth > _MAX_DEPTH or id(fn) in self.in_progress:
-            return s
-        self.in_progress.add(id(fn))
-        self._walk_fn(fn, s, depth)
-        self.in_progress.discard(id(fn))
-        self.summaries[id(fn)] = s
-        return s
-
-    def _walk_fn(self, fn, summary: _Summary, depth):
-        scope = getattr(fn, "_gl", None)
-        if scope is None:
-            return
-        mod = scope.mod
-        qual = fn_qual(fn)
-        project = self.project
-
-        def record_blocking(kind, line, held):
-            site = (kind, mod.rel, line, qual)
-            if len(summary.blocking) < 50:
-                summary.blocking.append(site)
-            self._maybe_flag(site, held)
-
-        def handle_call(node, held):
-            chain = _dotted(node.func)
-            canon = project.canonical(mod, chain) if chain else None
-            site = _FakeSite(node, chain, canon)
-            kind = _blocking_kind(site)
-            if kind:
-                record_blocking(kind, node.lineno, held)
-            if not chain:
-                return
-            for tgt in project.resolve_chain(mod, scope, chain):
-                sub = self.summarize(tgt, depth + 1)
-                summary.acquires |= sub.acquires
-                for h in held:
-                    for a in sub.acquires:
-                        if a != h:
-                            self.edges.setdefault(
-                                (h, a), (mod.rel, node.lineno, qual))
-                for bsite in sub.blocking:
-                    if len(summary.blocking) < 50:
-                        summary.blocking.append(bsite)
-                    self._maybe_flag(bsite, held)
-
-        def visit(node, held):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                acquired = []
-                for item in node.items:
-                    for sub in ast.walk(item.context_expr):
-                        if isinstance(sub, ast.Call):
-                            handle_call(sub, held)
-                    lid = self.acquire_id(mod, scope, item.context_expr)
-                    if lid is not None:
-                        for h in held:
-                            if h != lid:
-                                self.edges.setdefault(
-                                    (h, lid),
-                                    (mod.rel, node.lineno, qual))
-                        acquired.append(lid)
-                        summary.acquires.add(lid)
-                new_held = held + tuple(a for a in acquired
-                                        if a not in held)
-                for b in node.body:
-                    visit(b, new_held)
-                return
-            if isinstance(node, ast.Call):
-                handle_call(node, held)
-            for child in ast.iter_child_nodes(node):
-                visit(child, held)
-
-        body = fn.body if isinstance(fn.body, list) else [fn.body]
-        for stmt in body:
-            visit(stmt, ())
-
-    def _maybe_flag(self, bsite, held):
-        if not held:
-            return
-        kind, rel, line, qual = bsite
-        for h in held:
-            if self.in_scope(h):
-                self.blocking_findings.append(Finding(
-                    CODE, rel, line,
-                    "%s in %s while holding %s — a hot-path lock must "
-                    "never wait on the device or the network"
-                    % (kind, qual, h),
-                    "blocking:%s:%s:%s" % (kind.split()[0], qual, h)))
-                return
-
-
-class _FakeSite:
-    __slots__ = ("node", "chain", "canon")
-
-    def __init__(self, node, chain, canon):
-        self.node = node
-        self.chain = chain
-        self.canon = canon
-
-
-def _own_nodes(fn):
-    """All AST nodes of ``fn`` excluding nested function bodies."""
-    def rec(node):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            yield child
-            yield from rec(child)
-    body = fn.body if isinstance(fn.body, list) else [fn.body]
-    for stmt in body:
-        yield stmt
-        yield from rec(stmt)
-
 
 def run(project: Project):
-    an = _Analysis(project)
-    an.collect_locks()
-    for mod in project.modules.values():
-        for fn in mod.functions.values():
-            an.summarize(fn)
+    an = lock_analysis(project)
 
     findings = list(an.blocking_findings)
     # deduplicate blocking findings (same site reached via several callers)

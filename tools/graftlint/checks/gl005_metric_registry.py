@@ -52,14 +52,21 @@ def _code_metrics(project: Project):
 
 
 def _doc_metrics(path: Path):
-    """{metric_name: line} from markdown table rows (first cell)."""
+    """{metric_name: line} from markdown table rows (first cell).  The
+    *Runlog events* section documents ledger event names, not metrics —
+    that table belongs to GL010 and is skipped here."""
     out = {}
     if not path.exists():
         return None
+    in_events = False
     for i, line in enumerate(path.read_text(encoding="utf-8").splitlines(),
                              start=1):
         stripped = line.strip()
-        if not stripped.startswith("| `"):
+        if stripped.startswith("#"):
+            in_events = bool(re.match(r"^#+\s+.*runlog events",
+                                      stripped, re.IGNORECASE))
+            continue
+        if in_events or not stripped.startswith("| `"):
             continue
         first_cell = stripped.split("|")[1]
         for name in re.findall(r"`([^`]+)`", first_cell):

@@ -1,0 +1,299 @@
+"""GL007: every ``MXNET_*`` env knob is documented in docs/knobs.md.
+
+The tree reads ~80 distinct ``MXNET_*`` environment variables; an
+undocumented knob is invisible to operators, a documented-but-gone knob
+is a config file that silently stopped working, and a doc default that
+drifted from the code is worse than no doc at all.  This check extracts
+every literal ``MXNET_*`` read (``os.environ.get`` / ``os.getenv`` /
+``os.environ[...]`` / ``get_env`` / keys routed through any keyed
+accessor the env-taint pass resolves) with its default and owning
+module, and diffs against the generated table in ``docs/knobs.md``:
+
+- a read with no table row  -> **undocumented** knob;
+- a table row with no read  -> **ghost** knob (dead doc, or the read was
+  deleted without regenerating);
+- a row whose default or module list differs from the code -> **drift**.
+
+The table is generated — ``python -m tools.graftlint --write-knobs``
+rewrites the block between the ``knobs:begin``/``knobs:end`` markers,
+preserving the hand-written description column by knob name — so fixing
+any of the three findings is one command plus a review of the diff.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, _dotted
+from ..dataflow import env_taint
+
+CODE = "GL007"
+TITLE = "env-knob registry: MXNET_* reads match docs/knobs.md"
+
+KNOBS_BEGIN = "<!-- knobs:begin -->"
+KNOBS_END = "<!-- knobs:end -->"
+
+_KNOB_RE = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_SIMPLE_STR = re.compile(r"^[A-Za-z0-9_./:+-]*$")
+
+
+class Knob:
+    __slots__ = ("key", "sites", "defaults", "dtypes")
+
+    def __init__(self, key):
+        self.key = key
+        self.sites: List[Tuple[str, int, str]] = []   # (rel, line, module)
+        self.defaults: set = set()
+        self.dtypes: set = set()
+
+
+def _render_default(node, mod, project) -> str:
+    if node is None:
+        return "unset"
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if v is None:
+            return "unset"
+        if isinstance(v, str):
+            return v if v and _SIMPLE_STR.match(v) else repr(v)
+        return repr(v)
+    got = project.const_str(mod, None, node)
+    if got is not None:
+        return got if _SIMPLE_STR.match(got) else repr(got)
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is py3.9+
+        text = "<expr>"
+    return "computed: %s" % (text[:40] + ("…" if len(text) > 40 else ""))
+
+
+def _call_default(call: ast.Call, key_index: int):
+    """(default node or None, dtype node or None) of an env-read call."""
+    default = None
+    dtype = None
+    if len(call.args) > key_index + 1:
+        default = call.args[key_index + 1]
+    if len(call.args) > key_index + 2:
+        dtype = call.args[key_index + 2]
+    for kw in call.keywords:
+        if kw.arg == "default":
+            default = kw.value
+        elif kw.arg == "dtype":
+            dtype = kw.value
+    return default, dtype
+
+
+def collect_env_knobs(project: Project) -> Dict[str, Knob]:
+    """Every literal MXNET_* read in the project, with defaults/types.
+    Cached per project (the CLI generate path and the check share it)."""
+    cached = getattr(project, "_gl_env_knobs", None)
+    if cached is not None:
+        return cached
+    knobs: Dict[str, Knob] = {}
+
+    def add(key, mod, line, default_s, dtype_s):
+        if not _KNOB_RE.match(key):
+            return
+        k = knobs.setdefault(key, Knob(key))
+        k.sites.append((mod.rel, line, mod.name))
+        k.defaults.add(default_s)
+        if dtype_s:
+            k.dtypes.add(dtype_s)
+
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                if not chain:
+                    continue
+                canon = project.canonical(mod, chain) or ""
+                is_get = (canon in ("os.environ.get", "os.getenv") or
+                          chain[-2:] == ("environ", "get") or
+                          (chain[-2:] == ("environ", "setdefault") and
+                           ("os" in chain or "environ" in canon)))
+                is_get_env = chain[-1] == "get_env"
+                if not (is_get or is_get_env):
+                    continue
+                if not node.args:
+                    continue
+                key = project.const_str(mod, None, node.args[0])
+                if key is None and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    key = node.args[0].value
+                if key is None:
+                    # class-const key (scope-less const_str misses those)
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        for (_, cname), v in mod.class_consts.items():
+                            if cname == arg.id and isinstance(v, str):
+                                key = v
+                                break
+                if key is None:
+                    continue
+                dflt, dtyp = _call_default(node, 0)
+                dtype_s = None
+                if is_get_env:
+                    dtype_s = "str"
+                    if dtyp is not None:
+                        dc = _dotted(dtyp)
+                        if dc:
+                            dtype_s = dc[-1]
+                add(key, mod, node.lineno,
+                    _render_default(dflt, mod, project), dtype_s)
+            elif isinstance(node, ast.Subscript):
+                if not isinstance(node.ctx, ast.Load):
+                    continue
+                chain = _dotted(node.value)
+                canon = project.canonical(mod, chain) if chain else None
+                if canon == "os.environ" or \
+                        (chain and chain[-2:] == ("os", "environ")):
+                    key = project.const_str(mod, None, node.slice)
+                    if key is not None:
+                        add(key, mod, node.lineno, "required", None)
+
+    # keys routed through custom keyed accessors (beyond get_env itself)
+    taint = env_taint(project)
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            for er in taint.extra_reads(fn):
+                if er.key is not None and _KNOB_RE.match(er.key) and \
+                        er.key not in knobs:
+                    add(er.key, mod, er.line, "unset", None)
+    project._gl_env_knobs = knobs  # type: ignore[attr-defined]
+    return knobs
+
+
+def knob_rows(project: Project) -> List[Tuple[str, str, str, str]]:
+    """(knob, default, type, modules) rows, sorted by knob name."""
+    rows = []
+    for key, k in sorted(collect_env_knobs(project).items()):
+        default = " / ".join(sorted(k.defaults))
+        dtype = " / ".join(sorted(k.dtypes)) if k.dtypes else "str"
+        mods = ", ".join(sorted({m for _, _, m in k.sites}))
+        rows.append((key, default, dtype, mods))
+    return rows
+
+
+_HEADER = """# Environment knobs
+
+Every ``MXNET_*`` environment variable read anywhere in ``mxnet_tpu/``
+or ``tools/``.  **Generated** — the table between the markers is written
+by ``python -m tools.graftlint --write-knobs`` and verified by lint
+check GL007 (see [lint.md](lint.md)): undocumented reads, ghost rows and
+default drift all fail the lint.  The *description* column is
+hand-written and preserved across regeneration; everything else comes
+from the code.
+
+Defaults are the literal fallbacks at the read sites (`unset` = no
+default / feature off, `required` = the read raises when missing,
+multiple values mean different call sites use different fallbacks).
+
+Subsystem guides: [observability.md](observability.md),
+[serving.md](serving.md), [parallel.md](parallel.md),
+[lint.md](lint.md).
+"""
+
+
+def render_knobs_md(project: Project,
+                    existing_text: Optional[str]) -> str:
+    """Full docs/knobs.md text: regenerate the marked table, preserving
+    any hand-written description cells and all text outside markers."""
+    descriptions: Dict[str, str] = {}
+    before, after = _HEADER + "\n", "\n"
+    if existing_text:
+        for key, desc in _parse_doc_rows(existing_text).items():
+            descriptions[key] = desc[3]
+        if KNOBS_BEGIN in existing_text and KNOBS_END in existing_text:
+            before = existing_text.split(KNOBS_BEGIN)[0]
+            after = existing_text.split(KNOBS_END, 1)[1]
+    lines = [KNOBS_BEGIN,
+             "| knob | default | type | read in | description |",
+             "|---|---|---|---|---|"]
+    for key, default, dtype, mods in knob_rows(project):
+        lines.append("| `%s` | `%s` | %s | %s | %s |"
+                     % (key, default, dtype, mods,
+                        descriptions.get(key, "")))
+    lines.append(KNOBS_END)
+    return before + "\n".join(lines) + after
+
+
+def _parse_doc_rows(text: str) -> Dict[str, Tuple[int, str, str, str]]:
+    """{knob: (line, default, modules, description)} from the marked
+    table."""
+    out: Dict[str, Tuple[int, str, str, str]] = {}
+    inside = False
+    for i, line in enumerate(text.splitlines(), start=1):
+        s = line.strip()
+        if s == KNOBS_BEGIN:
+            inside = True
+            continue
+        if s == KNOBS_END:
+            inside = False
+            continue
+        if not inside or not s.startswith("| `"):
+            continue
+        cells = [c.strip() for c in s.strip("|").split("|")]
+        if len(cells) < 4:
+            continue
+        m = re.match(r"^`([^`]+)`$", cells[0])
+        if not m or not _KNOB_RE.match(m.group(1)):
+            continue
+        default = cells[1].strip("`")
+        mods = cells[3]
+        desc = cells[4] if len(cells) > 4 else ""
+        out.setdefault(m.group(1), (i, default, mods, desc))
+    return out
+
+
+def run(project: Project):
+    docs_path = Path(project.config.get(
+        "knobs_md", project.root / "docs" / "knobs.md"))
+    findings = []
+    rel_docs = docs_path
+    try:
+        rel_docs = docs_path.relative_to(project.root)
+    except ValueError:
+        pass
+    if not docs_path.exists():
+        findings.append(Finding(
+            CODE, str(rel_docs), 1,
+            "knobs doc %s does not exist — generate it with "
+            "python -m tools.graftlint --write-knobs" % rel_docs,
+            "missing-docs"))
+        return findings
+    doc = _parse_doc_rows(docs_path.read_text(encoding="utf-8"))
+    code = {key: (default, mods)
+            for key, default, _, mods in knob_rows(project)}
+
+    for key in sorted(set(code) - set(doc)):
+        knob = collect_env_knobs(project)[key]
+        rel, line, _ = knob.sites[0]
+        findings.append(Finding(
+            CODE, rel, line,
+            "env knob %r is read here but has no row in %s — run "
+            "--write-knobs and describe it" % (key, rel_docs),
+            "undocumented:%s" % key))
+    for key in sorted(set(doc) - set(code)):
+        findings.append(Finding(
+            CODE, str(rel_docs), doc[key][0],
+            "env knob %r is documented but no read of it exists in the "
+            "tree — dead doc row (or a dead knob was deleted; run "
+            "--write-knobs)" % key, "ghost:%s" % key))
+    for key in sorted(set(doc) & set(code)):
+        line, ddefault, dmods, _ = doc[key]
+        cdefault, cmods = code[key]
+        if ddefault != cdefault:
+            findings.append(Finding(
+                CODE, str(rel_docs), line,
+                "env knob %r documents default `%s` but the code's is "
+                "`%s` — run --write-knobs" % (key, ddefault, cdefault),
+                "default-drift:%s" % key))
+        elif dmods != cmods:
+            findings.append(Finding(
+                CODE, str(rel_docs), line,
+                "env knob %r documents read-in modules %r but the code "
+                "reads it from %r — run --write-knobs"
+                % (key, dmods, cmods), "module-drift:%s" % key))
+    return findings

@@ -1,0 +1,241 @@
+"""GL008: every thread is daemon or provably joined.
+
+A non-daemon ``threading.Thread`` that nobody joins keeps the process
+alive after main exits — the classic "probe hangs at shutdown" bug the
+fault-tolerance and serving PRs each dodged by hand.  Two findings:
+
+- **unjoined**: a ``threading.Thread`` construction (including
+  instantiations of project classes that subclass ``Thread``) that is
+  neither daemonized (``daemon=True`` in the constructor, a
+  ``super().__init__(daemon=True)`` in the subclass, or a later
+  ``x.daemon = True`` assignment) nor joined: for a thread bound to
+  ``self.X`` or a local name the check requires a ``X.join(...)`` call
+  somewhere in the same module; for unbound forms (list comprehensions,
+  fire-and-forget chains) any ``.join(`` call in the module counts.
+- **hang**: a non-daemon thread whose target (or subclass ``run``)
+  can reach a timeout-less ``queue.get()`` / ``.join()`` — the shutdown
+  path then has no bounded way to stop it.
+
+Daemon threads are exempt from both (the interpreter kills them), which
+matches the tree's convention: background samplers/exporters are daemon
++ Event-signalled, worker pools are daemon + sentinel-drained.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, _dotted, fn_qual
+from ..dataflow import blocking_kind
+
+CODE = "GL008"
+TITLE = "thread discipline: every thread daemon or provably joined"
+
+
+def _thread_subclasses(project: Project) -> Dict[str, Set[str]]:
+    """{module_name: {class names subclassing threading.Thread}}"""
+    out: Dict[str, Set[str]] = {}
+    for mod in project.modules.values():
+        for cls, bases in mod.class_bases.items():
+            for b in bases:
+                if b == "Thread" or b.endswith(".Thread"):
+                    out.setdefault(mod.name, set()).add(cls)
+    return out
+
+
+def _class_daemonized(project: Project, mod, cls: str) -> bool:
+    """True when the Thread subclass daemonizes itself: daemon=True in a
+    super().__init__ call or a self.daemon = True assignment."""
+    for qual, fn in mod.functions.items():
+        if not qual.startswith(cls + "."):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                # covers plain chains and super().__init__(...) whose
+                # receiver is itself a call
+                if not (isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "__init__"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is True:
+                        return True
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon" and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value is True:
+                        return True
+    return False
+
+
+def _resolve_target_fn(project: Project, mod, scope, call: ast.Call):
+    """The function node a Thread's target= (or args[0] for bare
+    Thread(target)) refers to, if resolvable in-project."""
+    expr = None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            expr = kw.value
+    if expr is None:
+        return None
+    chain = _dotted(expr)
+    if not chain:
+        return None
+    got = project.resolve_chain(mod, scope, chain)
+    return got[0] if got else None
+
+
+def _join_targets(mod) -> Tuple[Set[str], bool]:
+    """(names X with a X.join(...) call in the module, any-join-at-all)"""
+    names: Set[str] = set()
+    any_join = False
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            base = node.func.value
+            if isinstance(base, ast.Constant):
+                continue    # ", ".join(...) string joins
+            chain = _dotted(base)
+            if chain and (chain[0] in ("os", "posixpath", "ntpath") or
+                          chain[-1] in ("path", "sep")):
+                continue    # os.path.join and friends
+            if chain:
+                names.add(chain[-1])    # t.join() -> "t", self._t -> "_t"
+            any_join = True             # threads[i].join() etc.
+    return names, any_join
+
+
+def _daemonized_later(mod, bound: Optional[str]) -> bool:
+    """X.daemon = True somewhere in the module for the bound name."""
+    if bound is None:
+        return False
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                node.value.value is True:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "daemon":
+                    chain = _dotted(tgt.value)
+                    if chain and chain[-1] == bound:
+                        return True
+    return False
+
+
+def _hang_sites(project: Project, root) -> List[Tuple[str, int, str]]:
+    out = []
+    for g in project.reachable([root]):
+        scope = getattr(g, "_gl", None)
+        if scope is None:
+            continue
+        for site in project.facts(g).calls:
+            if site.is_ref:
+                continue
+            kind = blocking_kind(site)
+            if kind in ("queue.get() without timeout",
+                        "join() without timeout"):
+                out.append((scope.mod.rel, site.line, kind))
+    return out
+
+
+def run(project: Project):
+    findings = []
+    subclasses = _thread_subclasses(project)
+    daemon_classes: Set[Tuple[str, str]] = set()
+    for mname, classes in subclasses.items():
+        mod = project.modules[mname]
+        for cls in classes:
+            if _class_daemonized(project, mod, cls):
+                daemon_classes.add((mname, cls))
+
+    for mod in project.modules.values():
+        join_names, any_join = _join_targets(mod)
+        # map ctor call -> the name it is bound to (t = Thread(...) /
+        # self._t = Thread(...)); unbound ctors keep None
+        bound: Dict[int, Optional[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for tgt in node.targets:
+                    name = None
+                    if isinstance(tgt, ast.Name):
+                        name = tgt.id
+                    elif isinstance(tgt, ast.Attribute):
+                        name = tgt.attr
+                    if name:
+                        bound[id(node.value)] = name
+                        break
+
+        for fn in mod.functions.values():
+            scope = fn._gl
+            for site in project.facts(fn).calls:
+                call = site.node
+                if site.is_ref or not site.chain or \
+                        not isinstance(call, ast.Call):
+                    continue
+                last = site.chain[-1]
+                sub_cls = None
+                run_fn = None
+                if last == "Thread":
+                    canon = site.canon or ""
+                    if not ("threading" in canon or
+                            site.chain[0] in ("threading", "_threading")):
+                        continue
+                elif (mod.name, last) in daemon_classes:
+                    continue    # self-daemonizing subclass: always fine
+                elif last in subclasses.get(mod.name, ()):
+                    sub_cls = last
+                    run_fn = mod.functions.get(last + ".run")
+                else:
+                    # imported project Thread subclass
+                    src = mod.from_imports.get(last)
+                    if src and src[0] in subclasses and \
+                            src[1] in subclasses[src[0]]:
+                        if (src[0], src[1]) in daemon_classes:
+                            continue
+                        sub_cls = src[1]
+                        smod = project.modules[src[0]]
+                        run_fn = smod.functions.get(src[1] + ".run")
+                    else:
+                        continue
+
+                daemon = None
+                for kw in call.keywords:
+                    if kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                name = bound.get(id(call))
+                if daemon is None and _daemonized_later(mod, name):
+                    daemon = True
+                qual = fn_qual(fn)
+                what = sub_cls or "threading.Thread"
+                if not daemon:
+                    joined = (name in join_names) if name else any_join
+                    if not joined:
+                        findings.append(Finding(
+                            CODE, mod.rel, call.lineno,
+                            "%s constructed in %s is neither daemon=True "
+                            "nor joined anywhere in this module — it will "
+                            "outlive shutdown" % (what, qual),
+                            "unjoined:%s:%s" % (qual, what)))
+                    root = run_fn or _resolve_target_fn(
+                        project, mod, scope, call)
+                    if root is not None:
+                        for rel, line, kind in _hang_sites(project, root):
+                            findings.append(Finding(
+                                CODE, rel, line,
+                                "non-daemon thread (%s, started in %s) can "
+                                "block forever on %s — shutdown has no "
+                                "bounded way to stop it"
+                                % (what, qual, kind),
+                                "hang:%s:%s" % (qual, kind.split()[0])))
+    # dedup (same ctor reached from several facts paths)
+    uniq = {}
+    for f in findings:
+        uniq.setdefault(f.fingerprint, f)
+    return list(uniq.values())

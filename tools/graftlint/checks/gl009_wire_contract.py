@@ -1,0 +1,329 @@
+"""GL009: the KVStore wire contract matches end to end.
+
+The kvstore client and server share a frame format but not a schema
+file: the client sends ``self._rpc("<cmd>", ...)`` literals, the server
+dispatches on ``cmd == "<cmd>"`` literals; the client builds context
+dicts (``{"r": ..., "st": ...}``), the server validates them against
+``frozenset`` key tables; both sides hold a copy of the replay-guarded
+op set (``_SEQ_OPS`` / ``_MUTATING``).  Each pair is a drift hazard: a
+renamed cmd becomes an "unknown command" reject at runtime, a context
+field added on one side becomes a loud frame error on every RPC.  This
+check statically extracts both halves and diffs them:
+
+- **cmd-unhandled** / **cmd-dead**: client cmd with no server
+  comparison, server comparison no client ever sends;
+- **ctx-drift**: context dict keys built by the client (incl. the
+  tracing module's ``flow_out`` payload) vs the server's ``*_KEYS``
+  validation table for the same wrapper key;
+- **pack-parse-drift**: wrapper keys written by ``_pack_payload`` vs
+  the allowed-key set in ``_parse_payload``;
+- **incomplete-validation**: a ``_check_*`` context validator that
+  rejects unknown keys but never checks ``set(ctx) != *_KEYS`` — it
+  silently accepts frames with *missing* fields;
+- **seq-ops-drift**: client ``_SEQ_OPS`` vs server ``_MUTATING``.
+
+Extraction is purely literal — dynamically computed cmds or key sets are
+invisible here, which is fine: the wire code is deliberately literal so
+the contract stays greppable.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, _dotted
+
+CODE = "GL009"
+TITLE = "kvstore wire contract: client and server halves match"
+
+
+def _find_module(project: Project, suffix: str):
+    for mod in project.modules.values():
+        if mod.name == suffix or mod.name.endswith("." + suffix):
+            return mod
+    return None
+
+
+def _literal_strs(node) -> Optional[List[str]]:
+    """The string elements of frozenset((...)) / set / tuple / list
+    literals, or None when any element is non-literal."""
+    if isinstance(node, ast.Call):
+        chain = _dotted(node.func)
+        if chain and chain[-1] in ("frozenset", "set", "tuple") \
+                and len(node.args) == 1:
+            return _literal_strs(node.args[0])
+        return None
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _named_set(mod, name: str) -> Optional[Tuple[FrozenSet[str], int]]:
+    """Module- or class-level ``NAME = frozenset((...))`` assignment."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            tname = tgt.id if isinstance(tgt, ast.Name) else None
+            if tname != name:
+                continue
+            vals = _literal_strs(node.value)
+            if vals is not None:
+                return frozenset(vals), node.lineno
+    return None
+
+
+def _client_cmds(mod) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if chain and chain[-1] == "_rpc" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                out.setdefault(node.args[0].value, node.lineno)
+    return out
+
+
+def _server_cmds(mod) -> Dict[str, int]:
+    """Literal comparisons against a name ``cmd``: both ``cmd == "x"``
+    and ``cmd in ("x", "y")`` forms."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not (isinstance(node.left, ast.Name) and
+                node.left.id == "cmd"):
+            continue
+        comp = node.comparators[0]
+        if isinstance(node.ops[0], ast.Eq) and \
+                isinstance(comp, ast.Constant) and \
+                isinstance(comp.value, str):
+            out.setdefault(comp.value, node.lineno)
+        elif isinstance(node.ops[0], ast.In):
+            for v in _literal_strs(comp) or ():
+                out.setdefault(v, node.lineno)
+    return out
+
+
+def _pack_mapping(server) -> Tuple[Dict[str, str], Set[str], int]:
+    """From ``_pack_payload``: ({wrapper key: param name}, all wrapper
+    keys written incl. the message key, def line)."""
+    mapping: Dict[str, str] = {}
+    keys: Set[str] = set()
+    line = 1
+    fn = server.functions.get("_pack_payload")
+    if fn is None:
+        return mapping, keys, line
+    line = fn.lineno
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.slice, ast.Constant) and \
+                        isinstance(tgt.slice.value, str):
+                    key = tgt.slice.value
+                    keys.add(key)
+                    src = node.value
+                    if isinstance(src, ast.Call) and src.args:
+                        src = src.args[0]
+                    chain = _dotted(src)
+                    if chain:
+                        mapping[key] = chain[-1]
+    return mapping, keys, line
+
+
+def _parse_allowed(server) -> Optional[Tuple[FrozenSet[str], int]]:
+    """The allowed-wrapper-keys literal inside ``_parse_payload``
+    (``set(hdr) - {"m", "tc", ...}``)."""
+    fn = server.functions.get("_parse_payload")
+    if fn is None:
+        return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Set):
+            vals = _literal_strs(node)
+            if vals and "m" in vals:
+                return frozenset(vals), node.lineno
+    return None
+
+
+def _validators(server) -> Dict[str, Tuple[str, ast.AST]]:
+    """{wrapper key: (validator fn name, fn node)} from the
+    ``x = _check_y(hdr["k"])`` dispatch in ``_parse_payload``."""
+    out: Dict[str, Tuple[str, ast.AST]] = {}
+    fn = server.functions.get("_parse_payload")
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if not chain or not chain[-1].startswith("_check_"):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Subscript) and \
+                        isinstance(arg.slice, ast.Constant) and \
+                        isinstance(arg.slice.value, str):
+                    vfn = server.functions.get(chain[-1])
+                    if vfn is not None:
+                        out[arg.slice.value] = (chain[-1], vfn)
+    return out
+
+
+def _validator_keys(server, vfn) -> Optional[Tuple[str, FrozenSet[str]]]:
+    """The ``*_KEYS`` table a validator checks against: (name, keys)."""
+    for node in ast.walk(vfn):
+        if isinstance(node, ast.Name) and node.id.endswith("_KEYS"):
+            got = _named_set(server, node.id)
+            if got is not None:
+                return node.id, got[0]
+    return None
+
+
+def _has_completeness_check(vfn, keys_name: str) -> bool:
+    """``set(x) != KEYS`` anywhere in the validator body."""
+    for node in ast.walk(vfn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], ast.NotEq):
+            sides = (node.left, node.comparators[0])
+            has_set = any(isinstance(s, ast.Call) and
+                          _dotted(s.func) == ("set",) for s in sides)
+            has_keys = any(isinstance(s, ast.Name) and s.id == keys_name
+                           for s in sides)
+            if has_set and has_keys:
+                return True
+    return False
+
+
+def _client_ctx_keys(project: Project, client,
+                     param: str) -> Optional[FrozenSet[str]]:
+    """Keys of the dict literal the client binds to ``param`` (e.g.
+    ``health_ctx = {"r": ..., "st": ...}``); for a param with no local
+    dict (the trace context rides in from tracing), the union of dict
+    keys returned by any in-project ``flow_out``."""
+    keys: Set[str] = set()
+    found = False
+    for node in ast.walk(client.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == param:
+                    found = True
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            keys.add(k.value)
+    if found:
+        return frozenset(keys)
+    for mod in project.modules.values():
+        for qual, fn in mod.functions.items():
+            if qual.split(".")[-1] != "flow_out":
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and \
+                        isinstance(node.value, ast.Dict):
+                    found = True
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            keys.add(k.value)
+    return frozenset(keys) if found else None
+
+
+def run(project: Project):
+    client = _find_module(project, "kvstore")
+    server = _find_module(project, "kvstore_server")
+    if client is None or server is None:
+        return []
+    findings = []
+
+    # -- command sets ------------------------------------------------------
+    sent = _client_cmds(client)
+    handled = _server_cmds(server)
+    for cmd in sorted(set(sent) - set(handled)):
+        findings.append(Finding(
+            CODE, client.rel, sent[cmd],
+            "client sends cmd %r but the server never compares against it "
+            "— every such RPC fails with unknown-command" % cmd,
+            "cmd-unhandled:%s" % cmd))
+    for cmd in sorted(set(handled) - set(sent)):
+        findings.append(Finding(
+            CODE, server.rel, handled[cmd],
+            "server handles cmd %r but no client call site sends it — "
+            "dead wire surface (or the sender was renamed)" % cmd,
+            "cmd-dead:%s" % cmd))
+
+    # -- wrapper keys: pack vs parse --------------------------------------
+    mapping, pack_keys, pack_line = _pack_mapping(server)
+    allowed = _parse_allowed(server)
+    if pack_keys and allowed is not None:
+        allowed_keys, allowed_line = allowed
+        for key in sorted(pack_keys - allowed_keys):
+            findings.append(Finding(
+                CODE, server.rel, pack_line,
+                "_pack_payload writes wrapper key %r that _parse_payload "
+                "rejects as unknown — every frame carrying it is dropped"
+                % key, "pack-parse-drift:%s" % key))
+        for key in sorted(allowed_keys - pack_keys):
+            findings.append(Finding(
+                CODE, server.rel, allowed_line,
+                "_parse_payload allows wrapper key %r that _pack_payload "
+                "never writes — dead allowance widens the wire surface"
+                % key, "pack-parse-drift:%s" % key))
+
+    # -- context key sets + validator completeness ------------------------
+    for wkey, (vname, vfn) in sorted(_validators(server).items()):
+        table = _validator_keys(server, vfn)
+        if table is None:
+            continue
+        keys_name, server_keys = table
+        if not _has_completeness_check(vfn, keys_name):
+            findings.append(Finding(
+                CODE, server.rel, vfn.lineno,
+                "%s rejects unknown keys but never checks set(ctx) != %s "
+                "— frames with MISSING %r fields pass validation silently"
+                % (vname, keys_name, wkey),
+                "incomplete-validation:%s" % vname))
+        param = mapping.get(wkey)
+        if param is None:
+            continue
+        client_keys = _client_ctx_keys(project, client, param)
+        if client_keys is None:
+            continue
+        for key in sorted(client_keys - server_keys):
+            findings.append(Finding(
+                CODE, server.rel, vfn.lineno,
+                "client %s carries key %r that %s rejects as unknown — "
+                "every RPC with that context is a frame error"
+                % (param, key, vname),
+                "ctx-drift:%s:%s" % (wkey, key)))
+        for key in sorted(server_keys - client_keys):
+            findings.append(Finding(
+                CODE, server.rel, vfn.lineno,
+                "%s requires key %r that client %s never sends — "
+                "completeness validation rejects every such frame"
+                % (vname, key, param),
+                "ctx-drift:%s:%s" % (wkey, key)))
+
+    # -- replay-guarded op sets -------------------------------------------
+    seq_ops = _named_set(client, "_SEQ_OPS")
+    mutating = _named_set(server, "_MUTATING")
+    if seq_ops is not None and mutating is not None and \
+            seq_ops[0] != mutating[0]:
+        only_c = sorted(seq_ops[0] - mutating[0])
+        only_s = sorted(mutating[0] - seq_ops[0])
+        findings.append(Finding(
+            CODE, client.rel, seq_ops[1],
+            "client _SEQ_OPS and server _MUTATING disagree "
+            "(client-only: %s, server-only: %s) — replayed frames are "
+            "either re-applied or never acked" % (only_c, only_s),
+            "seq-ops-drift"))
+    return findings

@@ -1,0 +1,131 @@
+"""GL010: every runlog event name is documented, and vice versa.
+
+The run ledger (``mxnet_tpu/runlog.py``) is an append-only JSONL stream
+consumed by offline tooling — the sentinel, the atlas, post-mortem
+scripts.  Its schema is the set of literal event names the tree emits;
+an undocumented event is invisible to ledger consumers, a documented
+event nobody emits is a query that silently matches nothing.  Mirrors
+GL005 (metrics registry): code side is every ``*runlog*.event("name",
+...)`` call with a literal first argument, doc side is the *Runlog
+events* table in ``docs/observability.md``.  Diffed both directions.
+
+Dynamic event names (non-literal first arg) are flagged too: the ledger
+contract is only checkable when names are literals, and every current
+emitter keeps them literal on purpose.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Tuple
+
+from ..core import Finding, Project, _dotted
+
+CODE = "GL010"
+TITLE = "runlog events: emitted names match the documented table"
+
+_EVENT_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SECTION_RE = re.compile(r"^#+\s+.*runlog events", re.IGNORECASE)
+
+
+def emitted_events(project: Project) -> Tuple[Dict[str, Tuple[str, int]],
+                                              list]:
+    """({event name: (rel, line)} of literal emits, [(rel, line, reason)]
+    dynamic emits)."""
+    events: Dict[str, Tuple[str, int]] = {}
+    dynamic = []
+    for mod in project.modules.values():
+        in_runlog = mod.name == "runlog" or mod.name.endswith(".runlog")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain or chain[-1] != "event":
+                continue
+            recv = chain[-2] if len(chain) >= 2 else None
+            if not (recv in ("_runlog", "runlog") or
+                    (in_runlog and recv in ("log", "self", None))):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if _EVENT_RE.match(arg.value):
+                    events.setdefault(arg.value, (mod.rel, node.lineno))
+                else:
+                    dynamic.append((mod.rel, node.lineno,
+                                    "malformed literal %r" % arg.value))
+            elif not in_runlog:
+                # runlog.py's own forwarding shims are parameterized by
+                # design; everywhere else the name must be a literal
+                dynamic.append((mod.rel, node.lineno, "non-literal name"))
+    return events, dynamic
+
+
+def documented_events(text: str) -> Dict[str, int]:
+    """{event name: doc line} from the table under the *Runlog events*
+    heading (rows until the next heading)."""
+    out: Dict[str, int] = {}
+    inside = False
+    for i, line in enumerate(text.splitlines(), start=1):
+        s = line.strip()
+        if _SECTION_RE.match(s):
+            inside = True
+            continue
+        if inside and s.startswith("#"):
+            break
+        if not inside or not s.startswith("| `"):
+            continue
+        m = re.match(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|", s)
+        if m:
+            out.setdefault(m.group(1), i)
+    return out
+
+
+def run(project: Project):
+    docs_path = Path(project.config.get(
+        "observability_md", project.root / "docs" / "observability.md"))
+    findings = []
+    rel_docs = docs_path
+    try:
+        rel_docs = docs_path.relative_to(project.root)
+    except ValueError:
+        pass
+
+    events, dynamic = emitted_events(project)
+    for rel, line, reason in dynamic:
+        findings.append(Finding(
+            CODE, rel, line,
+            "runlog event with %s — ledger consumers cannot be checked "
+            "against dynamic event names; use a literal" % reason,
+            "dynamic-event:%s:%d" % (rel, line)))
+    if not events:
+        return findings
+
+    doc_text = docs_path.read_text(encoding="utf-8") \
+        if docs_path.exists() else ""
+    doc = documented_events(doc_text)
+    if not doc:
+        findings.append(Finding(
+            CODE, str(rel_docs), 1,
+            "no 'Runlog events' table found in %s but the tree emits %d "
+            "runlog events — add the section (rows: | `name` | emitted "
+            "by | meaning |)" % (rel_docs, len(events)),
+            "missing-events-table"))
+        return findings
+
+    for name in sorted(set(events) - set(doc)):
+        rel, line = events[name]
+        findings.append(Finding(
+            CODE, rel, line,
+            "runlog event %r is emitted here but has no row in the "
+            "Runlog events table in %s" % (name, rel_docs),
+            "undocumented-event:%s" % name))
+    for name in sorted(set(doc) - set(events)):
+        findings.append(Finding(
+            CODE, str(rel_docs), doc[name],
+            "runlog event %r is documented but nothing in the tree emits "
+            "it — dead doc row" % name,
+            "ghost-event:%s" % name))
+    return findings

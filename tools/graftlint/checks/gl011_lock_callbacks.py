@@ -1,0 +1,46 @@
+"""GL011: never invoke user callbacks while holding a lock.
+
+A callback invoked under a lock inherits that lock's critical section:
+if the callback (user code, by definition unknowable) blocks, every
+other thread contending the lock stalls; if it re-enters the owning
+object, a non-reentrant lock deadlocks on the spot.  The tree's own
+convention is snapshot-then-fire — collect the callback list and any
+payload under the lock, release, then invoke (see
+``SloScheduler._fire_level_change``).  This check walks the shared lock
+model and flags calls made with a non-empty held-lock set whose callee
+is callback-shaped: a name matching ``*_callback`` / ``*_hook`` /
+``on_*`` / ``*cb`` etc., or a bare name bound by iterating a
+callback/hook/listener container — and that does NOT resolve to an
+in-project function (resolvable callees are already walked
+transitively, so their lock behaviour is analysed for real rather than
+assumed hostile).
+"""
+from __future__ import annotations
+
+from ..core import Finding, Project
+from ..dataflow import lock_analysis
+
+CODE = "GL011"
+TITLE = "lock-callback discipline: no callbacks invoked under a lock"
+
+
+def run(project: Project):
+    findings = []
+    seen = set()
+    for rel, line, qual, chain_str, held in \
+            lock_analysis(project).callback_calls:
+        lid = held[-1]
+        fp = "callback:%s:%s:%s" % (qual, chain_str, lid)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        findings.append(Finding(
+            CODE, rel, line,
+            "callback %s() invoked in %s while holding %s — snapshot the "
+            "callback list under the lock, release, then fire (the "
+            "callback can block or re-enter and take the critical "
+            "section hostage)"
+            % (chain_str, qual,
+               " -> ".join(held) if len(held) > 1 else lid),
+            fp))
+    return findings

@@ -181,7 +181,8 @@ def _literal_strings(node) -> Optional[Tuple[str, ...]]:
 class Project:
     """Parsed view of one or more packages under a root directory."""
 
-    def __init__(self, root, packages: Sequence[str] = ("mxnet_tpu",),
+    def __init__(self, root,
+                 packages: Sequence[str] = ("mxnet_tpu", "tools", "bench"),
                  config: Optional[Dict[str, Any]] = None):
         self.root = Path(root)
         self.packages = tuple(packages)
@@ -195,7 +196,15 @@ class Project:
     def _load(self) -> None:
         for pkg in self.packages:
             base = self.root / pkg.replace(".", "/")
-            for path in sorted(base.rglob("*.py")):
+            if base.is_dir():
+                paths = sorted(base.rglob("*.py"))
+            elif base.with_suffix(".py").is_file():
+                # a package entry may be a single top-level module
+                # (bench.py lives at the repo root, not in a package)
+                paths = [base.with_suffix(".py")]
+            else:
+                paths = []
+            for path in paths:
                 rel = path.relative_to(self.root).as_posix()
                 stem = rel[:-3].replace("/", ".")
                 name = stem[:-len(".__init__")] \

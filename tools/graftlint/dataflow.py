@@ -1,0 +1,660 @@
+"""Interprocedural dataflow core shared by the graftlint checks.
+
+Two analyses live here, both computed once per :class:`Project` and cached
+on it, so every check that needs cross-function facts shares the work:
+
+**Env-key taint** (:func:`env_taint`).  The per-function fact extractor
+deliberately skips environment reads whose key is a *parameter* — the
+``get_env(name)`` accessor pattern — because the read belongs to the
+caller that supplied the literal.  This pass closes that gap
+interprocedurally: a fixpoint marks every parameter that flows into an
+env-read key (directly, or through any chain of resolvable calls), then
+:func:`function_env_reads` materializes a read *at each call site* that
+passes a literal key to such a parameter.  Helpers-behind-helpers —
+``op() -> _flag() -> _env() -> os.environ.get(name)`` — therefore no
+longer hide reads from GL001/GL002's reachability walks.
+
+**Lock model** (:func:`lock_analysis`).  The GL003 analysis, upgraded:
+
+* a static lock table with **constructor sites** — every
+  ``threading.Lock/RLock/Condition()`` call in the tree maps to a stable
+  lock id (``module.Class.attr`` / ``module.name`` for the assignment
+  forms, an anonymous *family* id for dict-of-locks and other dynamic
+  forms), which is what lets the runtime sanitizer
+  (:mod:`mxnet_tpu.locksmith`) translate live lock objects back into the
+  static graph;
+* **local aliasing**: ``lk = self._lock`` followed by ``with lk:`` is
+  tracked as an acquisition of ``self._lock``;
+* held-set propagation through resolvable callees (bounded depth), ABBA
+  edge collection, blocking-under-hot-lock findings; and
+* **callback capture** for GL011: any call made while holding a lock
+  whose name is callback-shaped (``on_*``, ``*_cb``, ``*callback*``,
+  ``*hook*``, …) and does not resolve to a function in the tree is
+  recorded with the held set.
+
+Soundness limits (see docs/lint.md): calls that cannot be resolved
+statically are skipped, never guessed; taint flows only through
+positional/keyword arguments that are plain names or literals; the lock
+walk models ``with`` acquisition only (the tree has no bare
+``.acquire()`` discipline) and bounds callee depth at ``_MAX_DEPTH``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (EnvRead, Finding, ModuleInfo, Project, _dotted, fn_name,
+                   fn_qual)
+
+__all__ = [
+    "EnvTaint", "LockAnalysis", "LockDef", "env_taint", "lock_analysis",
+    "function_env_reads", "reachable_env_reads", "lock_graph",
+]
+
+# ---------------------------------------------------------------------------
+# env-key taint
+# ---------------------------------------------------------------------------
+
+_ENV_GET_CANON = ("os.environ.get", "os.getenv")
+
+
+def _param_info(fn) -> Tuple[List[str], Set[str]]:
+    """(positional names in order, all bindable names) of a function."""
+    a = getattr(fn, "args", None)
+    if a is None:
+        return [], set()
+    pos = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    allnames = set(pos) | {p.arg for p in a.kwonlyargs}
+    return pos, allnames
+
+
+def _own_nodes(fn):
+    """All AST nodes of ``fn`` excluding nested function bodies."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from rec(child)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield stmt
+        yield from rec(stmt)
+
+
+def _is_env_read_call(site) -> bool:
+    """True when the call site is itself one of the env-read forms the
+    per-function fact extractor already handles (so taint must not
+    double-count it)."""
+    canon = site.canon or ""
+    chain = site.chain or ()
+    if canon in _ENV_GET_CANON:
+        return True
+    if chain and chain[-1] == "get_env":
+        return True
+    if len(chain) >= 2 and chain[-2:] == ("environ", "get"):
+        return True
+    return False
+
+
+class EnvTaint:
+    """Fixpoint over 'this parameter is used as an env-read key'."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        #: id(fn) -> set of tainted parameter names
+        self.key_params: Dict[int, Set[str]] = {}
+        self._extra: Dict[int, List[EnvRead]] = {}
+        self._all_fns: List[ast.AST] = [
+            fn for mod in project.modules.values()
+            for fn in mod.functions.values()]
+        self._build()
+
+    # -- construction -----------------------------------------------------
+    def _direct_key_params(self, fn) -> Set[str]:
+        scope = fn._gl
+        mod = scope.mod
+        _, params = _param_info(fn)
+        if not params:
+            return set()
+        out: Set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                canon = self.project.canonical(mod, chain) if chain else None
+                is_env = (canon in _ENV_GET_CANON or
+                          (chain and len(chain) >= 2 and
+                           chain[-2:] == ("environ", "get")) or
+                          (chain and chain[-1] == "get_env" and
+                           fn_name(fn) != "get_env"))
+                # os.environ.get(name) inside get_env itself
+                if chain and chain[-1] == "get_env" and \
+                        fn_name(fn) == "get_env":
+                    is_env = False
+                if is_env and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in params:
+                    out.add(node.args[0].id)
+            elif isinstance(node, ast.Subscript):
+                chain = _dotted(node.value)
+                canon = self.project.canonical(mod, chain) if chain else None
+                if (canon == "os.environ" or
+                        (chain and chain[-2:] == ("os", "environ"))) and \
+                        isinstance(node.slice, ast.Name) and \
+                        node.slice.id in params:
+                    out.add(node.slice.id)
+        return out
+
+    def _arg_bindings(self, caller, site):
+        """Yield (arg_expr, callee, callee_param_name) for a resolved call
+        site (positional + keyword args mapped onto the callee
+        signature)."""
+        call = site.node
+        if not isinstance(call, ast.Call):
+            return
+        for g in site.targets:
+            pos, allnames = _param_info(g)
+            offset = 0
+            gscope = getattr(g, "_gl", None)
+            if gscope is not None and gscope.cls is not None and pos and \
+                    pos[0] in ("self", "cls") and site.chain and \
+                    len(site.chain) > 1:
+                offset = 1
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                j = i + offset
+                if j < len(pos):
+                    yield arg, g, pos[j]
+            for kw in call.keywords:
+                if kw.arg is not None and kw.arg in allnames:
+                    yield kw.value, g, kw.arg
+
+    def _build(self):
+        project = self.project
+        for fn in self._all_fns:
+            self.key_params[id(fn)] = self._direct_key_params(fn)
+        # fixpoint: caller param passed into a tainted callee param
+        changed = True
+        iters = 0
+        while changed and iters < 20:
+            changed = False
+            iters += 1
+            for fn in self._all_fns:
+                _, params = _param_info(fn)
+                if not params:
+                    continue
+                mine = self.key_params[id(fn)]
+                for site in project.facts(fn).calls:
+                    if site.is_ref or not site.targets:
+                        continue
+                    if _is_env_read_call(site):
+                        continue
+                    for arg, g, gparam in self._arg_bindings(fn, site):
+                        if not (isinstance(arg, ast.Name) and
+                                arg.id in params):
+                            continue
+                        if gparam in self.key_params.get(id(g), ()) and \
+                                arg.id not in mine:
+                            mine.add(arg.id)
+                            changed = True
+
+    # -- queries ----------------------------------------------------------
+    def extra_reads(self, fn) -> List[EnvRead]:
+        """Env reads materialized at ``fn``'s call sites: literal (or
+        module-constant) keys passed to tainted parameters of callees.
+        Non-literal keys that are not parameters of ``fn`` become dynamic
+        reads.  Call sites the base fact extractor already records
+        (``get_env`` / ``os.environ.get``) are skipped."""
+        cached = self._extra.get(id(fn))
+        if cached is not None:
+            return cached
+        scope = getattr(fn, "_gl", None)
+        out: List[EnvRead] = []
+        if scope is None:
+            self._extra[id(fn)] = out
+            return out
+        mod = scope.mod
+        _, params = _param_info(fn)
+        for site in self.project.facts(fn).calls:
+            if site.is_ref or not site.targets:
+                continue
+            if _is_env_read_call(site):
+                continue
+            for arg, g, gparam in self._arg_bindings(fn, site):
+                if gparam not in self.key_params.get(id(g), ()):
+                    continue
+                key = self.project.const_str(mod, scope, arg)
+                if key is not None:
+                    out.append(EnvRead(key, site.line))
+                elif isinstance(arg, ast.Name) and arg.id in params:
+                    continue    # materializes in our callers instead
+                else:
+                    out.append(EnvRead(None, site.line))
+        self._extra[id(fn)] = out
+        return out
+
+
+def env_taint(project: Project) -> EnvTaint:
+    cached = getattr(project, "_gl_env_taint", None)
+    if cached is None:
+        cached = EnvTaint(project)
+        project._gl_env_taint = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def function_env_reads(project: Project, fn) -> List[EnvRead]:
+    """Direct facts plus taint-materialized reads for one function."""
+    return list(project.facts(fn).env_reads) + \
+        env_taint(project).extra_reads(fn)
+
+
+def reachable_env_reads(project: Project, root):
+    """{key: (rel, line)} + [(rel, line, qual)] dynamic reads reachable
+    from ``root`` through resolvable calls, env-key taint included."""
+    reads: Dict[str, Tuple[str, int]] = {}
+    dynamic: List[Tuple[str, int, str]] = []
+    dyn_seen: Set[Tuple[str, int]] = set()
+    for g in project.reachable([root]):
+        scope = getattr(g, "_gl", None)
+        if scope is None:
+            continue
+        for er in function_env_reads(project, g):
+            if er.key is None:
+                spot = (scope.mod.rel, er.line)
+                if spot not in dyn_seen:
+                    dyn_seen.add(spot)
+                    dynamic.append((scope.mod.rel, er.line, fn_qual(g)))
+            else:
+                reads.setdefault(er.key, (scope.mod.rel, er.line))
+    return reads, dynamic
+
+
+# ---------------------------------------------------------------------------
+# lock model
+# ---------------------------------------------------------------------------
+
+_BLOCKING_ATTRS = {
+    "asnumpy": ".asnumpy() host sync",
+    "block_until_ready": "block_until_ready device sync",
+    "wait_to_read": "wait_to_read device sync",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "recvfrom": "socket recv",
+    "recv_msg": "socket recv",
+    "recv_msg_full": "socket recv",
+    "accept": "socket accept",
+}
+
+# default: modules whose locks guard hot paths; overridable for fixtures
+_DEFAULT_SCOPE = ("telemetry", "engine", "serving", "health")
+
+_MAX_DEPTH = 8
+
+# callback-shaped call names: user/registry-supplied code the module does
+# not own.  Only calls that do NOT resolve to a function in the tree are
+# flagged — a project-owned method named on_epoch_end is ordinary code.
+_CB_CALL_RE = re.compile(
+    r"(?:^|_)(?:callback|hook|listener|observer|subscriber|cb)$"
+    r"|^on_[a-z0-9_]+$")
+#: containers whose iteration yields callbacks: ``for cb in self._hooks:``
+_CB_CONTAINER_RE = re.compile(
+    r"(?:^|_)(?:callbacks?|hooks?|listeners?|observers?|subscribers?)$")
+
+
+def blocking_kind(site) -> Optional[str]:
+    chain, canon, call = site.chain, site.canon or "", site.node
+    if not chain:
+        return None
+    last = chain[-1]
+    if last in _BLOCKING_ATTRS:
+        return _BLOCKING_ATTRS[last]
+    if canon == "time.sleep":
+        return "time.sleep"
+    if last == "get" and len(chain) > 1 and not call.args and \
+            not any(kw.arg in ("timeout", "block") for kw in call.keywords):
+        return "queue.get() without timeout"
+    if last == "join" and len(chain) > 1 and not call.args and \
+            not call.keywords:
+        return "join() without timeout"
+    return None
+
+
+@dataclass(frozen=True)
+class LockDef:
+    kind: str       # Lock / RLock / Condition
+    rel: str        # repo-relative path of the constructor site
+    line: int       # constructor line
+    family: bool = False   # dynamically-created (dict-of-locks etc.)
+
+
+class _Summary:
+    __slots__ = ("acquires", "blocking")
+
+    def __init__(self):
+        self.acquires: Set[str] = set()
+        # (kind, rel, line, qual) of blocking sites in fn + callees
+        self.blocking: List[Tuple[str, str, int, str]] = []
+
+
+class _FakeSite:
+    __slots__ = ("node", "chain", "canon")
+
+    def __init__(self, node, chain, canon):
+        self.node = node
+        self.chain = chain
+        self.canon = canon
+
+
+class LockAnalysis:
+    """Whole-tree lock table + acquisition graph + blocking/callback
+    findings.  Build with :func:`lock_analysis` (cached per project)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks: Dict[str, LockDef] = {}       # lock id -> definition
+        self.cond_alias: Dict[str, str] = {}      # condition id -> lock id
+        #: (rel, ctor line) -> lock id, for EVERY ctor site in the tree
+        self.sites: Dict[Tuple[str, int], str] = {}
+        self.summaries: Dict[int, _Summary] = {}
+        self.in_progress: Set[int] = set()
+        # (a, b) -> (rel, line, qual) first site acquiring b while holding a
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.blocking_findings: List[Finding] = []
+        #: (rel, line, qual, call chain string, held lock ids)
+        self.callback_calls: List[
+            Tuple[str, int, str, str, Tuple[str, ...]]] = []
+        self.scope = tuple(project.config.get(
+            "lock_scope_modules", _DEFAULT_SCOPE))
+        self._summarized = False
+
+    # -- lock definition table -------------------------------------------
+    def collect_locks(self):
+        pending_conds = []
+        for mod in self.project.modules.values():
+            # module-level globals
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    kind = self._ctor_kind(mod, node.value)
+                    if not kind:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            lid = "%s.%s" % (mod.name, tgt.id)
+                            self._add(lid, kind, mod, node.value,
+                                      pending_conds)
+                            break
+            # self.X = threading.Lock() inside methods
+            for fn in mod.functions.values():
+                scope = fn._gl
+                if scope.cls is None:
+                    continue
+                for node in _own_nodes(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    kind = self._ctor_kind(mod, node.value)
+                    if not kind:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            lid = "%s.%s.%s" % (mod.name, scope.cls,
+                                                tgt.attr)
+                            self._add(lid, kind, mod, node.value,
+                                      pending_conds)
+                            break
+        # resolve Condition(self.X) aliases now the lock table is complete
+        for lid, mod, call in pending_conds:
+            kind_rel_line = ("Condition", mod.rel, call.lineno)
+            if call.args:
+                arg = call.args[0]
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "self":
+                    owner = lid.rsplit(".", 1)[0]
+                    target = "%s.%s" % (owner, arg.attr)
+                    if target in self.locks:
+                        self.cond_alias[lid] = target
+                        self.sites.setdefault(
+                            (mod.rel, call.lineno), target)
+                        continue
+            self.locks.setdefault(lid, LockDef(*kind_rel_line))
+            self.sites.setdefault((mod.rel, call.lineno), lid)
+        # every remaining ctor site becomes an anonymous family: a lock
+        # created dynamically (dict-of-locks, per-call) still needs a
+        # static identity for the runtime sanitizer's site mapping
+        for mod in self.project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._ctor_kind(mod, node)
+                if not kind:
+                    continue
+                key = (mod.rel, node.lineno)
+                if key in self.sites:
+                    continue
+                lid = "%s.<%s@%d>" % (mod.name, kind.lower(), node.lineno)
+                self.locks.setdefault(
+                    lid, LockDef(kind, mod.rel, node.lineno, family=True))
+                self.sites[key] = lid
+
+    def _add(self, lid, kind, mod, value, pending_conds):
+        if kind == "Condition":
+            pending_conds.append((lid, mod, value))
+        else:
+            self.locks[lid] = LockDef(kind, mod.rel, value.lineno)
+            self.sites.setdefault((mod.rel, value.lineno), lid)
+
+    def _ctor_kind(self, mod, value) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _dotted(value.func)
+        if not chain or chain[-1] not in ("Lock", "RLock", "Condition"):
+            return None
+        canon = self.project.canonical(mod, chain) or ""
+        if "threading" in canon or chain[0] in ("threading", "_threading") \
+                or len(chain) == 1:
+            return chain[-1]
+        return None
+
+    # -- acquisition resolution ------------------------------------------
+    def _resolve_lock_expr(self, mod, scope, expr) -> Optional[str]:
+        lid = None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and scope is not None and scope.cls is not None:
+            lid = "%s.%s.%s" % (mod.name, scope.cls, expr.attr)
+        elif isinstance(expr, ast.Name):
+            if expr.id in mod.from_imports:
+                src, attr = mod.from_imports[expr.id]
+                lid = "%s.%s" % (src, attr)
+            else:
+                lid = "%s.%s" % (mod.name, expr.id)
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base in mod.imports:
+                lid = "%s.%s" % (mod.imports[base], expr.attr)
+        if lid is None:
+            return None
+        lid = self.cond_alias.get(lid, lid)
+        return lid if lid in self.locks else None
+
+    def acquire_id(self, mod, scope, expr,
+                   aliases: Optional[Dict[str, str]] = None) -> Optional[str]:
+        if aliases and isinstance(expr, ast.Name) and expr.id in aliases:
+            return aliases[expr.id]
+        return self._resolve_lock_expr(mod, scope, expr)
+
+    def in_scope(self, lock_id: str) -> bool:
+        modpart = lock_id.lower()
+        return any(s in modpart for s in self.scope)
+
+    # -- per-function summaries ------------------------------------------
+    def summarize_all(self):
+        if self._summarized:
+            return
+        self._summarized = True
+        if not self.locks and not self.sites:
+            self.collect_locks()
+        for mod in self.project.modules.values():
+            for fn in mod.functions.values():
+                self.summarize(fn)
+
+    def summarize(self, fn, depth=0) -> _Summary:
+        cached = self.summaries.get(id(fn))
+        if cached is not None:
+            return cached
+        s = _Summary()
+        if depth > _MAX_DEPTH or id(fn) in self.in_progress:
+            return s
+        self.in_progress.add(id(fn))
+        self._walk_fn(fn, s, depth)
+        self.in_progress.discard(id(fn))
+        self.summaries[id(fn)] = s
+        return s
+
+    def _walk_fn(self, fn, summary: _Summary, depth):
+        scope = getattr(fn, "_gl", None)
+        if scope is None:
+            return
+        mod = scope.mod
+        qual = fn_qual(fn)
+        project = self.project
+
+        # local lock aliases (lk = self._lock) and callback loop vars
+        # (for cb in self._callbacks:), collected in one prepass
+        aliases: Dict[str, str] = {}
+        cb_vars: Set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                lid = self._resolve_lock_expr(mod, scope, node.value)
+                if lid is not None:
+                    aliases[node.targets[0].id] = lid
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name):
+                ichain = _dotted(node.iter)
+                if ichain and _CB_CONTAINER_RE.search(ichain[-1]):
+                    cb_vars.add(node.target.id)
+
+        def record_blocking(kind, line, held):
+            site = (kind, mod.rel, line, qual)
+            if len(summary.blocking) < 50:
+                summary.blocking.append(site)
+            self._maybe_flag(site, held)
+
+        def maybe_callback(node, chain, held):
+            if not held or not chain:
+                return
+            name = chain[-1]
+            shaped = bool(_CB_CALL_RE.search(name)) or \
+                (len(chain) == 1 and name in cb_vars)
+            if not shaped:
+                return
+            if project.resolve_chain(mod, scope, chain):
+                return  # project-owned function, not a user callback
+            self.callback_calls.append(
+                (mod.rel, node.lineno, qual, ".".join(chain), tuple(held)))
+
+        def handle_call(node, held):
+            chain = _dotted(node.func)
+            canon = project.canonical(mod, chain) if chain else None
+            site = _FakeSite(node, chain, canon)
+            kind = blocking_kind(site)
+            if kind:
+                record_blocking(kind, node.lineno, held)
+            if not chain:
+                return
+            maybe_callback(node, chain, held)
+            for tgt in project.resolve_chain(mod, scope, chain):
+                sub = self.summarize(tgt, depth + 1)
+                summary.acquires |= sub.acquires
+                for h in held:
+                    for a in sub.acquires:
+                        if a != h:
+                            self.edges.setdefault(
+                                (h, a), (mod.rel, node.lineno, qual))
+                for bsite in sub.blocking:
+                    if len(summary.blocking) < 50:
+                        summary.blocking.append(bsite)
+                    self._maybe_flag(bsite, held)
+
+        def visit(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            handle_call(sub, held)
+                    lid = self.acquire_id(mod, scope, item.context_expr,
+                                          aliases)
+                    if lid is not None:
+                        for h in held:
+                            if h != lid:
+                                self.edges.setdefault(
+                                    (h, lid),
+                                    (mod.rel, node.lineno, qual))
+                        acquired.append(lid)
+                        summary.acquires.add(lid)
+                new_held = held + tuple(a for a in acquired
+                                        if a not in held)
+                for b in node.body:
+                    visit(b, new_held)
+                return
+            if isinstance(node, ast.Call):
+                handle_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            visit(stmt, ())
+
+    def _maybe_flag(self, bsite, held):
+        if not held:
+            return
+        kind, rel, line, qual = bsite
+        for h in held:
+            if self.in_scope(h):
+                self.blocking_findings.append(Finding(
+                    "GL003", rel, line,
+                    "%s in %s while holding %s — a hot-path lock must "
+                    "never wait on the device or the network"
+                    % (kind, qual, h),
+                    "blocking:%s:%s:%s" % (kind.split()[0], qual, h)))
+                return
+
+
+def lock_analysis(project: Project) -> LockAnalysis:
+    """Shared, fully-summarized LockAnalysis for a project (GL003, GL011
+    and the lock-graph export all reuse one instance)."""
+    cached = getattr(project, "_gl_lock_analysis", None)
+    if cached is None:
+        cached = LockAnalysis(project)
+        cached.collect_locks()
+        cached.summarize_all()
+        project._gl_lock_analysis = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def lock_graph(project: Project) -> Dict:
+    """JSON-able static lock graph for the runtime sanitizer
+    (``python -m tools.graftlint --dump-lock-graph``): the lock table with
+    constructor sites, the site->id mapping, and the acquisition edges."""
+    an = lock_analysis(project)
+    return {
+        "version": 1,
+        "locks": {
+            lid: {"kind": d.kind, "rel": d.rel, "line": d.line,
+                  "family": d.family}
+            for lid, d in sorted(an.locks.items())},
+        "sites": {"%s:%d" % site: lid
+                  for site, lid in sorted(an.sites.items())},
+        "edges": sorted([list(pair) for pair in an.edges]),
+    }
