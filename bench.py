@@ -486,6 +486,14 @@ def main():
         _health.enable()
         _health.monitor.dtype = dtype
 
+    # device-memory ledger rides along the same way (ISSUE 16): the
+    # census thread samples owner/device gauges during the run and the
+    # result carries a "memory" block plus the census A/B overhead
+    from mxnet_tpu import memwatch as _memwatch
+    memwatch_on = os.environ.get("BENCH_MEMWATCH", "1") != "0"
+    if memwatch_on:
+        _memwatch.enable()
+
     net = vision.resnet50_v1()
     net.initialize(ctx=ctx)
     net.hybridize()
@@ -493,6 +501,10 @@ def main():
     x = mx.nd.random.uniform(shape=(batch_size, 3, image_size, image_size),
                              ctx=ctx)
     y = mx.nd.array(np.random.randint(0, 1000, (batch_size,)), ctx=ctx)
+    if memwatch_on:
+        # the bench holds one synthetic batch for the whole run — ledger
+        # it as input data or it ages into a leak suspect
+        _memwatch.tag("io", (x, y), detail="bench_batch")
 
     if path == "fused":
         net(x).wait_to_read()          # materialize parameters
@@ -599,6 +611,23 @@ def main():
         med_ts_off = statistics.median(ts_off_times)
         if med_ts_off > 0:
             sampler_overhead_pct = (med / med_ts_off - 1.0) * 100.0
+
+    # memwatch A/B, same protocol and the same <1% noise bar: `med` was
+    # measured with the ledger hooks + census thread live
+    memwatch_overhead_pct = None
+    if memwatch_on:
+        _memwatch.disable()
+        mw_off_times, _ = blocked_phase(overlap_depth, iters)
+        _memwatch.enable()
+        # the off-window's donated steps produced state buffers the
+        # ledger never saw — one tagged step re-adopts them before the
+        # steady-state census, or they read as a 100 MB "leak"
+        fetch(step())
+        if health_on:
+            _health.monitor.drop_window()
+        med_mw_off = statistics.median(mw_off_times)
+        if med_mw_off > 0:
+            memwatch_overhead_pct = (med / med_mw_off - 1.0) * 100.0
 
     # checkpoint overhead A/B, same blocked protocol, <3% bar (ISSUE 13).
     # One TrainCheckpointer save cycle = host snapshot of every parameter
@@ -756,6 +785,30 @@ def main():
             "donation_leaks": sorted(n for n, p in progs.items()
                                      if p.donation_leak),
         }
+
+    # device-memory evidence (ISSUE 16): per-device peak bytes from the
+    # allocator (census high-water on CPU), the steady-state owner
+    # ledger and the measured census A/B overhead — never fails the
+    # primary metric
+    if memwatch_on:
+        try:
+            mw_snap = _memwatch.census()
+            devices = mw_snap["devices"]
+            result["memory"] = {
+                "peak_bytes_in_use": max(
+                    (st["peak_bytes_in_use"] for st in devices.values()),
+                    default=0),
+                "per_device": devices,
+                "owner_bytes": {o: rec["bytes"]
+                                for o, rec in mw_snap["owners"].items()},
+                "coverage_pct": round(mw_snap["coverage_pct"], 2),
+                "leak_suspects": len(mw_snap["suspects"]),
+                "memwatch_overhead_pct": (
+                    round(memwatch_overhead_pct, 2)
+                    if memwatch_overhead_pct is not None else None),
+            }
+        except Exception as e:
+            result["memory"] = {"error": repr(e)[:200]}
 
     # per-layer attribution (satellite, round 10): which scopes own the
     # MFU gap — top-10 flops/bytes shares per analyzed program, next to

@@ -300,6 +300,12 @@ class TrainCheckpointer:
             # be committed: latest() would have resumed past it)
             shutil.rmtree(path, ignore_errors=True)
         tree = dict(tree)
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            # snapshot leaves that are still device arrays (numpy host
+            # copies are skipped by tag) are checkpoint-owned until the
+            # async write drops them
+            _memwatch.tag("checkpoint", tree)
 
         def _finish():
             # the orbax submit itself (directory creation, serialization

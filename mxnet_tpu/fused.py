@@ -115,6 +115,19 @@ class FusedTrainer:
                 if self._momentum != 0.0 else {})
         self._state = (args, auxs, moms)
         self._params = params
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            _memwatch.tag("params", (args, auxs), detail="fused_trainer")
+            _memwatch.tag("opt_state", moms, detail="fused_trainer")
+            # the Block's own Parameter arrays stay live alongside the
+            # private donated copies — ledger them too
+            blk = {}
+            for n, p in params.items():
+                try:
+                    blk[n] = p.data()._data
+                except Exception:
+                    continue
+            _memwatch.tag("params", blk, detail="block")
         n_rng = max(1, self._plan.n_rng)
         self._keys = jnp.zeros((n_rng, 2), jnp.uint32)
 
@@ -211,6 +224,12 @@ class FusedTrainer:
             # invalidated, or the in-place chain silently broke
             _health.audit_donation("fused_trainer_step", donated_in)
         self._state = (args, auxs, moms)
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            # donation handed the old buffers to XLA — the outputs are
+            # fresh arrays that must re-enter the ledger every step
+            _memwatch.tag("params", (args, auxs), detail="fused_trainer")
+            _memwatch.tag("opt_state", moms, detail="fused_trainer")
         if _health.enabled:
             _health.monitor.on_step("fused_trainer_step")
         ctx = data.context if isinstance(data, NDArray) else None
@@ -229,3 +248,10 @@ class FusedTrainer:
             self._params[n].data()._data = jnp.array(args[n], copy=True)
         for n in self._plan.aux_names:
             self._params[n].data()._data = jnp.array(auxs[n], copy=True)
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            _memwatch.tag("params",
+                          {n: self._params[n].data()._data
+                           for n in (*self._arg_names,
+                                     *self._plan.aux_names)},
+                          detail="block")

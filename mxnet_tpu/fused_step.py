@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from . import atlas as _atlas
 from . import telemetry as _telemetry
 from . import health as _health
+from . import memwatch as _memwatch
 
 __all__ = ["enabled", "mesh_enabled", "ModuleFusedStep",
            "TrainerFusedUpdate", "TrainerMeshUpdate", "DonationPool",
@@ -116,6 +117,11 @@ class DonationPool:
     def give(self, slot, handle, new_data):
         self._own[slot] = new_data
         handle._data = new_data
+        if _memwatch.enabled:
+            # Module-path slots are ("w", name)/("s", slot, j); Trainer
+            # pools only ever hold donated opt-state (int-tuple slots).
+            _memwatch.tag("params" if slot and slot[0] == "w"
+                          else "opt_state", new_data)
 
     def disown(self, slot):
         """Forget a slot (its buffer escaped to non-pool code — e.g. the
@@ -804,6 +810,8 @@ class TrainerFusedUpdate:
             pool = self._pools[k]
             for (i, p), w, st in zip(live, new_p, new_s):
                 p.list_data()[k]._data = w
+                if _memwatch.enabled:
+                    _memwatch.tag("params", w)
                 leaves = _opt.fused_state_leaves(tr._updaters[k].states[i])
                 for j, (leaf, arr) in enumerate(zip(leaves, st)):
                     pool.give((i, j), leaf, arr)
@@ -1017,6 +1025,8 @@ class TrainerMeshUpdate:
         shards = {s.device.id: s.data for s in global_arr.addressable_shards}
         for k, h in enumerate(handles):
             h._data = shards[self._devids[k]]
+        if _memwatch.enabled:
+            _memwatch.tag("params", list(shards.values()))
 
     def _scatter_state(self, slot, leaves_k, global_arr):
         shards = {s.device.id: s.data for s in global_arr.addressable_shards}

@@ -201,6 +201,10 @@ class Parameter:
             self._data[ctx] = nd.array(
                 data.asnumpy() if isinstance(data, nd.NDArray) else data,
                 dtype=self.dtype, ctx=ctx)
+        from .. import memwatch as _memwatch
+        if _memwatch.enabled:
+            _memwatch.tag("params", list(self._data.values()),
+                          detail="gluon")
         if self._grad_req != "null":
             self._init_grad()
 
@@ -210,6 +214,10 @@ class Parameter:
         for ctx, arr in self._data.items():
             self._grad[ctx] = nd.zeros(arr.shape, dtype=arr.dtype, ctx=ctx)
             autograd.mark_variables(arr, self._grad[ctx], self._grad_req)
+        from .. import memwatch as _memwatch
+        if _memwatch.enabled:
+            _memwatch.tag("activations", list(self._grad.values()),
+                          detail="grad")
 
     def _reduce(self):
         """Reduce data from multiple contexts to cpu (ref parameter.py:312)."""

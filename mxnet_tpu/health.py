@@ -102,7 +102,11 @@ _STRAGGLER = _telemetry.gauge(
     "1 when this rank's step time exceeds the straggler band",
     ("rank",))
 
-CAUSES = ("compute_bound", "input_bound", "sync_bound", "compile_bound")
+#: ``oom_risk`` is set by memwatch's pre-flight (not by the step-window
+#: classifier); listing it here lets on_step zero it once the risky
+#: program's window passes.
+CAUSES = ("compute_bound", "input_bound", "sync_bound", "compile_bound",
+          "oom_risk")
 
 # -- peak FLOPS model (shared with bench.py) --------------------------------
 
@@ -281,6 +285,13 @@ def register_program(name, fn, args, kwargs=None, donated=False, env=None):
     _PROG_HBM.labels(program=name, kind="output").set(out_b)
     if tmp_b is not None:
         _PROG_HBM.labels(program=name, kind="temp").set(tmp_b)
+    try:
+        # OOM pre-flight: every registration site gets the projection for
+        # free; memwatch gates itself and must never break registration.
+        from . import memwatch as _memwatch
+        _memwatch.preflight(pc)
+    except Exception:
+        pass
     return pc
 
 

@@ -443,6 +443,11 @@ class PrefetchingIter(DataIter):
             return arr
         if getattr(data, "sharding", None) != self._target:
             arr._data = jax.device_put(data, self._target)
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            # producer-side staging buffers: double-buffered batches live
+            # on device before the consumer step adopts them
+            _memwatch.tag("io", arr._data, detail=self._label)
         return arr
 
     def _assemble(self, batches):

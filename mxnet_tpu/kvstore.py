@@ -152,6 +152,14 @@ class KVStore:
         self._compression = None
 
     # ---- core API -------------------------------------------------------
+    def _ledger(self, keys):
+        # the store's aggregation buffers are repointed on every push —
+        # keep them in the memory ledger or they census as untagged
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            for k in keys:
+                _memwatch.tag("opt_state", self._store[k], detail="kvstore")
+
     def init(self, key, value):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
@@ -159,6 +167,7 @@ class KVStore:
                 raise MXNetError("key %r already initialized" % k)
             v0 = v[0] if isinstance(v, (list, tuple)) else v
             self._store[k] = v0.copy()
+        self._ledger(keys)
 
     def push(self, key, value, priority=0):
         tel = _telemetry.enabled
@@ -181,6 +190,7 @@ class KVStore:
                     from .ndarray.sparse import cast_storage
                     agg = cast_storage(agg, dst.stype)
                 agg.copyto(dst)
+        self._ledger(keys)
         if tel:
             _KV_PUSH.labels(type=self.kind).inc(len(keys))
             _KV_PUSH_LAT.labels(type=self.kind).observe(
@@ -368,6 +378,7 @@ class DistKVStore(KVStore):
                 raise MXNetError("key %r already initialized" % k)
             v0 = v[0] if isinstance(v, (list, tuple)) else v
             self._store[k] = self._pg.broadcast(v0.copy(), root=0)
+        self._ledger(keys)
 
     @property
     def rank(self):
@@ -406,6 +417,7 @@ class DistKVStore(KVStore):
                 # default updater is ASSIGN (reference kvstore docs): the
                 # aggregate replaces the stored value
                 agg.copyto(self._store[k])
+        self._ledger(keys)
         if tel:
             _KV_PUSH.labels(type=self.kind).inc(len(keys))
             _KV_PUSH_LAT.labels(type=self.kind).observe(

@@ -1,0 +1,624 @@
+"""Device-memory observability: owner-tagged HBM ledger + OOM forensics.
+
+``storage.py`` exposes the raw primitives (allocator stats, live-array
+census) but nothing wires them into telemetry, health verdicts or flight
+dumps — an OOM today is a bare ``RESOURCE_EXHAUSTED`` with zero context.
+This module is the memory analog of the health monitor (PR 7), built
+from three pieces:
+
+**Owner-tagged ledger** — the allocation choke points (Module param
+init, fused-step donation pools, optimizer state creation, serving
+warmup/hot-swap, io prefetch staging, checkpoint host snapshots) call
+:func:`tag` with the owner that allocated the buffers.  The registry
+keeps ``id(array) -> (owner, detail, weakref)``; a periodic
+:func:`census` classifies ``jax.live_arrays()`` against it into
+params / opt_state / activations / serving / io / checkpoint /
+untagged and exports ``memwatch_owner_bytes{owner}`` plus per-device
+``device_bytes_in_use`` / ``device_peak_bytes_in_use`` /
+``device_bytes_limit`` gauges.  The PR 11 time-series sampler persists
+those gauges into its rings for free — the census runs on its OWN
+thread (``MXNET_MEMWATCH_INTERVAL``) because the sampler contractually
+makes zero jax calls.
+
+**OOM pre-flight** — ``health.register_program`` hands every new
+program's cost record to :func:`preflight`: projected footprint
+(args + output, + temp when ``MXNET_HEALTH_DEEP=1``) on top of the live
+tagged bytes versus the allocator ``bytes_limit``.  Crossing
+``MXNET_MEMWATCH_PREFLIGHT_FRACTION`` of the limit trips a health
+verdict ``cause=oom_risk``, an ``oom_risk`` ledger event and a
+rate-limited warning — before XLA hits the wall.
+
+**Leak sentinel + OOM forensics** — untagged arrays surviving
+``MXNET_MEMWATCH_LEAK_GENERATIONS`` censuses are flagged once into
+``memory_leak_suspects_total`` with a top-offenders table (shape /
+dtype / device / likely owner by shape-match against the ledger).  The
+executor and serving dispatch boundaries catch ``RESOURCE_EXHAUSTED``
+and call :func:`on_oom`, which dumps the flight recorder
+(``reason=oom``) — the dump embeds :func:`forensics`: the per-owner
+ledger, the suspects table, per-device stats and the last registered
+program's footprint, next to the recorder's own memory time-series
+window.
+
+Everything is gated on the module attribute :data:`enabled` (default
+OFF; ``MXNET_MEMWATCH=1`` or :func:`enable`, which implies telemetry),
+so the disabled path at every hook site is a single attribute check.
+Surfaces: ``/memz`` (telemetry HTTP), flight dumps, and the
+``tools/memwatch.py`` CLI (snapshot / ``--watch`` / ``--diff`` /
+``--smoke``).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+
+from . import telemetry as _telemetry
+from .base import get_env
+
+__all__ = ["enabled", "enable", "disable", "reset", "tag", "untag",
+           "census", "snapshot", "forensics", "preflight", "owner_bytes",
+           "is_oom", "on_oom", "start", "stop", "running", "OWNERS"]
+
+logger = logging.getLogger(__name__)
+
+#: single-attribute gate read by every hook site; default off.
+enabled: bool = False
+
+#: owner taxonomy of the ledger; census buckets every live array into
+#: one of these (or ``untagged``).
+OWNERS = ("params", "opt_state", "activations", "serving", "io",
+          "checkpoint", "untagged")
+
+#: offenders kept in the suspects table per census.
+TOP_OFFENDERS = 10
+
+# -- metrics ----------------------------------------------------------------
+
+_OWNER_BYTES = _telemetry.gauge(
+    "memwatch_owner_bytes",
+    "live device bytes attributed to an owner by the memory census",
+    ("owner",))
+_OWNER_ARRAYS = _telemetry.gauge(
+    "memwatch_owner_arrays",
+    "live array count attributed to an owner by the memory census",
+    ("owner",))
+_DEV_IN_USE = _telemetry.gauge(
+    "device_bytes_in_use",
+    "allocator bytes_in_use per device (census live bytes when the "
+    "backend exposes no allocator stats)",
+    ("device",))
+_DEV_PEAK = _telemetry.gauge(
+    "device_peak_bytes_in_use",
+    "allocator peak_bytes_in_use per device (census high-water mark on "
+    "backends without allocator stats)",
+    ("device",))
+_DEV_LIMIT = _telemetry.gauge(
+    "device_bytes_limit",
+    "allocator bytes_limit per device (0 when the backend exposes none)",
+    ("device",))
+_LEAK_SUSPECTS = _telemetry.counter(
+    "memory_leak_suspects_total",
+    "untagged arrays that survived the leak-sentinel generation window")
+_OOM_EVENTS = _telemetry.counter(
+    "memwatch_oom_total",
+    "RESOURCE_EXHAUSTED errors caught at a dispatch boundary",
+    ("site",))
+_PREFLIGHT_RISKS = _telemetry.counter(
+    "memwatch_preflight_risks_total",
+    "program registrations whose projected footprint crossed the "
+    "pre-flight fraction of bytes_limit",
+    ("program",))
+_CENSUS_SECONDS = _telemetry.histogram(
+    "memwatch_census_seconds",
+    "wall time of one memory census pass")
+
+# -- tag registry -----------------------------------------------------------
+
+# id(array) -> (owner, detail, weakref-or-None).  The weakref both keeps
+# the entry prunable and guards against id reuse: an entry whose referent
+# died is dropped at the next census, so a recycled id can never inherit
+# a stale owner.
+_tags = {}
+_lock = threading.Lock()
+
+# leak sentinel state: census generation counter, id -> first-seen
+# generation for untagged arrays, ids already counted as suspects.
+_generation = 0
+_first_seen = {}
+_flagged = set()
+
+# last census snapshot (owner totals, device stats, suspects) served by
+# snapshot()/forensics() without re-walking live arrays.
+_last_census = None
+
+# census high-water mark per device — the peak fallback for backends
+# (CPU) whose allocator exposes no stats.
+_census_peak = {}
+
+# last program name handed to preflight, for forensics attribution.
+_last_program = None
+_last_warn = {}
+
+
+def _unwrap(leaf):
+    """NDArray -> backing jax array; pass jax arrays through; None for
+    host-side leaves (numpy, scalars) the ledger cannot track."""
+    data = getattr(leaf, "_data", leaf)
+    if hasattr(data, "devices") and hasattr(data, "nbytes"):
+        return data
+    return None
+
+
+def tag(owner, leaves, detail=None):
+    """Attribute the device arrays in ``leaves`` (any pytree; NDArrays
+    are unwrapped) to ``owner``.  Re-tagging an id overwrites — buffers
+    that change hands (donation pools) follow their latest owner.
+    Returns the number of arrays tagged; 0 when disabled."""
+    if not enabled:
+        return 0
+    try:
+        import jax
+        entries = []
+        for leaf in jax.tree_util.tree_leaves(leaves):
+            arr = _unwrap(leaf)
+            if arr is None:
+                continue
+            try:
+                ref = weakref.ref(arr)
+            except TypeError:
+                ref = None
+            entries.append((id(arr), (owner, detail, ref)))
+    except Exception:
+        return 0
+    if not entries:
+        return 0
+    with _lock:
+        for key, val in entries:
+            _tags[key] = val
+            _first_seen.pop(key, None)
+            _flagged.discard(key)
+    return len(entries)
+
+
+def untag(leaves):
+    """Drop the ledger entries for ``leaves`` (used when an owner
+    releases buffers it knows are dead, e.g. serving hot-swap)."""
+    if not enabled:
+        return
+    try:
+        import jax
+        keys = [id(a) for a in
+                (_unwrap(leaf) for leaf in jax.tree_util.tree_leaves(leaves))
+                if a is not None]
+    except Exception:
+        return
+    with _lock:
+        for key in keys:
+            _tags.pop(key, None)
+
+
+def owner_bytes(owner, detail=None):
+    """Live bytes of one owner straight from the ledger weakrefs — no
+    ``jax.live_arrays()`` walk, cheap enough for per-request serving
+    stats.  ``detail`` narrows to one tag detail (e.g. a model name)."""
+    total = 0
+    with _lock:
+        entries = list(_tags.values())
+    for own, det, ref in entries:
+        if own != owner or (detail is not None and det != detail):
+            continue
+        arr = ref() if ref is not None else None
+        if arr is None:
+            continue
+        try:
+            if not arr.is_deleted():
+                total += arr.nbytes
+        except Exception:
+            continue
+    return total
+
+
+# -- census -----------------------------------------------------------------
+
+def _device_stats():
+    """Per-device allocator stats with census fallback for backends that
+    expose none; updates the device gauges."""
+    import jax
+    from . import storage as _storage
+    out = {}
+    for d in jax.local_devices():
+        key = str(d)
+        st = _storage.memory_stats(d)
+        if st:
+            in_use = int(st.get("bytes_in_use", 0))
+            peak = int(st.get("peak_bytes_in_use", 0))
+            limit = int(st.get("bytes_limit", 0))
+            source = "allocator"
+        else:
+            _, in_use = _storage.live_arrays(d)
+            peak = max(_census_peak.get(key, 0), in_use)
+            limit = 0
+            source = "census"
+        _census_peak[key] = max(_census_peak.get(key, 0), in_use)
+        peak = max(peak, _census_peak[key])
+        out[key] = {"bytes_in_use": in_use, "peak_bytes_in_use": peak,
+                    "bytes_limit": limit, "source": source}
+        _DEV_IN_USE.labels(device=key).set(in_use)
+        _DEV_PEAK.labels(device=key).set(peak)
+        _DEV_LIMIT.labels(device=key).set(limit)
+    return out
+
+
+def _likely_owner(shape, dtype, tagged_live):
+    """Shape/dtype match against the tagged live set — the leak table's
+    best guess at who allocated an untagged buffer."""
+    for (sh, dt), owner in tagged_live.items():
+        if sh == shape and dt == dtype:
+            return owner
+    for (sh, dt), owner in tagged_live.items():
+        if sh == shape:
+            return owner
+    return None
+
+
+def census():
+    """One ledger pass: classify ``jax.live_arrays()`` by owner, update
+    the gauges, age the leak sentinel, refresh device stats.  Returns
+    the snapshot dict (also cached for :func:`snapshot`).  Called by
+    the census thread, ``/memz``, and directly by tests/tools."""
+    global _generation, _last_census
+    t0 = time.perf_counter()
+    import jax
+    from . import storage as _storage
+    owners = {o: {"bytes": 0, "arrays": 0} for o in OWNERS}
+    details = {}
+    tagged_live = {}
+    live_ids = set()
+    live_skeys = set()
+    suspects = []
+    with _lock:
+        _generation += 1
+        gen = _generation
+        tags = dict(_tags)
+    arrs = []
+    for a in jax.live_arrays():
+        try:
+            arrs.append((a, _storage.array_buffers(a), int(a.nbytes),
+                         tuple(a.shape), str(a.dtype)))
+        except Exception:       # deleted/donated buffer
+            continue
+    # dedupe aliasing buffers (jax caches per-shard ArrayImpl views of
+    # sharded arrays, which alias the parent's storage): visit tagged
+    # arrays and multi-buffer parents first so the owner attribution
+    # wins and the alias contributes zero fresh bytes
+    arrs.sort(key=lambda t: (id(t[0]) in tags, len(t[1])), reverse=True)
+    seen_bufs = set()
+    for a, bufs, nbytes, shape, dtype in arrs:
+        fresh = 0
+        aliased = False
+        for d, ptr, nb in bufs:
+            if ptr is not None:
+                bkey = (id(d), ptr)
+                if bkey in seen_bufs:
+                    aliased = True
+                    continue
+                seen_bufs.add(bkey)
+            fresh += nb
+        if aliased and fresh == 0:
+            continue            # pure alias of an already-counted array
+        if bufs:
+            nbytes = fresh
+        key = id(a)
+        live_ids.add(key)
+        # sentinel identity: the first buffer pointer when available —
+        # stable across aliasing views (jax may yield a cached shard
+        # view instead of the original array on later walks), unlike
+        # id(a)
+        skey = key
+        for d, ptr, _nb in bufs:
+            if ptr is not None:
+                skey = "%x:%x" % (id(d), ptr)   # JSON-stable
+                break
+        live_skeys.add(skey)
+        entry = tags.get(key)
+        if entry is not None:
+            owner, det, ref = entry
+            referent = ref() if ref is not None else None
+            if ref is not None and referent is not a:
+                entry = None    # id reused by a new array: not this tag
+        if entry is not None:
+            owner, det, _ = entry
+            if owner not in owners:
+                owner = "untagged"
+            owners[owner]["bytes"] += nbytes
+            owners[owner]["arrays"] += 1
+            if det is not None:
+                d = details.setdefault(owner, {})
+                d[det] = d.get(det, 0) + nbytes
+            tagged_live.setdefault((shape, dtype), owner)
+            # a buffer that was a suspect but then got tagged is owned
+            # after all — drop the sentinel state
+            _first_seen.pop(skey, None)
+            _flagged.discard(skey)
+        else:
+            owners["untagged"]["bytes"] += nbytes
+            owners["untagged"]["arrays"] += 1
+            if nbytes < get_env("MXNET_MEMWATCH_LEAK_MIN_BYTES", 4096,
+                                int):
+                # scalars and other crumbs (RNG keys, loss values) churn
+                # forever below the sentinel's radar — a leak that
+                # matters is big
+                continue
+            first = _first_seen.setdefault(skey, gen)
+            age = gen - first
+            suspects.append({"id": skey, "nbytes": nbytes, "shape": shape,
+                             "dtype": dtype,
+                             "device": str(next(iter(a.devices()))),
+                             "age": age})
+    # prune registry entries whose referent died and sentinel state for
+    # buffers no longer live (frees the identity for safe reuse)
+    with _lock:
+        for key, (_, _, ref) in list(_tags.items()):
+            if key not in live_ids and ref is not None and ref() is None:
+                _tags.pop(key, None)
+        for skey in list(_first_seen):
+            if skey not in live_skeys:
+                _first_seen.pop(skey, None)
+                _flagged.discard(skey)
+
+    k = get_env("MXNET_MEMWATCH_LEAK_GENERATIONS", 3, int)
+    newly_flagged = []
+    for s in suspects:
+        s["likely_owner"] = _likely_owner(s["shape"], s["dtype"],
+                                          tagged_live)
+        if s["age"] >= k and s["id"] not in _flagged:
+            with _lock:
+                _flagged.add(s["id"])
+            newly_flagged.append(s)
+            _LEAK_SUSPECTS.inc()
+    suspects.sort(key=lambda s: s["nbytes"], reverse=True)
+    suspects = [dict(s, shape=list(s["shape"])) for s in
+                suspects[:TOP_OFFENDERS] if s["age"] >= k]
+    if newly_flagged:
+        top = max(newly_flagged, key=lambda s: s["nbytes"])
+        try:
+            from . import runlog as _runlog
+            if _runlog.enabled():
+                _runlog.event("memory_leak_suspect",
+                              new_suspects=len(newly_flagged),
+                              top_nbytes=top["nbytes"],
+                              top_shape=list(top["shape"]),
+                              top_dtype=top["dtype"],
+                              top_device=top["device"],
+                              likely_owner=top.get("likely_owner"),
+                              generation=gen)
+        except Exception:
+            pass
+
+    for o, rec in owners.items():
+        _OWNER_BYTES.labels(owner=o).set(rec["bytes"])
+        _OWNER_ARRAYS.labels(owner=o).set(rec["arrays"])
+    devices = _device_stats()
+    total = sum(rec["bytes"] for rec in owners.values())
+    tagged = total - owners["untagged"]["bytes"]
+    snap = {"unix_time": time.time(), "generation": gen,
+            "owners": owners, "details": details, "devices": devices,
+            "suspects": suspects,
+            "total_bytes": total, "tagged_bytes": tagged,
+            "untagged_bytes": owners["untagged"]["bytes"],
+            "coverage_pct": (100.0 * tagged / total) if total else 100.0}
+    with _lock:
+        _last_census = snap
+    _CENSUS_SECONDS.observe(time.perf_counter() - t0)
+    return snap
+
+
+def snapshot(refresh=False):
+    """Last census snapshot (or a fresh one when ``refresh`` / none yet);
+    the ``/memz`` payload."""
+    with _lock:
+        snap = _last_census
+    if snap is None or refresh:
+        snap = census()
+    return dict(snap, enabled=enabled, running=running(),
+                last_program=_last_program)
+
+
+# -- OOM pre-flight ---------------------------------------------------------
+
+def preflight(pc):
+    """Project a newly registered program's footprint against the
+    allocator limit; called by ``health.register_program`` with the
+    :class:`health.ProgramCost`.  Risk = live tagged bytes + args + out
+    (+ temp when known) crossing ``MXNET_MEMWATCH_PREFLIGHT_FRACTION``
+    of ``bytes_limit``.  Returns the verdict dict or None (disabled /
+    no limit known)."""
+    global _last_program
+    if not enabled or pc is None:
+        return None
+    _last_program = pc.name
+    from . import storage as _storage
+    limit = _storage.bytes_limit()
+    if limit <= 0:
+        return None
+    need = int(pc.arg_bytes or 0) + int(pc.out_bytes or 0) + \
+        int(pc.temp_bytes or 0)
+    with _lock:
+        snap = _last_census
+    live = snap["tagged_bytes"] if snap else 0
+    frac = get_env("MXNET_MEMWATCH_PREFLIGHT_FRACTION", 0.95, float)
+    projected = live + need
+    verdict = {"program": pc.name, "need_bytes": need,
+               "live_tagged_bytes": live, "bytes_limit": limit,
+               "projected_bytes": projected,
+               "risk": projected > frac * limit}
+    if verdict["risk"]:
+        _PREFLIGHT_RISKS.labels(program=pc.name).inc()
+        try:
+            from . import health as _health
+            _health._VERDICT.labels(cause="oom_risk").set(1.0)
+            _health._ANOMALIES.labels(cause="oom_risk").inc()
+        except Exception:
+            pass
+        try:
+            from . import runlog as _runlog
+            if _runlog.enabled():
+                _runlog.event("oom_risk", **verdict)
+        except Exception:
+            pass
+        interval = get_env("MXNET_MEMWATCH_WARN_INTERVAL", 60.0, float)
+        now = time.monotonic()
+        if now - _last_warn.get(pc.name, -interval) >= interval:
+            _last_warn[pc.name] = now
+            logger.warning(
+                "memwatch: program %r projects %d bytes "
+                "(%d live tagged + %d args/out/temp) against a %d-byte "
+                "limit — OOM risk", pc.name, projected, live, need, limit)
+    return verdict
+
+
+# -- OOM forensics ----------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "Out of memory", "out of memory", "OOM")
+
+
+def is_oom(exc):
+    """Best-effort RESOURCE_EXHAUSTED classifier (XlaRuntimeError carries
+    the grpc status name in its message)."""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def on_oom(exc, site="executor", program=None):
+    """Forensics for a caught RESOURCE_EXHAUSTED: fresh census, ``oom``
+    ledger event, flight dump (``reason=oom`` — the dump embeds
+    :func:`forensics`).  Never raises; callers re-raise the original
+    error.  Nested catch sites (serving wraps the executor dispatch) see
+    the same exception object once — a marker attribute on the exception
+    dedups (builtin exceptions don't support weakrefs)."""
+    if not enabled:
+        return None
+    if getattr(exc, "_memwatch_handled", False):
+        return None
+    try:
+        exc._memwatch_handled = True
+    except Exception:
+        pass
+    _OOM_EVENTS.labels(site=site).inc()
+    try:
+        snap = census()
+    except Exception:
+        snap = None
+    dump_path = None
+    try:
+        from . import tracing as _tracing
+        dump_path = _tracing.flight.dump(reason="oom")
+    except Exception:
+        pass
+    try:
+        from . import runlog as _runlog
+        if _runlog.enabled():
+            owners = {o: rec["bytes"]
+                      for o, rec in (snap or {}).get("owners", {}).items()}
+            _runlog.event("oom", site=site, program=program or _last_program,
+                          error=str(exc)[:400], owner_bytes=owners,
+                          flight_dump=dump_path)
+    except Exception:
+        pass
+    return dump_path
+
+
+def forensics():
+    """The flight-dump block: ledger snapshot + last registered
+    program's footprint (``None`` entries when health never saw one)."""
+    snap = snapshot()
+    prog = None
+    if _last_program is not None:
+        try:
+            from . import health as _health
+            pc = _health.programs().get(_last_program)
+            if pc is not None:
+                prog = dict(pc.as_dict(), name=_last_program)
+        except Exception:
+            pass
+    return {"census": snap, "last_program": prog}
+
+
+# -- census thread ----------------------------------------------------------
+
+_thread = None
+_stop = threading.Event()
+
+
+def _loop():
+    while not _stop.is_set():
+        try:
+            census()
+        except Exception:
+            logger.debug("memwatch census failed", exc_info=True)
+        _stop.wait(get_env("MXNET_MEMWATCH_INTERVAL", 5.0, float))
+
+
+def start():
+    """Start the census thread (idempotent)."""
+    global _thread
+    if _thread is not None and _thread.is_alive():
+        return
+    _stop.clear()
+    _thread = threading.Thread(target=_loop, name="memwatch-census",
+                               daemon=True)
+    _thread.start()
+
+
+def stop():
+    """Stop the census thread (the ledger and gauges stay)."""
+    global _thread
+    _stop.set()
+    t = _thread
+    if t is not None:
+        t.join(timeout=5.0)
+    _thread = None
+
+
+def running():
+    return _thread is not None and _thread.is_alive()
+
+
+# -- gates ------------------------------------------------------------------
+
+def enable(census_thread=True):
+    """Turn the ledger hooks on (implies telemetry — the gauges feed the
+    time-series sampler).  ``census_thread=False`` for tests that drive
+    :func:`census` manually."""
+    global enabled
+    _telemetry.enable()
+    enabled = True
+    if census_thread:
+        start()
+
+
+def disable():
+    global enabled
+    enabled = False
+    stop()
+
+
+def reset():
+    """Test isolation: drop the ledger, sentinel state and cached census."""
+    global _generation, _last_census, _last_program
+    stop()
+    with _lock:
+        _tags.clear()
+        _first_seen.clear()
+        _flagged.clear()
+        _generation = 0
+        _last_census = None
+    _census_peak.clear()
+    _last_warn.clear()
+    _last_program = None
+
+
+if get_env("MXNET_MEMWATCH", False, bool):
+    enable()

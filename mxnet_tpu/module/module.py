@@ -167,6 +167,15 @@ class Module(BaseModule):
         _fill(self._aux_params, aux_params)
         self._exec_group.set_params(self._arg_params, self._aux_params,
                                     allow_extra=allow_extra)
+        from .. import memwatch as _memwatch
+        if _memwatch.enabled:
+            # ledger: the device-resident parameter buffers (every exec's
+            # arg/aux dicts) plus the host master copies above — both are
+            # live jax buffers and both belong to the params budget
+            for e in self._exec_group.execs:
+                _memwatch.tag("params", (e.arg_dict, e.aux_dict))
+            _memwatch.tag("params", (self._arg_params, self._aux_params),
+                          detail="host_master")
         self.params_initialized = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -315,6 +324,14 @@ class Module(BaseModule):
                 for k, (w, g) in enumerate(zip(weights, grads)):
                     self._updater(
                         opt.Optimizer.slot_index(i, ndev, k), g, w)
+        from .. import memwatch as _memwatch
+        if _memwatch.enabled:
+            # kvstore pull / eager ops repoint grad buffers at fresh
+            # program outputs — re-ledger them or the tags die with the
+            # old buffers
+            for grads in eg.grad_arrays:
+                for g in grads or ():
+                    _memwatch.tag("activations", g)
         if tel:
             _fused.STEP_DISPATCH.labels(path="eager").inc()
             _fused.STEP_TIME.observe(time.perf_counter() - t0)

@@ -66,8 +66,15 @@ class Optimizer:
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and weight.dtype == np.float16:
             w32 = weight.astype(np.float32)
-            return (self.create_state(index, w32), w32)
-        return self.create_state(index, weight)
+            state = (self.create_state(index, w32), w32)
+        else:
+            state = self.create_state(index, weight)
+        from . import memwatch as _memwatch
+        if _memwatch.enabled and state is not None:
+            # every update path (eager Updater, fused step, Trainer mesh)
+            # funnels state creation through here — the one ledger hook
+            _memwatch.tag("opt_state", state)
+        return state
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError
@@ -727,6 +734,14 @@ class Updater:
             self.states_synced[index] = True
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+        from . import memwatch as _memwatch
+        if _memwatch.enabled:
+            # eager updates repoint weight/state handles at fresh program
+            # outputs each step — re-ledger them or the tags die with the
+            # old buffers
+            _memwatch.tag("params", weight)
+            if self.states[index] is not None:
+                _memwatch.tag("opt_state", self.states[index])
 
     def set_states(self, states):
         states = pickle.loads(states) if isinstance(states, bytes) else states

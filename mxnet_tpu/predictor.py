@@ -251,13 +251,23 @@ class Predictor:
         contract forbids)."""
         self._executor.copy_params_from(arg_params, aux_params,
                                         allow_extra_params)
-        if self._mesh is None:
-            return
-        import jax
+        if self._mesh is not None:
+            import jax
+            for name, arr in self._executor.arg_dict.items():
+                if name in self._input_names:
+                    continue
+                sh = self._rules.sharding_for(name, arr.shape)
+                arr._data = jax.device_put(arr._data, sh)
+            for arr in self._executor.aux_dict.values():
+                arr._data = jax.device_put(arr._data, self._replicated)
+        # hot-swap memory hygiene: re-point the shared param dicts at the
+        # live bound arrays.  The construction-time copies (mesh
+        # predictors and cross-context binds hold distinct buffers) would
+        # otherwise pin a dead weight generation in HBM across every
+        # future swap; after this, dropping the swap source releases it.
         for name, arr in self._executor.arg_dict.items():
-            if name in self._input_names:
-                continue
-            sh = self._rules.sharding_for(name, arr.shape)
-            arr._data = jax.device_put(arr._data, sh)
-        for arr in self._executor.aux_dict.values():
-            arr._data = jax.device_put(arr._data, self._replicated)
+            if name not in self._input_names and name in self._arg_params:
+                self._arg_params[name] = arr
+        for name, arr in self._executor.aux_dict.items():
+            if name in self._aux_params:
+                self._aux_params[name] = arr

@@ -260,6 +260,7 @@ class ModelServer:
         # beyond this after warmup is a silent recompile
         self._warm_compile_counts = self._compile_count()
         self._warmed = True
+        self._tag_memory()
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Shut down.  ``drain=True`` (graceful): stop admitting, execute
@@ -414,6 +415,7 @@ class ModelServer:
                 # post-warmup recompile
                 pred.copy_params_from(args, auxs or None,
                                       allow_extra_params=True)
+        self._tag_memory()
         if _telemetry.enabled:
             _SWAPS.inc()
         from .. import runlog as _runlog
@@ -489,9 +491,19 @@ class ModelServer:
         """One padded-bucket forward under the swap lock; returns host
         arrays (sliced per request by the caller)."""
         pred = self._predictors[bucket]
-        with self._swap_lock:
-            outs = pred.forward(**feed)
-        return [o.asnumpy() for o in outs]
+        try:
+            with self._swap_lock:
+                outs = pred.forward(**feed)
+            # the host transfer blocks on device completion — an async
+            # dispatch OOM surfaces here, inside the forensics catch
+            return [o.asnumpy() for o in outs]
+        except Exception as e:
+            from .. import memwatch as _memwatch
+            if _memwatch.enabled and _memwatch.is_oom(e):
+                _memwatch.on_oom(
+                    e, site="serving",
+                    program="serving:%s:b%d:forward" % (self.name, bucket))
+            raise
 
     def _count_slo(self, req, outcome):
         _SLO_REQS.labels(slo_class=getattr(req, "slo_class", "standard"),
@@ -582,9 +594,35 @@ class ModelServer:
         prefix = "serving:%s:" % self.name
         return sorted(n for n in _health.programs() if n.startswith(prefix))
 
+    def _tag_memory(self):
+        """Ledger the currently bound weight generation of every bucket as
+        serving-owned (detail = model name) — warmup and each hot swap
+        re-tag so ``owner_bytes("serving", detail=name)`` tracks the live
+        generation only."""
+        from .. import memwatch as _memwatch
+        if not _memwatch.enabled:
+            return
+        for pred in set(self._predictors.values()):
+            ex = getattr(pred, "_executor", None)
+            if ex is not None:
+                _memwatch.tag("serving", (ex.arg_dict, ex.aux_dict),
+                              detail=self.name)
+
+    def memory(self) -> Dict[str, object]:
+        """Per-model ledger block for /stats and /statusz: live bytes of
+        this model's bound weight generation (weakref walk — no global
+        live-array census on the request path)."""
+        from .. import memwatch as _memwatch
+        return {
+            "enabled": _memwatch.enabled,
+            "serving_bytes": _memwatch.owner_bytes("serving",
+                                                   detail=self.name),
+        }
+
     def stats(self) -> Dict[str, object]:
         return {
             "model": self.name,
+            "memory": self.memory(),
             "buckets": list(self._batcher.buckets),
             "max_batch_size": self.config.max_batch_size,
             "batch_timeout_ms": self.config.batch_timeout_ms,

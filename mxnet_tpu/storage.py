@@ -27,8 +27,8 @@ from typing import Dict, Optional, Tuple
 import jax
 
 __all__ = ["apply_pool_env", "memory_stats", "bytes_allocated",
-           "bytes_limit", "memory_info", "live_arrays", "release_all",
-           "report"]
+           "bytes_limit", "memory_info", "device_nbytes", "array_buffers",
+           "live_arrays", "release_all", "report"]
 
 
 def apply_pool_env(environ=None) -> Dict[str, str]:
@@ -99,20 +99,82 @@ def memory_info(device=None) -> Tuple[int, int]:
     return max(total - used, 0), total
 
 
+def device_nbytes(a, device) -> int:
+    """Bytes the array actually holds ON ``device``: the sum of its
+    addressable shards there.  A mesh-sharded array contributes only its
+    local shard bytes, not the global ``nbytes``, to each device."""
+    devs = a.devices()
+    if device not in devs:
+        return 0
+    if len(devs) == 1:
+        return a.nbytes
+    total = 0
+    for sh in a.addressable_shards:
+        if sh.device == device and sh.data is not None:
+            total += sh.data.nbytes
+    return total
+
+
+def array_buffers(a):
+    """``[(device, buffer_ptr_or_None, nbytes)]`` for the array's
+    addressable buffers.  The pointer identifies the underlying device
+    buffer so callers can dedupe aliases — jax caches per-shard
+    ``ArrayImpl`` views on first ``addressable_shards`` access, and
+    those views show up in ``jax.live_arrays()`` sharing the parent's
+    storage."""
+    devs = a.devices()
+    if len(devs) == 1:
+        try:
+            ptr = a.unsafe_buffer_pointer()
+        except Exception:
+            ptr = None
+        return [(next(iter(devs)), ptr, a.nbytes)]
+    out = []
+    for sh in a.addressable_shards:
+        if sh.data is None:
+            continue
+        try:
+            ptr = sh.data.unsafe_buffer_pointer()
+        except Exception:
+            ptr = None
+        out.append((sh.device, ptr, sh.data.nbytes))
+    return out
+
+
 def live_arrays(device=None) -> Tuple[int, int]:
     """(count, total_bytes) of live jax arrays, optionally filtered to one
-    device — the storage manager's live-allocation census."""
+    device — the storage manager's live-allocation census.  Per-device
+    totals count addressable shard bytes (see :func:`device_nbytes`) and
+    each underlying device buffer exactly once (aliasing shard views are
+    skipped), so summing over devices matches the global figure instead
+    of multiply-counting sharded arrays."""
     device = _as_device(device)
-    count = 0
-    total = 0
+    arrays = []
     for a in jax.live_arrays():
         try:
-            if device is not None and device not in a.devices():
-                continue
-            count += 1
-            total += a.nbytes
+            arrays.append(array_buffers(a))
         except Exception:       # deleted/donated buffers
             continue
+    # parents before their cached shard views: the view's single buffer
+    # is then already seen and skipped
+    arrays.sort(key=len, reverse=True)
+    seen = set()
+    count = 0
+    total = 0
+    for bufs in arrays:
+        contributed = 0
+        for d, ptr, nbytes in bufs:
+            if ptr is not None:
+                key = (id(d), ptr)
+                if key in seen:
+                    continue
+                seen.add(key)
+            if device is not None and d != device:
+                continue
+            contributed += nbytes
+        if contributed:
+            count += 1
+            total += contributed
     return count, total
 
 

@@ -106,9 +106,11 @@ def start_http_server(port: int, registry: MetricRegistry,
                       host: str = "127.0.0.1"):
     """Serve ``/metrics`` (text exposition), ``/metrics.json``,
     ``/statusz`` (health snapshot), ``/programz`` (registered XLA
-    programs with their atlas per-scope tables) and ``/timeseriesz``
-    (multi-resolution metric history; ``?window=SECS&prefix=NAME`` to
-    filter, ``?format=ascii`` for sparklines) on a daemon thread.
+    programs with their atlas per-scope tables), ``/memz`` (owner-tagged
+    memory ledger; ``?refresh=1`` forces a fresh census) and
+    ``/timeseriesz`` (multi-resolution metric history;
+    ``?window=SECS&prefix=NAME`` to filter, ``?format=ascii`` for
+    sparklines) on a daemon thread.
     ``/programz?top_k=N`` bounds each program's scope table.  Binds loopback by
     default — the wire is unauthenticated, so exposing it wider is an
     explicit operator choice (``MXNET_TELEMETRY_HOST``).  Returns the
@@ -156,6 +158,16 @@ def start_http_server(port: int, registry: MetricRegistry,
                          "running": _ts.running(),
                          "series": snap}).encode()
                     ctype = "application/json"
+            elif path == "/memz":
+                # lazy import for the same circularity reason as /statusz.
+                # ?refresh=1 forces a fresh census (a jax.live_arrays walk)
+                # instead of serving the census thread's last snapshot.
+                from .. import memwatch as _memwatch
+                refresh = any(part == "refresh=1"
+                              for part in query.split("&"))
+                body = json.dumps(_memwatch.snapshot(refresh=refresh),
+                                  default=str).encode()
+                ctype = "application/json"
             elif path == "/programz":
                 # lazy imports for the same circularity reason as /statusz
                 from .. import atlas as _atlas

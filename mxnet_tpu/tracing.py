@@ -403,6 +403,15 @@ class FlightRecorder:
                         doc["timeseries"] = tsdoc
                 except Exception:
                     pass
+                # memory forensics: the owner-tagged ledger, the leak
+                # suspects table and the last registered program's
+                # footprint (the oom_risk / reason=oom evidence).
+                try:
+                    from . import memwatch as _memwatch
+                    if _memwatch.enabled:
+                        doc["memwatch"] = _memwatch.forensics()
+                except Exception:
+                    pass
                 path = self.path()
                 tmp = "%s.tmp.%d" % (path, os.getpid())
                 with open(tmp, "w") as f:

@@ -1,0 +1,569 @@
+"""Device-memory observability (mxnet_tpu/memwatch.py).
+
+Covers the owner-tagged ledger across the Module eager / Module fused /
+gluon Trainer paths (tag handles survive every buffer-repoint site:
+kvstore push, updater writeback, donation pools), the per-device sharded
+census fix in ``storage.live_arrays``, the leak sentinel aging window
+with its flight-dump embedding, the OOM pre-flight projection against
+``bytes_limit``, the forced RESOURCE_EXHAUSTED forensics dump
+(``reason=oom``), serving hot-swap hygiene (old weight generation leaves
+the ledger), and the donation-audit cross-check.
+
+Assertions are written against *our* arrays (tagged-handle checks,
+owner_bytes sums) rather than global census coverage, because
+``jax.live_arrays()`` is process-global and a full pytest run carries
+live buffers from every other test file.  The >=90% whole-process
+coverage contract is asserted by ``tools/memwatch.py --smoke`` in a
+fresh interpreter.
+"""
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import health, memwatch, nd, storage, telemetry, tracing
+from mxnet_tpu import fused_step as fused
+
+S = mx.symbol
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    health.reset()
+    memwatch.reset()
+    memwatch.enable(census_thread=False)
+    yield
+    memwatch.disable()
+    memwatch.reset()
+    health.disable()
+    health.reset()
+    telemetry.disable()
+    telemetry.reset()
+    gc.collect()
+
+
+def _build_module(batch=8):
+    data = S.Variable("data")
+    label = S.Variable("softmax_label")
+    fc1 = S.FullyConnected(data, num_hidden=16, name="fc1")
+    act = S.Activation(fc1, act_type="relu")
+    fc2 = S.FullyConnected(act, num_hidden=4, name="fc2")
+    out = S.SoftmaxOutput(fc2, label, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    return mod
+
+
+class _Batch:
+    def __init__(self, batch=8, seed=0):
+        rs = np.random.RandomState(seed)
+        self.data = [nd.array(rs.randn(batch, 10).astype(np.float32))]
+        self.label = [nd.array(
+            rs.randint(0, 4, (batch,)).astype(np.float32))]
+
+
+def _tagged_ids():
+    """Live id set of the ledger (weakref-validated, like the census)."""
+    out = {}
+    for key, (owner, det, ref) in list(memwatch._tags.items()):
+        a = ref() if ref is not None else None
+        if a is not None and id(a) == key:
+            out[key] = owner
+    return out
+
+
+def _train(mod, steps=3):
+    for i in range(steps):
+        b = _Batch(seed=100 + i)
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+
+
+# ---------------------------------------------------------------------------
+# owner-tagged ledger across the three update paths
+# ---------------------------------------------------------------------------
+class TestLedgerModule:
+    @pytest.mark.parametrize("flag", ["0", "1"])
+    def test_all_handles_tagged_after_training(self, monkeypatch, flag):
+        """Every buffer the module owns is in the ledger with the right
+        owner AFTER training steps — i.e. the tags survive the eager
+        updater / kvstore push / fused donation repoints."""
+        monkeypatch.setenv(fused.ENV_FLAG, flag)
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("momentum", 0.9), ("learning_rate", 0.01)))
+        _train(mod)
+        tags = _tagged_ids()
+        ex = mod._exec_group.execs[0]
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            assert tags.get(id(arr._data)) == "params", \
+                "%s (%s path) untagged" % (name, flag)
+        # host master copies ride in the params budget too
+        for name, arr in mod._arg_params.items():
+            assert tags.get(id(arr._data)) == "params", name
+        assert memwatch.owner_bytes("params") >= sum(
+            a._data.nbytes for a in ex.arg_dict.values()
+            if a is not None)
+
+    def test_eager_grads_and_kvstore_retagged(self, monkeypatch):
+        monkeypatch.setenv(fused.ENV_FLAG, "0")
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd")
+        _train(mod)
+        tags = _tagged_ids()
+        ex = mod._exec_group.execs[0]
+        for name, g in ex.grad_dict.items():
+            assert tags.get(id(g._data)) == "activations", name
+        # the local kvstore's aggregation buffers are repointed every
+        # push — they must stay on the ledger (owner: opt_state)
+        for key, arr in mod._kvstore._store.items():
+            assert tags.get(id(arr._data)) == "opt_state", key
+        # adopted input batches are io
+        assert memwatch.owner_bytes("io") > 0
+
+    def test_census_owner_sums_and_gauges(self, monkeypatch):
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("momentum", 0.9),))
+        _train(mod)
+        snap = memwatch.census()
+        total = sum(rec["bytes"] for rec in snap["owners"].values())
+        assert total == snap["total_bytes"]
+        assert snap["tagged_bytes"] + snap["untagged_bytes"] == total
+        for owner in ("params", "opt_state", "io"):
+            assert snap["owners"][owner]["bytes"] > 0, owner
+            assert telemetry.value("memwatch_owner_bytes", owner=owner) \
+                == snap["owners"][owner]["bytes"]
+        # device gauges follow the census (CPU: census fallback source)
+        dev = next(iter(snap["devices"]))
+        st = snap["devices"][dev]
+        assert st["bytes_in_use"] > 0
+        assert st["peak_bytes_in_use"] >= st["bytes_in_use"]
+        assert telemetry.value("device_bytes_in_use", device=dev) \
+            == st["bytes_in_use"]
+
+    def test_trainer_fused_params_and_state_tagged(self, monkeypatch):
+        from mxnet_tpu import autograd
+        from mxnet_tpu.gluon import nn, Trainer
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        net = nn.Sequential()
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+        net.initialize(ctx=mx.cpu())
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 0.01})
+        for i in range(3):
+            rs = np.random.RandomState(i)
+            x = nd.array(rs.randn(8, 10).astype(np.float32))
+            with autograd.record():
+                y = net(x)
+                loss = (y * y).sum()
+            loss.backward()
+            tr.step(8)
+        tags = _tagged_ids()
+        for name, p in net.collect_params().items():
+            assert tags.get(id(p.data()._data)) == "params", name
+        # adam slots (mean/var per param) live in the donation pool
+        assert memwatch.owner_bytes("opt_state") > 0
+
+    def test_disabled_tag_is_noop(self):
+        memwatch.disable()
+        assert memwatch.tag("params", nd.array(np.zeros(4))) == 0
+        assert memwatch._tags == {}
+
+    def test_retag_overwrites_and_untag_drops(self):
+        a = nd.array(np.zeros((4, 4), np.float32))
+        assert memwatch.tag("io", a) == 1
+        memwatch.tag("checkpoint", a)
+        assert _tagged_ids()[id(a._data)] == "checkpoint"
+        memwatch.untag(a)
+        assert id(a._data) not in memwatch._tags
+
+
+# ---------------------------------------------------------------------------
+# satellite: sharded per-device census (storage.live_arrays)
+# ---------------------------------------------------------------------------
+class TestShardedCensus:
+    def test_sharded_array_not_multiply_counted(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = jax.devices()
+        assert len(devs) == 8, "conftest forces an 8-device CPU mesh"
+        mesh = Mesh(np.array(devs), ("d",))
+        before = {d: storage.live_arrays(d)[1] for d in devs}
+        x = jax.device_put(jnp.zeros((8, 64), jnp.float32),
+                           NamedSharding(mesh, P("d")))
+        after = {d: storage.live_arrays(d)[1] for d in devs}
+        shard = x.nbytes // 8
+        for d in devs:
+            assert after[d] - before[d] == shard, str(d)
+        # per-device shard bytes sum to the global figure — the old code
+        # counted the full nbytes on every holding device (8x)
+        assert sum(storage.device_nbytes(x, d) for d in devs) == x.nbytes
+        del x
+
+    def test_single_device_array_full_bytes(self):
+        import jax
+        a = nd.array(np.zeros((16, 16), np.float32))
+        d = next(iter(a._data.devices()))
+        assert storage.device_nbytes(a._data, d) == a._data.nbytes
+        other = [dv for dv in jax.devices() if dv != d][0]
+        assert storage.device_nbytes(a._data, other) == 0
+
+
+# ---------------------------------------------------------------------------
+# leak sentinel
+# ---------------------------------------------------------------------------
+class TestLeakSentinel:
+    def test_untagged_survivor_flagged_within_k(self, monkeypatch,
+                                                tmp_path):
+        import jax.numpy as jnp
+        monkeypatch.setenv("MXNET_MEMWATCH_LEAK_GENERATIONS", "2")
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH",
+                           str(tmp_path / "flight.json"))
+        # big enough to guarantee a top-offenders slot among any
+        # leftover process noise
+        leak = jnp.zeros((512, 512), jnp.float32) + 1
+        before = telemetry.value("memory_leak_suspects_total") or 0.0
+        memwatch.census()                       # first seen (age 0)
+        snap = memwatch.census()                # age 1 < K: not yet
+        assert not any(s["shape"] == [512, 512] for s in snap["suspects"])
+        snap = memwatch.census()                # age 2 >= K: flagged
+        ours = [s for s in snap["suspects"] if s["shape"] == [512, 512]]
+        assert ours and ours[0]["age"] >= 2
+        assert ours[0]["dtype"] == "float32"
+        assert ours[0]["device"]
+        assert telemetry.value("memory_leak_suspects_total") > before
+        # flagged once: another census must not re-count it
+        count = telemetry.value("memory_leak_suspects_total")
+        memwatch.census()
+        assert telemetry.value("memory_leak_suspects_total") == count
+        # ...and it lands in a flight dump via the forensics block
+        path = tracing.flight.dump(reason="manual")
+        doc = json.load(open(path))
+        sus = doc["memwatch"]["census"]["suspects"]
+        assert any(s["shape"] == [512, 512] for s in sus)
+        del leak
+
+    def test_tiny_arrays_below_floor_never_suspects(self, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("MXNET_MEMWATCH_LEAK_GENERATIONS", "1")
+        # 64 f32 = 256 bytes, under MXNET_MEMWATCH_LEAK_MIN_BYTES: RNG
+        # keys and loss scalars must churn below the sentinel's radar
+        crumb = jnp.zeros((64,), jnp.float32) + 3
+        before = telemetry.value("memory_leak_suspects_total") or 0.0
+        for _ in range(4):
+            snap = memwatch.census()
+        assert not any(s["shape"] == [64] for s in snap["suspects"])
+        assert (telemetry.value("memory_leak_suspects_total") or 0.0) \
+            == before
+        del crumb
+
+    def test_tagged_arrays_never_suspects(self):
+        a = nd.array(np.zeros((256, 256), np.float32))
+        memwatch.tag("io", a)
+        for _ in range(5):
+            snap = memwatch.census()
+        assert not any(s["shape"] == [256, 256] for s in snap["suspects"])
+
+    def test_likely_owner_by_shape_match(self):
+        import jax.numpy as jnp
+        tagged = nd.array(np.zeros((133, 70), np.float32))
+        memwatch.tag("serving", tagged)
+        memwatch.census()
+        leak = jnp.zeros((133, 70), jnp.float32) + 1
+        snap = memwatch.census()
+        ours = [s for s in snap["suspects"] if s["shape"] == [133, 70]]
+        # age below window -> not in the table yet; age it
+        for _ in range(4):
+            snap = memwatch.census()
+        ours = [s for s in snap["suspects"] if s["shape"] == [133, 70]]
+        assert ours and ours[0]["likely_owner"] == "serving"
+        del leak
+
+
+# ---------------------------------------------------------------------------
+# OOM pre-flight
+# ---------------------------------------------------------------------------
+class TestPreflight:
+    def _pc(self, name="big_step", arg=6 << 20, out=2 << 20):
+        return health.ProgramCost(name, flops=1.0, arg_bytes=arg,
+                                  out_bytes=out, temp_bytes=None,
+                                  donation_requested=False)
+
+    def test_risk_trips_verdict_and_counter(self, monkeypatch):
+        monkeypatch.setattr(storage, "bytes_limit",
+                            lambda device=None: 4 << 20)
+        v = memwatch.preflight(self._pc())
+        assert v["risk"] and v["need_bytes"] == 8 << 20
+        assert v["bytes_limit"] == 4 << 20
+        assert telemetry.value("memwatch_preflight_risks_total",
+                               program="big_step") == 1.0
+        assert telemetry.value("step_health_verdict",
+                               cause="oom_risk") == 1.0
+        assert telemetry.value("health_anomalies_total",
+                               cause="oom_risk") == 1.0
+
+    def test_roomy_limit_passes(self, monkeypatch):
+        monkeypatch.setattr(storage, "bytes_limit",
+                            lambda device=None: 1 << 40)
+        v = memwatch.preflight(self._pc())
+        assert v is not None and not v["risk"]
+        fam = telemetry.registry().get("memwatch_preflight_risks_total")
+        assert telemetry.value("memwatch_preflight_risks_total",
+                               program="big_step") in (None, 0.0)
+
+    def test_no_limit_known_is_silent(self, monkeypatch):
+        monkeypatch.setattr(storage, "bytes_limit", lambda device=None: 0)
+        assert memwatch.preflight(self._pc()) is None
+
+    def test_register_program_reaches_preflight(self, monkeypatch):
+        """health.register_program hands every program to preflight —
+        no caller opts in separately."""
+        import jax
+        import jax.numpy as jnp
+        monkeypatch.setattr(storage, "bytes_limit",
+                            lambda device=None: 1)
+        health.enable()
+        memwatch.census()
+        fn = jax.jit(lambda x: x * 2.0)
+        x = jnp.zeros((64, 64), jnp.float32)
+        health.register_program("preflight_probe", fn, (x,))
+        assert telemetry.value("memwatch_preflight_risks_total",
+                               program="preflight_probe") == 1.0
+
+    def test_fraction_knob(self, monkeypatch):
+        monkeypatch.setattr(storage, "bytes_limit",
+                            lambda device=None: 10 << 20)
+        monkeypatch.setenv("MXNET_MEMWATCH_PREFLIGHT_FRACTION", "0.5")
+        v = memwatch.preflight(self._pc())      # 8 MiB > 0.5 * 10 MiB
+        assert v["risk"]
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+class TestOOMForensics:
+    def test_is_oom_classifier(self):
+        assert memwatch.is_oom(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes"))
+        assert not memwatch.is_oom(ValueError("shape mismatch"))
+
+    def test_forced_resource_exhausted_dumps(self, monkeypatch, tmp_path):
+        """A RESOURCE_EXHAUSTED escaping the executor dispatch produces
+        one reason=oom flight dump embedding ledger + device stats +
+        the last registered program."""
+        dump = str(tmp_path / "oom_flight.json")
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH", dump)
+        monkeypatch.setenv(fused.ENV_FLAG, "0")
+        health.enable()
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd")
+        _train(mod, steps=1)                    # registers programs
+        ex = mod._exec_group.execs[0]
+
+        def boom(is_train):
+            def fn(*a, **k):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating "
+                    "9999999999 bytes")
+            return fn
+
+        monkeypatch.setattr(type(ex), "_fwd_fn", lambda self, t: boom(t))
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            mod.forward(_Batch())
+        assert telemetry.value("memwatch_oom_total", site="executor") \
+            == 1.0
+        assert telemetry.value("flight_recorder_dumps_total",
+                               reason="oom") == 1.0
+        doc = json.load(open(dump))
+        mw = doc["memwatch"]
+        assert mw["census"]["owners"]["params"]["bytes"] > 0
+        assert mw["census"]["devices"]
+        assert mw["last_program"] is not None
+        assert mw["last_program"]["arg_bytes"] > 0
+
+    def test_nested_catch_sites_dump_once(self, monkeypatch, tmp_path):
+        """serving's catch wraps the executor's: the same exception
+        object must not double-count or double-dump."""
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH",
+                           str(tmp_path / "f.json"))
+        exc = RuntimeError("RESOURCE_EXHAUSTED: oom")
+        assert memwatch.on_oom(exc, site="executor") is not None
+        assert memwatch.on_oom(exc, site="serving") is None
+        assert telemetry.value("memwatch_oom_total", site="executor") \
+            == 1.0
+        assert telemetry.value("memwatch_oom_total",
+                               site="serving") in (None, 0.0)
+
+    def test_donation_audit_cross_check(self, monkeypatch):
+        """The fused path's donated buffers: health's donation audit
+        sees no leak, and memwatch agrees — the donated generation is
+        not lingering as untagged census bytes."""
+        monkeypatch.setenv(fused.ENV_FLAG, "1")
+        health.enable()
+        mod = _build_module()
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("momentum", 0.9),))
+        _train(mod)
+        leaks = [n for n, pc in health.programs().items()
+                 if pc.donation_leak]
+        assert leaks == [], "donation audit flagged %s" % leaks
+        snap = memwatch.census()
+        # every fused-path param/slot generation but the live one was
+        # donated away; the live one is tagged, so none of the module's
+        # param-shaped buffers sit in the suspects table
+        ex = mod._exec_group.execs[0]
+        shapes = [list(a._data.shape) for n, a in ex.arg_dict.items()
+                  if n not in ("data", "softmax_label")]
+        for s in snap["suspects"]:
+            assert s["shape"] not in shapes, s
+
+
+# ---------------------------------------------------------------------------
+# serving hot-swap hygiene
+# ---------------------------------------------------------------------------
+class TestServingHygiene:
+    def _server(self, scale=0.5, **kw):
+        from mxnet_tpu.serving import ModelServer
+        x = S.var("data")
+        out = S.FullyConnected(x, num_hidden=4, no_bias=True, name="fc")
+        params = {"fc_weight": nd.array(
+            np.full((4, 8), scale, np.float32))}
+        kw.setdefault("max_batch_size", 4)
+        kw.setdefault("batch_timeout_ms", 5)
+        srv = ModelServer(out.tojson(), params,
+                          example_shapes={"data": (8,)}, **kw)
+        return srv, params
+
+    def test_swap_drops_old_generation(self):
+        import weakref
+        srv, pa = self._server(0.5)
+        srv.start()
+        try:
+            x = np.ones(8, np.float32)
+            assert np.all(srv.predict({"data": x})[0] == 4.0)
+            old_bytes = memwatch.owner_bytes("serving", detail=srv.name)
+            assert old_bytes > 0
+            old_refs = []
+            for pred in set(srv._predictors.values()):
+                for arr in pred._executor.arg_dict.values():
+                    if arr is not None:
+                        old_refs.append(weakref.ref(arr._data))
+            pb = {"fc_weight": nd.array(np.full((4, 8), 1.5, np.float32))}
+            srv.swap_params(pb)
+            assert np.all(srv.predict({"data": x})[0] == 12.0)
+            gc.collect()
+            survivors = [r for r in old_refs
+                         if r() is not None and not r().is_deleted()]
+            # the swapped-in weight repoints every bucket executor; the
+            # old generation's weight buffers must be collectable (input
+            # placeholders may live on)
+            assert len(survivors) < len(old_refs), \
+                "no old-generation buffer was released"
+            # and the ledger follows: serving bytes reflect the new
+            # generation, not old+new
+            assert memwatch.owner_bytes("serving", detail=srv.name) \
+                <= old_bytes
+        finally:
+            srv.stop()
+
+    def test_swap_under_load_no_leak_growth(self):
+        srv, pa = self._server(0.5)
+        pb = {"fc_weight": nd.array(np.full((4, 8), 1.5, np.float32))}
+        srv.start()
+        try:
+            x = np.ones((2, 8), np.float32)
+            srv.predict({"data": x})
+            gc.collect()
+            base = memwatch.owner_bytes("serving", detail=srv.name)
+            for i in range(20):
+                srv.swap_params([pa, pb][i % 2])
+                srv.predict({"data": x})
+            gc.collect()
+            after = memwatch.owner_bytes("serving", detail=srv.name)
+            # 20 swaps must not accrete weight generations: the serving
+            # footprint stays within 2x of one generation
+            assert after <= 2 * base, (base, after)
+        finally:
+            srv.stop()
+
+    def test_stats_and_health_carry_memory_block(self):
+        srv, _ = self._server()
+        srv.start()
+        try:
+            st = srv.stats()
+            assert st["memory"]["enabled"] is True
+            assert st["memory"]["serving_bytes"] > 0
+            assert srv.health()["memory"]["serving_bytes"] \
+                == st["memory"]["serving_bytes"]
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /memz, snapshot, census thread
+# ---------------------------------------------------------------------------
+class TestSurfaces:
+    def test_memz_endpoint(self):
+        import urllib.request
+        from mxnet_tpu.telemetry import export as texp
+        a = nd.array(np.zeros((32, 32), np.float32))
+        memwatch.tag("io", a)
+        port = texp.start_http_server(0, telemetry.registry())
+        try:
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/memz?refresh=1" % port,
+                timeout=10).read()
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            assert doc["owners"]["io"]["bytes"] >= a._data.nbytes
+            assert doc["devices"]
+        finally:
+            texp.stop_http_server()
+
+    def test_snapshot_caches_until_refresh(self):
+        s1 = memwatch.snapshot()
+        s2 = memwatch.snapshot()
+        assert s2["generation"] == s1["generation"]
+        s3 = memwatch.snapshot(refresh=True)
+        assert s3["generation"] == s1["generation"] + 1
+
+    def test_census_thread_lifecycle(self, monkeypatch):
+        monkeypatch.setenv("MXNET_MEMWATCH_INTERVAL", "0.05")
+        memwatch.start()
+        assert memwatch.running()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if memwatch.snapshot().get("generation", 0) >= 2:
+                break
+            time.sleep(0.05)
+        assert memwatch.snapshot()["generation"] >= 2
+        memwatch.stop()
+        assert not memwatch.running()
+
+    def test_census_prunes_dead_entries(self):
+        a = nd.array(np.zeros((8, 8), np.float32))
+        memwatch.tag("io", a)
+        key = id(a._data)
+        del a
+        gc.collect()
+        memwatch.census()
+        assert key not in memwatch._tags

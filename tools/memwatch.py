@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Memory-ledger CLI: snapshot, watch, diff and smoke-check memwatch.
+
+Modes:
+
+``snapshot`` (default)
+    GET ``/memz`` from a running job's telemetry endpoint and render the
+    owner ledger, per-device allocator stats and leak-suspects table.
+    ``--refresh`` forces a fresh census server-side; ``-o FILE`` saves
+    the raw JSON for a later ``--diff``.
+
+``--watch [SECS]``
+    Poll the endpoint and reprint the ledger with per-owner deltas —
+    a top(1) for device memory.
+
+``--diff A B``
+    Two saved snapshots -> per-owner / per-device byte deltas plus the
+    suspects that appeared in B.  The forensic workflow: snapshot before
+    and after the suspect window, diff, read the growth.
+
+``--smoke``
+    Self-contained in-process check (no server): enable memwatch, run a
+    tiny train loop through Module, then assert the acceptance contract
+    — tagged coverage >= 90% of census bytes, zero leak suspects, and
+    an OOM pre-flight verdict that passes under a roomy synthetic
+    ``bytes_limit`` and trips under a 1-byte one.  Exit 0/1.
+
+Usage:
+    python tools/memwatch.py [--url http://127.0.0.1:9102] [--refresh]
+    python tools/memwatch.py -o before.json
+    python tools/memwatch.py --watch 5
+    python tools/memwatch.py --diff before.json after.json
+    python tools/memwatch.py --smoke
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return "%.1f %s" % (n, unit) if unit != "B" \
+                else "%d B" % int(n)
+        n /= 1024.0
+
+
+def _default_url():
+    port = os.environ.get("MXNET_TELEMETRY_PORT")
+    return "http://127.0.0.1:%s" % port if port else "http://127.0.0.1:9102"
+
+
+def _fetch(url, refresh):
+    full = url.rstrip("/") + "/memz" + ("?refresh=1" if refresh else "")
+    with urllib.request.urlopen(full, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _render(snap, prev=None, out=sys.stdout):
+    w = out.write
+    w("memwatch @ %s  gen=%s  coverage=%.2f%%  enabled=%s\n"
+      % (time.strftime("%H:%M:%S",
+                       time.localtime(snap.get("unix_time", time.time()))),
+         snap.get("generation"), snap.get("coverage_pct", 0.0),
+         snap.get("enabled")))
+    w("%-12s %14s %8s %12s\n" % ("owner", "bytes", "arrays", "delta"))
+    prev_owners = (prev or {}).get("owners", {})
+    for owner, rec in snap.get("owners", {}).items():
+        delta = rec["bytes"] - prev_owners.get(owner, {}).get("bytes", 0) \
+            if prev else 0
+        w("%-12s %14s %8d %12s\n"
+          % (owner, _fmt_bytes(rec["bytes"]), rec["arrays"],
+             ("%+d" % delta) if prev else "-"))
+    for dev, st in snap.get("devices", {}).items():
+        w("device %-24s in_use=%s peak=%s limit=%s (%s)\n"
+          % (dev, _fmt_bytes(st["bytes_in_use"]),
+             _fmt_bytes(st["peak_bytes_in_use"]),
+             _fmt_bytes(st["bytes_limit"]) if st["bytes_limit"] else "-",
+             st.get("source", "?")))
+    suspects = snap.get("suspects", [])
+    if suspects:
+        w("leak suspects (age >= sentinel window):\n")
+        for s in suspects:
+            w("  %10s  shape=%s dtype=%s device=%s age=%d likely=%s\n"
+              % (_fmt_bytes(s["nbytes"]), s["shape"], s["dtype"],
+                 s["device"], s["age"], s.get("likely_owner")))
+    out.flush()
+
+
+def _diff(path_a, path_b, out=sys.stdout):
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    w = out.write
+    w("diff %s -> %s\n" % (path_a, path_b))
+    w("%-12s %14s %14s %14s\n" % ("owner", "before", "after", "delta"))
+    owners = sorted(set(a.get("owners", {})) | set(b.get("owners", {})))
+    for owner in owners:
+        ba = a.get("owners", {}).get(owner, {}).get("bytes", 0)
+        bb = b.get("owners", {}).get(owner, {}).get("bytes", 0)
+        w("%-12s %14s %14s %+14d\n"
+          % (owner, _fmt_bytes(ba), _fmt_bytes(bb), bb - ba))
+    devs = sorted(set(a.get("devices", {})) | set(b.get("devices", {})))
+    for dev in devs:
+        da = a.get("devices", {}).get(dev, {}).get("bytes_in_use", 0)
+        db = b.get("devices", {}).get(dev, {}).get("bytes_in_use", 0)
+        w("device %-24s %14s %14s %+14d\n"
+          % (dev, _fmt_bytes(da), _fmt_bytes(db), db - da))
+    old_ids = {s["id"] for s in a.get("suspects", [])}
+    new = [s for s in b.get("suspects", []) if s["id"] not in old_ids]
+    if new:
+        w("new leak suspects in %s:\n" % path_b)
+        for s in new:
+            w("  %10s  shape=%s dtype=%s device=%s likely=%s\n"
+              % (_fmt_bytes(s["nbytes"]), s["shape"], s["dtype"],
+                 s["device"], s.get("likely_owner")))
+    out.flush()
+    return 0
+
+
+def _smoke():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import health, memwatch, storage
+
+    memwatch.reset()
+    health.enable()
+    memwatch.enable(census_thread=False)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.rand(8, 32).astype("float32"))],
+        label=[mx.nd.array(
+            np.random.randint(0, 4, (8,)).astype("float32"))])
+    mod.bind(data_shapes=[("data", (8, 32))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    for _ in range(3):
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+
+    snap = memwatch.census()
+    failures = []
+    if snap["coverage_pct"] < 90.0:
+        failures.append("coverage %.2f%% < 90%%" % snap["coverage_pct"])
+    if snap["suspects"]:
+        failures.append("leak suspects present: %r" % snap["suspects"])
+
+    # pre-flight: CPU backends expose no allocator limit, so exercise the
+    # projection against synthetic limits — roomy must pass, 1 byte must
+    # trip.
+    pcs = health.programs()
+    verdicts = {}
+    if pcs:
+        pc = next(iter(pcs.values()))
+        real_limit = storage.bytes_limit
+        try:
+            storage.bytes_limit = lambda device=None: 1 << 40
+            roomy = memwatch.preflight(pc)
+            storage.bytes_limit = lambda device=None: 1
+            tight = memwatch.preflight(pc)
+        finally:
+            storage.bytes_limit = real_limit
+        verdicts = {"roomy": roomy, "tight": tight}
+        if roomy is None or roomy["risk"]:
+            failures.append("pre-flight flagged a tiny program against a "
+                            "1 TiB limit: %r" % (roomy,))
+        if tight is None or not tight["risk"]:
+            failures.append("pre-flight missed a 1-byte limit: %r"
+                            % (tight,))
+    else:
+        failures.append("no program registered with health — pre-flight "
+                        "never exercised")
+
+    print(json.dumps({
+        "probe": "memwatch", "ok": not failures, "failures": failures,
+        "coverage_pct": round(snap["coverage_pct"], 2),
+        "owners": {o: rec["bytes"] for o, rec in snap["owners"].items()},
+        "suspects": len(snap["suspects"]),
+        "preflight": {k: (v and {"risk": v["risk"],
+                                 "need_bytes": v["need_bytes"]})
+                      for k, v in verdicts.items()},
+    }))
+    return 0 if not failures else 1
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(
+        description="memwatch ledger CLI (see module docstring)")
+    ap.add_argument("--url", default=_default_url(),
+                    help="telemetry endpoint base URL")
+    ap.add_argument("--refresh", action="store_true",
+                    help="force a fresh census server-side")
+    ap.add_argument("-o", "--output", metavar="FILE",
+                    help="save the raw snapshot JSON")
+    ap.add_argument("--watch", nargs="?", const=5.0, type=float,
+                    metavar="SECS", help="poll and reprint with deltas")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="diff two saved snapshot files")
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process acceptance smoke (no server needed)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke()
+    if args.diff:
+        return _diff(args.diff[0], args.diff[1])
+    if args.watch is not None:
+        prev = None
+        try:
+            while True:
+                snap = _fetch(args.url, refresh=True)
+                _render(snap, prev=prev)
+                sys.stdout.write("\n")
+                prev = snap
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+    snap = _fetch(args.url, args.refresh)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        print("saved %s" % args.output)
+    _render(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

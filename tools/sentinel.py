@@ -91,6 +91,15 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     # (an accidental cache bypass shows up as exactly that).
     "step_first_compile_seconds":   ("lower",  0.50, 3.0),
     "serving_warmup_seconds":       ("lower",  0.50, 2.0),
+    # device-memory observability (ISSUE 16): the resnet50 round's
+    # per-device peak — LOWER is good; a step that suddenly holds more
+    # HBM regressed even if it got faster.  Generous absolute slack
+    # because the CPU census-fallback peak moves with unrelated process
+    # residents.
+    "resnet50_peak_bytes_in_use":   ("lower",  0.25, float(8 << 20)),
+    # census + ledger hooks must stay at noise level, same bar as the
+    # monitor/sampler
+    "memwatch_overhead_pct":        ("lower",  0.00, 1.0),
 }
 #: band for metrics not in the table: 15% relative, either direction bad
 #: is unknowable, so assume higher-is-better (throughput-style default).
@@ -138,6 +147,10 @@ def _norm_bench_parsed(parsed: dict, source: str) -> dict:
     if isinstance(health, dict):
         put("monitor_overhead_pct", health.get("monitor_overhead_pct"))
         put("sampler_overhead_pct", health.get("sampler_overhead_pct"))
+    memory = parsed.get("memory")
+    if isinstance(memory, dict) and "error" not in memory:
+        put("resnet50_peak_bytes_in_use", memory.get("peak_bytes_in_use"))
+        put("memwatch_overhead_pct", memory.get("memwatch_overhead_pct"))
     atlas = parsed.get("atlas")
     if isinstance(atlas, dict) and "error" not in atlas:
         covs = [_num(a.get("coverage_pct")) for a in atlas.values()
