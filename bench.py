@@ -629,6 +629,26 @@ def main():
         if med_mw_off > 0:
             memwatch_overhead_pct = (med / med_mw_off - 1.0) * 100.0
 
+    # fleet-collector scrape overhead A/B, same protocol and the same
+    # <1% noise bar: `med` above ran unscraped; this span re-measures
+    # while a live collector scrapes this process's /allz every 0.5s —
+    # 10x the production cadence — so the delta bounds the serve+scrape
+    # cost from the training loop's point of view
+    fleet_overhead_pct = None
+    if health_on and os.environ.get("BENCH_FLEET", "1") != "0":
+        import tempfile
+        from mxnet_tpu.telemetry import fleet as _fleet
+        with tempfile.TemporaryDirectory() as fleet_dir:
+            _fleet.register_endpoint(_telemetry.start_http_server(0),
+                                     fleet_dir=fleet_dir)
+            _fleet.start_collector(fleet_dir=fleet_dir, interval=0.5)
+            fl_times, _ = blocked_phase(overlap_depth, iters)
+            _fleet.reset()
+        _health.monitor.drop_window()
+        med_fl = statistics.median(fl_times)
+        if med > 0:
+            fleet_overhead_pct = (med_fl / med - 1.0) * 100.0
+
     # checkpoint overhead A/B, same blocked protocol, <3% bar (ISSUE 13).
     # One TrainCheckpointer save cycle = host snapshot of every parameter
     # + off-thread async orbax write; its marginal cost (including the
@@ -777,6 +797,9 @@ def main():
             "sampler_overhead_pct": (round(sampler_overhead_pct, 2)
                                      if sampler_overhead_pct is not None
                                      else None),
+            "fleet_scrape_overhead_pct": (round(fleet_overhead_pct, 2)
+                                          if fleet_overhead_pct is not None
+                                          else None),
             "program_flops": {n: p.flops for n, p in sorted(progs.items())},
             "program_hbm_bytes": {
                 n: {"args": p.arg_bytes, "output": p.out_bytes,

@@ -56,7 +56,8 @@ from .base import get_env
 __all__ = ["enabled", "enable", "disable", "peak_tflops", "achieved_tflops",
            "mfu_fraction", "mfu_impossible", "register_program",
            "audit_donation", "programs", "program_flops_total", "monitor",
-           "workers", "statusz", "StepMonitor", "WorkerTable", "CAUSES"]
+           "workers", "statusz", "healthz", "StepMonitor", "WorkerTable",
+           "CAUSES"]
 
 #: single-attribute gate read by every hook site; default off.
 enabled: bool = False
@@ -652,6 +653,28 @@ def statusz():
         "workers": workers.snapshot(),
         "program_cache": _program_cache.stats(),
     }
+
+
+def healthz():
+    """Process-level liveness/degradation verdict for scrape consumers
+    (served on ``/healthz`` and bundled into ``/allz``).  ``degraded``
+    when the step window is attributed to oom_risk or an anomaly tripped
+    within the last 60 s; a reachable process is otherwise ``ok`` even
+    with the health hooks off (liveness and health are different
+    questions)."""
+    snap = monitor.snapshot()
+    causes = []
+    if snap["cause"] == "oom_risk":
+        causes.append("oom_risk")
+    now = time.time()
+    for entry in reversed(snap["ledger"]):
+        if entry.get("anomaly") and now - entry.get("unix_time", 0.0) <= 60.0:
+            causes.append("recent_anomaly")
+            break
+    return {"status": "degraded" if causes else "ok", "enabled": enabled,
+            "causes": causes, "cause": snap["cause"],
+            "mfu_pct": snap["mfu_pct"],
+            "ewma_seconds": snap["ewma_seconds"]}
 
 
 # -- gates ------------------------------------------------------------------

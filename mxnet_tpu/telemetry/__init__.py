@@ -29,6 +29,8 @@ attribute :data:`enabled` — a single attribute check on the disabled
 """
 from __future__ import annotations
 
+import sys as _sys
+
 from ..base import get_env
 from .registry import (Counter, Gauge, Histogram, MetricRegistry,
                        WindowedRate, DEFAULT_TIME_BUCKETS, log_buckets)
@@ -72,12 +74,18 @@ def histogram(name, help="", labelnames=(),  # noqa: A002
 def enable():
     """Turn the built-in instrumentation on; starts the /metrics endpoint
     when ``MXNET_TELEMETRY_PORT`` is set and the time-series sampler
-    unless ``MXNET_TELEMETRY_TS=0``."""
+    unless ``MXNET_TELEMETRY_TS=0``.  With ``MXNET_FLEET_DIR`` also set,
+    the bound endpoint is announced in the fleet directory so a fleet
+    collector can discover and scrape this process (see telemetry/fleet
+    and docs/observability.md "Fleet")."""
     global enabled
     enabled = True
     port = get_env("MXNET_TELEMETRY_PORT", None, int)
     if port is not None:
-        start_http_server(port)
+        bound = start_http_server(port)
+        if get_env("MXNET_FLEET_DIR", None):
+            from . import fleet as _fleet
+            _fleet.register_endpoint(bound)
     if get_env("MXNET_TELEMETRY_TS", True, bool):
         timeseries.start()
 
@@ -86,6 +94,8 @@ def disable():
     global enabled
     enabled = False
     timeseries.stop()
+    if "mxnet_tpu.telemetry.fleet" in _sys.modules:
+        _sys.modules["mxnet_tpu.telemetry.fleet"].unregister_endpoint()
 
 
 def snapshot():

@@ -10,9 +10,13 @@ carries the same data as one nested dict for programmatic consumers
 from __future__ import annotations
 
 import json
+import re
 import threading
+import time
+import urllib.parse
 from typing import Dict, Optional
 
+from ..base import get_env
 from .registry import Counter, Gauge, Histogram, MetricRegistry
 
 __all__ = ["prometheus_text", "snapshot", "snapshot_json",
@@ -168,6 +172,60 @@ def start_http_server(port: int, registry: MetricRegistry,
                 body = json.dumps(_memwatch.snapshot(refresh=refresh),
                                   default=str).encode()
                 ctype = "application/json"
+            elif path == "/healthz":
+                from .. import health as _health
+                body = json.dumps(_health.healthz()).encode()
+                ctype = "application/json"
+            elif path == "/allz":
+                # one round-trip for scrape consumers (the fleet
+                # collector): statusz + healthz + memz + a full metrics
+                # snapshot + a bounded timeseries window.  Each block is
+                # independent — one failing subsystem must not take the
+                # whole scrape down.
+                window = get_env("MXNET_FLEET_ALLZ_WINDOW", 60.0, float)
+                for part in query.split("&"):
+                    if part.startswith("window="):
+                        try:
+                            window = float(part[len("window="):])
+                        except ValueError:
+                            pass
+                doc = {"unix_time": time.time()}
+                try:
+                    from .. import health as _health
+                    doc["statusz"] = _health.statusz()
+                    doc["healthz"] = _health.healthz()
+                except Exception:
+                    pass
+                try:
+                    from .. import memwatch as _memwatch
+                    doc["memz"] = _memwatch.snapshot(refresh=False)
+                except Exception:
+                    pass
+                doc["metrics"] = snapshot(registry)
+                try:
+                    from . import timeseries as _ts
+                    doc["timeseries"] = _ts.trailing(
+                        window_seconds=window)
+                except Exception:
+                    pass
+                body = json.dumps(doc, default=str).encode()
+                ctype = "application/json"
+            elif path == "/fleetz":
+                # only meaningful on the collector process
+                from . import fleet as _fleet
+                if not _fleet.running():
+                    self.send_error(404, "no fleet collector running")
+                    return
+                window = None
+                for part in query.split("&"):
+                    if part.startswith("window="):
+                        try:
+                            window = float(part[len("window="):])
+                        except ValueError:
+                            pass
+                body = json.dumps(_fleet.fleetz(window=window),
+                                  default=str).encode()
+                ctype = "application/json"
             elif path == "/programz":
                 # lazy imports for the same circularity reason as /statusz
                 from .. import atlas as _atlas
@@ -189,6 +247,35 @@ def start_http_server(port: int, registry: MetricRegistry,
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802 - stdlib API
+            # /flightz: remote flight-recorder dump trigger (the fleet
+            # collector fires this at the offending rank when a page-
+            # severity alert fires, so the forensic snapshot is captured
+            # at fire time).  The reason string is sanitized — it ends
+            # up as a metric label and in the dump filename's doc.
+            path, _, query = self.path.partition("?")
+            if path != "/flightz":
+                self.send_error(404)
+                return
+            reason = "fleet_alert"
+            for part in query.split("&"):
+                if part.startswith("reason="):
+                    reason = urllib.parse.unquote(part[len("reason="):])
+            reason = re.sub(r"[^A-Za-z0-9_.-]", "_", reason)[:64] \
+                or "fleet_alert"
+            try:
+                from .. import tracing as _tracing
+                dump_path = _tracing.flight.dump(reason=reason)
+            except Exception:
+                dump_path = None
+            body = json.dumps({"path": dump_path,
+                               "reason": reason}).encode()
+            self.send_response(200 if dump_path else 500)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

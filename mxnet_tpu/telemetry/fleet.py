@@ -1,0 +1,1067 @@
+"""Fleet control plane: discovery, cross-process scrape/merge, alerting.
+
+Every process with ``MXNET_TELEMETRY_PORT`` exports rich per-process
+endpoints (``/statusz``, ``/timeseriesz``, ``/memz``, ``/healthz``) —
+but nothing watches a *gang* of them as one system.  This module is the
+Monarch/Borgmon-style pull layer on top:
+
+- **discovery** — :func:`register_endpoint` drops a JSON endpoint file
+  (rank, role, pid, host, port, run_id) into ``MXNET_FLEET_DIR`` and
+  keeps its mtime fresh from a heartbeat thread; :func:`discover` reaps
+  files whose mtime is older than ``MXNET_FLEET_STALE_AFTER``, so a
+  SIGKILLed rank disappears from the fleet view without coordination.
+- **scrape + merge** — :class:`FleetCollector` polls every endpoint's
+  consolidated ``/allz`` document once per ``MXNET_FLEET_SCRAPE_INTERVAL``
+  (per-target timeout + exponential backoff, ``fleet_scrape_*`` self-
+  metrics) and lands the samples in rank-labeled multi-resolution ring
+  buffers (:class:`FleetStore`, reusing the timeseries tiers), plus a
+  derived layer: fleet step rate, ``fleet_mfu_pct``, straggler skew
+  (max/median step time), HBM by owner and by rank, per-model QPS and
+  shed rate.  The merged view is served from the collector process's own
+  ``/fleetz`` endpoint and embedded in its flight dumps.
+- **alerting** — declarative :class:`AlertRule` s (``threshold``,
+  ``delta``, ``absence``, multi-window ``burn_rate``) over any fleet or
+  per-rank series.  A fire emits a ``fleet_alert`` runlog event, bumps
+  ``fleet_alerts_total{rule,severity}`` and — for page severity — POSTs
+  the *offending rank's* ``/flightz`` trigger so the forensic snapshot
+  is captured at fire time, not at postmortem time.  Firing is edge-
+  triggered and debounced (``MXNET_FLEET_ALERT_DEBOUNCE``): a persisting
+  condition fires exactly once until it resolves.
+
+Scraped-quantile convention: ``/timeseriesz`` and ``/allz`` serialize a
+histogram quantile that falls in the +Inf overflow bucket as JSON
+``null``.  :func:`quantile_from_buckets` keeps that convention on the
+client side (``None`` = off-scale, ``0.0`` = no observations), and the
+dashboard renders it ``>max`` — an off-scale tail must never read as 0.
+
+Lock discipline (graftlint GL003): no HTTP, file or runlog I/O happens
+under the store or collector locks — scrape documents are fetched and
+parsed first, then appended under the lock; alert actions are collected
+under the lock and executed after it is released.  All threads are
+daemons stopped via ``Event`` + joined with a timeout (GL008).
+
+The dashboard client lives in ``tools/fleetwatch.py``; the protocol and
+rule table are documented in docs/observability.md ("Fleet").
+"""
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import get_env
+from .. import telemetry as _telemetry
+from . import timeseries as _timeseries
+
+__all__ = ["register_endpoint", "unregister_endpoint", "endpoint_path",
+           "discover", "quantile_from_buckets", "FleetStore", "AlertRule",
+           "FleetCollector", "register_rule", "rules", "reset_rules",
+           "default_rules", "start_collector", "stop_collector", "running",
+           "collector", "fleetz", "flight_block", "reset"]
+
+# -- self-metrics (GL005: every name below is a row in the metric table
+# of docs/observability.md) -------------------------------------------------
+
+_SCRAPES = _telemetry.counter(
+    "fleet_scrape_total",
+    "fleet collector scrapes completed, by target", ("target",))
+_SCRAPE_ERRS = _telemetry.counter(
+    "fleet_scrape_errors_total",
+    "fleet scrape failures (connect/timeout/parse), by target", ("target",))
+_SCRAPE_TIME = _telemetry.histogram(
+    "fleet_scrape_seconds",
+    "wall time of one target scrape: /allz round-trip plus merge",
+    ("target",))
+_TARGETS = _telemetry.gauge(
+    "fleet_targets",
+    "endpoint files currently live in the fleet directory")
+_REAPED = _telemetry.counter(
+    "fleet_reaped_endpoints_total",
+    "stale endpoint files reaped from the fleet directory by mtime")
+_STEP_RATE = _telemetry.gauge(
+    "fleet_step_rate",
+    "aggregate optimization steps/s summed across scraped ranks")
+_FLEET_MFU = _telemetry.gauge(
+    "fleet_mfu_pct",
+    "mean live MFU percent across ranks reporting step_mfu_pct")
+_SKEW = _telemetry.gauge(
+    "fleet_straggler_skew",
+    "max/median step-time ratio across ranks (straggler signal)")
+_HBM_OWNER = _telemetry.gauge(
+    "fleet_hbm_bytes",
+    "fleet-wide HBM bytes by memwatch owner, summed across ranks",
+    ("owner",))
+_RANK_HBM = _telemetry.gauge(
+    "fleet_rank_hbm_bytes",
+    "per-rank device bytes in use, summed over the rank's devices",
+    ("rank",))
+_HBM_FRAC = _telemetry.gauge(
+    "fleet_hbm_used_frac",
+    "worst-rank HBM used/limit fraction across the fleet")
+_SERVING_P99 = _telemetry.gauge(
+    "fleet_serving_p99_seconds",
+    "worst-rank serving request p99 (NaN while the tail is off-scale)")
+_MODEL_QPS = _telemetry.gauge(
+    "fleet_model_qps",
+    "fleet-wide ok-outcome requests/s by served model", ("model",))
+_MODEL_SHED = _telemetry.gauge(
+    "fleet_model_shed_rate",
+    "fleet-wide rejected-outcome requests/s by served model", ("model",))
+_ALERTS_TOTAL = _telemetry.counter(
+    "fleet_alerts_total",
+    "alert-rule fires by rule and severity", ("rule", "severity"))
+_ALERTS_ACTIVE = _telemetry.gauge(
+    "fleet_alerts_active",
+    "currently-firing alert instances by severity", ("severity",))
+
+_SEVERITIES = ("warn", "page")
+
+#: metric-name prefixes merged into the fleet store (bounds the ring
+#: count per rank; empty string = merge everything).
+_DEFAULT_PREFIXES = ("step_,worker_,serving_,device_,memwatch_,"
+                     "trainer_,health_,kvstore_")
+
+
+def _median(values):
+    vals = sorted(values)
+    n = len(vals)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+# ---------------------------------------------------------------------------
+# endpoint registration + discovery
+# ---------------------------------------------------------------------------
+
+def _self_identity():
+    role = os.environ.get("DMLC_ROLE", "worker") or "worker"
+    key = "DMLC_WORKER_ID" if role == "worker" else "DMLC_SERVER_ID"
+    try:
+        rank = int(os.environ.get(key, "0") or "0")
+    except ValueError:
+        rank = 0
+    return role, rank
+
+
+def _write_endpoint(path, doc):
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+class _Heartbeat(threading.Thread):
+    """Daemon loop: rewrite the endpoint file every ``interval`` seconds
+    so its mtime stays fresh (and the file resurrects if a collector's
+    reaper raced a long GC pause)."""
+
+    def __init__(self, path, doc, interval):
+        super().__init__(name="mxtpu-fleet-heartbeat", daemon=True)
+        self._path = path
+        self._doc = doc
+        self._interval = float(interval)
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self._doc["unix_time"] = time.time()
+                _write_endpoint(self._path, self._doc)
+            except Exception:
+                pass  # a full disk must not take the process down
+
+    def halt(self, timeout: float = 2.0):
+        self._stop_evt.set()
+        self.join(timeout)
+
+
+_endpoint_lock = threading.Lock()
+_endpoint_file: Optional[str] = None
+_heartbeat: Optional[_Heartbeat] = None
+
+
+def register_endpoint(port, fleet_dir=None, host=None, run_id=None):
+    """Announce this process's telemetry endpoint in the fleet directory.
+
+    Writes ``endpoint_<role><rank>_<pid>.json`` atomically and starts a
+    heartbeat thread that keeps the mtime fresh.  Idempotent (the
+    previous registration is replaced).  Returns the file path, or None
+    when no fleet directory is configured."""
+    if fleet_dir is None:
+        fleet_dir = get_env("MXNET_FLEET_DIR", None)
+    if not fleet_dir:
+        return None
+    if host is None:
+        host = get_env("MXNET_TELEMETRY_HOST", "127.0.0.1")
+    if run_id is None:
+        run_id = get_env("MXNET_RUN_ID", "")
+    role, rank = _self_identity()
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = os.path.join(fleet_dir, "endpoint_%s%d_%d.json"
+                        % (role, rank, os.getpid()))
+    doc = {"rank": rank, "role": role, "pid": os.getpid(), "host": host,
+           "port": int(port), "run_id": run_id, "unix_time": time.time()}
+    _write_endpoint(path, doc)
+    hb = _Heartbeat(path, dict(doc),
+                    get_env("MXNET_FLEET_HEARTBEAT", 5.0, float))
+    global _endpoint_file, _heartbeat
+    with _endpoint_lock:
+        old, _heartbeat = _heartbeat, hb
+        old_file, _endpoint_file = _endpoint_file, path
+    if old is not None:
+        old.halt()
+    if old_file and old_file != path:
+        try:
+            os.unlink(old_file)
+        except OSError:
+            pass
+    hb.start()
+    return path
+
+
+def unregister_endpoint():
+    """Stop the heartbeat and remove this process's endpoint file."""
+    global _endpoint_file, _heartbeat
+    with _endpoint_lock:
+        hb, _heartbeat = _heartbeat, None
+        path, _endpoint_file = _endpoint_file, None
+    if hb is not None:
+        hb.halt()
+    if path:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def endpoint_path():
+    with _endpoint_lock:
+        return _endpoint_file
+
+
+def discover(fleet_dir=None, stale_after=None, reap=True, now=None):
+    """Parse every live endpoint file; returns {target_id: endpoint doc}
+    with ``target_id = "<role><rank>"``.  Files whose mtime is older
+    than ``stale_after`` are reaped (unlinked + counted) when ``reap``."""
+    if fleet_dir is None:
+        fleet_dir = get_env("MXNET_FLEET_DIR", None)
+    if stale_after is None:
+        stale_after = get_env("MXNET_FLEET_STALE_AFTER", 30.0, float)
+    now = time.time() if now is None else float(now)
+    out: Dict[str, dict] = {}
+    if not fleet_dir or not os.path.isdir(fleet_dir):
+        return out
+    for name in sorted(os.listdir(fleet_dir)):
+        if not (name.startswith("endpoint_") and name.endswith(".json")):
+            continue
+        path = os.path.join(fleet_dir, name)
+        try:
+            age = now - os.stat(path).st_mtime
+        except OSError:
+            continue  # raced another reaper
+        if age > stale_after:
+            if reap:
+                try:
+                    os.unlink(path)
+                    _REAPED.inc()
+                except OSError:
+                    pass
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            tid = "%s%d" % (doc.get("role", "worker"),
+                            int(doc.get("rank", 0)))
+            doc["id"] = tid
+            out[tid] = doc
+        except (OSError, ValueError, TypeError):
+            continue  # torn write: the next heartbeat repairs it
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scraped-histogram quantiles (the JSON-null overflow convention)
+# ---------------------------------------------------------------------------
+
+def quantile_from_buckets(sample, q):
+    """Client-side mirror of ``Histogram.quantile`` over a scraped
+    snapshot sample (``{"buckets": {bound: cumulative}, "count": n}``).
+
+    Returns 0.0 with no observations and ``None`` when the target falls
+    in the +Inf overflow bucket — the same "off-scale is null, not a
+    number" convention ``/timeseriesz`` uses, so a merged fleet series
+    can never render an off-scale tail as a healthy 0."""
+    try:
+        n = float(sample.get("count") or 0)
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+    if n <= 0:
+        return 0.0
+    bounds = []
+    for bound, cum in (sample.get("buckets") or {}).items():
+        try:
+            b = float(bound)
+        except (TypeError, ValueError):
+            continue  # the "+Inf" key
+        if math.isfinite(b):
+            bounds.append((b, float(cum)))
+    bounds.sort()
+    target = q * n
+    prev_cum, lo = 0.0, 0.0
+    for bound, cum in bounds:
+        if cum >= target:
+            c = cum - prev_cum
+            frac = (target - prev_cum) / c if c else 0.0
+            return lo + (bound - lo) * frac
+        prev_cum, lo = cum, bound
+    return None  # off scale: beyond the top finite bound
+
+
+# ---------------------------------------------------------------------------
+# merged store: rank-labeled multi-resolution rings
+# ---------------------------------------------------------------------------
+
+class FleetStore:
+    """Rank-labeled ring buffers over scraped samples, reusing the
+    timeseries tier machinery (one :class:`timeseries._Series` per
+    ``metric:stat{labels,rank=R}``; counters become windowed rates
+    across scrape ticks, exactly like the in-process sampler)."""
+
+    QUANTILES = (("p50", 0.5), ("p99", 0.99))
+
+    def __init__(self, interval: float,
+                 tiers: Sequence[Tuple[int, int]]
+                 = _timeseries.DEFAULT_TIERS):
+        self.interval = float(interval)
+        self.tier_spec = tuple(tiers)
+        self._lock = threading.Lock()
+        self._series: Dict[str, object] = {}
+
+    def push_rows(self, rows, now):
+        """Append pre-computed rows ``(metric, stat, labels, kind, raw)``
+        where raw is ``("counter", cumulative)`` for rate-derived series
+        or a float/None sample.  Returns the values actually pushed as
+        ``(metric, stat, labels, value)`` (rates resolved)."""
+        out = []
+        with self._lock:
+            for metric, stat, labels, kind, raw in rows:
+                key = _timeseries.series_key(metric, stat, labels)
+                s = self._series.get(key)
+                if s is None:
+                    s = _timeseries._Series(metric, stat, labels, kind,
+                                            self.tier_spec, self.interval)
+                    self._series[key] = s
+                if isinstance(raw, tuple):
+                    value = s.rate.observe(float(raw[1]), now)
+                else:
+                    value = _timeseries._finite_or_none(raw)
+                s.push(now, value)
+                out.append((metric, stat, labels, value))
+        return out
+
+    def ingest(self, rank, metrics, now, prefixes=()):
+        """Merge one scraped ``/allz`` metrics snapshot under the given
+        rank label.  Histogram samples become client-side p50/p99 (None
+        = overflow) plus a count rate; counters become rates; gauges
+        keep their value.  Returns the pushed rows."""
+        rows = []
+        for name in sorted(metrics):
+            if prefixes and not any(name.startswith(p) for p in prefixes):
+                continue
+            fam = metrics[name]
+            kind = fam.get("type", "gauge")
+            for sample in fam.get("samples", ()):
+                labels = dict(sample.get("labels") or {})
+                labels["rank"] = rank
+                if kind == "histogram":
+                    for stat, q in self.QUANTILES:
+                        rows.append((name, stat, labels, kind,
+                                     quantile_from_buckets(sample, q)))
+                    rows.append((name, "rate", labels, kind,
+                                 ("counter",
+                                  float(sample.get("count") or 0))))
+                elif kind == "counter":
+                    rows.append((name, "rate", labels, kind,
+                                 ("counter",
+                                  float(sample.get("value") or 0.0))))
+                else:
+                    rows.append((name, "value", labels, kind,
+                                 sample.get("value")))
+        return self.push_rows(rows, now)
+
+    # -- readers -----------------------------------------------------------
+
+    def snapshot(self, window_seconds=None, prefix=None, now=None):
+        """JSON-able {series_key: {metric, stat, labels, kind, tiers}} —
+        same shape as ``TimeSeriesStore.snapshot`` so the rendering
+        helpers (sparklines, ``render_ascii``) apply unchanged."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            items = sorted(self._series.items())
+        out = {}
+        for key, s in items:
+            if prefix and not s.metric.startswith(prefix):
+                continue
+            out[key] = {"metric": s.metric, "stat": s.stat,
+                        "labels": s.labels, "kind": s.kind,
+                        "tiers": [t.as_dict(window_seconds, now)
+                                  for t in s.tiers]}
+        return out
+
+    def latest(self, metric, stat, rank):
+        """Newest non-None finest-tier value of the exact series
+        ``metric:stat{rank=rank}`` (no other labels), or None."""
+        key = _timeseries.series_key(metric, stat, {"rank": rank})
+        with self._lock:
+            s = self._series.get(key)
+            pts = list(s.tiers[0].points) if s is not None else []
+        for _, v in reversed(pts):
+            if v is not None:
+                return v
+        return None
+
+    def window_stats(self, metric, stat, rank, window, now):
+        """(mean, oldest_t, n) over finite finest-tier points of the
+        exact series ``metric:stat{rank=rank}`` within ``window``."""
+        key = _timeseries.series_key(metric, stat, {"rank": rank})
+        with self._lock:
+            s = self._series.get(key)
+            pts = list(s.tiers[0].points) if s is not None else []
+        cut = now - float(window)
+        vals = [(t, v) for t, v in pts if t >= cut and v is not None]
+        if not vals:
+            return None, None, 0
+        return (sum(v for _, v in vals) / len(vals), vals[0][0], len(vals))
+
+    def ranks_of(self, metric, stat):
+        """Rank labels (excluding the synthetic "fleet" rank) holding
+        the exact series ``metric:stat{rank=R}``."""
+        with self._lock:
+            series = list(self._series.values())
+        out = []
+        for s in series:
+            if (s.metric == metric and s.stat == stat
+                    and set(s.labels) == {"rank"}
+                    and s.labels["rank"] != "fleet"):
+                out.append(s.labels["rank"])
+        return sorted(out)
+
+    def clear(self):
+        with self._lock:
+            self._series.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._series)
+
+
+# ---------------------------------------------------------------------------
+# declarative alert rules
+# ---------------------------------------------------------------------------
+
+_OPS = {">": lambda a, b: a > b, "<": lambda a, b: a < b}
+
+
+class AlertRule:
+    """One declarative alert over a merged fleet (or per-rank) series.
+
+    ``kind``:
+
+    - ``threshold`` — newest value ``op`` threshold;
+    - ``delta`` — short-window mean collapsed below
+      ``(1 - drop_frac) x`` the long-window mean;
+    - ``absence`` — a registered target has not been scraped
+      successfully for ``threshold`` seconds;
+    - ``burn_rate`` — the classic multi-window burn rate: the mean over
+      *both* the short and the long window satisfies ``op`` threshold
+      (the long window needs >= half its span of data, so one hiccup
+      cannot page).
+
+    ``scope`` is ``"fleet"`` (evaluate the synthetic ``rank="fleet"``
+    aggregate series) or ``"rank"`` (evaluate every rank's own series;
+    each rank is its own alert instance and its own offender).
+    ``offender`` names a per-rank derived column (``step_seconds``,
+    ``mfu_pct``, ``hbm_bytes``, ``hbm_frac``) whose argmax picks the
+    rank to blame — and, for page severity, whose flight-recorder dump
+    trigger is POSTed at fire time.  The registered rule set is
+    documented in the GL-checked table in docs/observability.md."""
+
+    def __init__(self, name, kind, severity="warn", metric=None,
+                 stat="value", scope="fleet", op=">", threshold=None,
+                 windows=None, drop_frac=0.5, offender=None, help=""):  # noqa: A002
+        if kind not in ("threshold", "delta", "absence", "burn_rate"):
+            raise ValueError("unknown alert kind %r" % kind)
+        if severity not in _SEVERITIES:
+            raise ValueError("severity must be one of %r" % (_SEVERITIES,))
+        if op not in _OPS:
+            raise ValueError("op must be one of %r" % list(_OPS))
+        if kind != "absence" and not metric:
+            raise ValueError("%s rule needs a metric" % kind)
+        if kind in ("delta", "burn_rate") and not windows:
+            raise ValueError("%s rule needs (short, long) windows" % kind)
+        self.name = name
+        self.kind = kind
+        self.severity = severity
+        self.metric = metric
+        self.stat = stat
+        self.scope = scope
+        self.op = op
+        self.threshold = threshold
+        self.windows = tuple(windows) if windows else None
+        self.drop_frac = float(drop_frac)
+        self.offender = offender
+        self.help = help
+
+    def as_dict(self):
+        return {"name": self.name, "kind": self.kind,
+                "severity": self.severity, "metric": self.metric,
+                "stat": self.stat, "scope": self.scope, "op": self.op,
+                "threshold": self.threshold, "windows": self.windows,
+                "drop_frac": self.drop_frac, "offender": self.offender,
+                "help": self.help}
+
+    def conditions(self, store, now):
+        """Yield ``(group, value, firing)`` per alert instance (absence
+        rules are evaluated by the collector, which owns the target
+        table)."""
+        if self.kind == "absence":
+            return
+        groups = (["fleet"] if self.scope == "fleet"
+                  else store.ranks_of(self.metric, self.stat))
+        op = _OPS[self.op]
+        for group in groups:
+            if self.kind == "threshold":
+                v = store.latest(self.metric, self.stat, group)
+                yield (group, v,
+                       v is not None and op(v, self.threshold))
+            elif self.kind == "delta":
+                short, long_ = self.windows
+                s_mean, _, _ = store.window_stats(
+                    self.metric, self.stat, group, short, now)
+                l_mean, l_old, l_n = store.window_stats(
+                    self.metric, self.stat, group, long_, now)
+                covered = (l_n >= 2 and l_old is not None
+                           and l_old <= now - 0.5 * long_)
+                firing = (covered and s_mean is not None
+                          and l_mean is not None and l_mean > 0
+                          and s_mean < (1.0 - self.drop_frac) * l_mean)
+                ratio = (s_mean / l_mean
+                         if s_mean is not None and l_mean else None)
+                yield (group, ratio, firing)
+            else:  # burn_rate
+                short, long_ = self.windows
+                s_mean, _, s_n = store.window_stats(
+                    self.metric, self.stat, group, short, now)
+                l_mean, l_old, l_n = store.window_stats(
+                    self.metric, self.stat, group, long_, now)
+                covered = (s_n >= 1 and l_n >= 2 and l_old is not None
+                           and l_old <= now - 0.5 * long_)
+                firing = (covered and op(s_mean, self.threshold)
+                          and op(l_mean, self.threshold))
+                yield (group, s_mean, firing)
+
+
+def default_rules():
+    """The built-in rule set (thresholds resolved from the environment
+    at call time; see the rule table in docs/observability.md)."""
+    short = get_env("MXNET_FLEET_BURN_SHORT", 60.0, float)
+    long_ = get_env("MXNET_FLEET_BURN_LONG", 300.0, float)
+    return [
+        AlertRule("straggler_skew_burn", kind="burn_rate", severity="page",
+                  metric="fleet_straggler_skew",
+                  threshold=get_env("MXNET_FLEET_SKEW_THRESHOLD", 1.75,
+                                    float),
+                  windows=(short, long_), offender="step_seconds",
+                  help="sustained straggler: max/median step time above "
+                       "the band over both burn windows"),
+        AlertRule("scrape_absence", kind="absence", severity="warn",
+                  threshold=get_env("MXNET_FLEET_ABSENCE_AFTER", 15.0,
+                                    float),
+                  help="a registered target has not answered a scrape"),
+        AlertRule("fleet_mfu_drop", kind="delta", severity="warn",
+                  metric="fleet_mfu_pct",
+                  drop_frac=get_env("MXNET_FLEET_MFU_DROP", 0.5, float),
+                  windows=(short, long_),
+                  help="fleet MFU collapsed vs its long-window mean"),
+        AlertRule("hbm_pressure", kind="threshold", severity="page",
+                  metric="fleet_hbm_used_frac",
+                  threshold=get_env("MXNET_FLEET_HBM_FRAC", 0.95, float),
+                  offender="hbm_frac",
+                  help="worst rank is close to its HBM limit"),
+    ]
+
+
+_rules_lock = threading.Lock()
+_rules: Dict[str, AlertRule] = {r.name: r for r in default_rules()}
+
+
+def register_rule(rule: AlertRule, replace=False):
+    """Register an alert rule (module-level, like telemetry metrics).
+    Re-registering an existing name requires ``replace=True``."""
+    with _rules_lock:
+        if rule.name in _rules and not replace:
+            raise ValueError("alert rule %r already registered"
+                             % rule.name)
+        _rules[rule.name] = rule
+    return rule
+
+
+def rules() -> List[AlertRule]:
+    with _rules_lock:
+        return list(_rules.values())
+
+
+def reset_rules():
+    """Reinstall the default rule set (re-reading env thresholds)."""
+    fresh = {r.name: r for r in default_rules()}
+    with _rules_lock:
+        _rules.clear()
+        _rules.update(fresh)
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+
+def _post_flight_trigger(endpoint, reason, timeout):
+    """POST the target's /flightz dump trigger; returns the dump path
+    the target reports (its filesystem, not ours)."""
+    url = "http://%s:%d/flightz?reason=%s" % (
+        endpoint.get("host", "127.0.0.1"), int(endpoint["port"]),
+        urllib.parse.quote(str(reason), safe=""))
+    req = urllib.request.Request(url, data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace")).get("path")
+
+
+class FleetCollector(threading.Thread):
+    """Daemon scrape/merge/alert loop over one fleet directory."""
+
+    def __init__(self, fleet_dir=None, interval=None, timeout=None,
+                 stale_after=None, debounce=None, prefixes=None,
+                 window=300.0):
+        super().__init__(name="mxtpu-fleet-collector", daemon=True)
+        if fleet_dir is None:
+            fleet_dir = get_env("MXNET_FLEET_DIR", None)
+        if not fleet_dir:
+            raise ValueError("fleet collector needs a fleet directory "
+                             "(MXNET_FLEET_DIR)")
+        self.fleet_dir = fleet_dir
+        self.interval = float(
+            get_env("MXNET_FLEET_SCRAPE_INTERVAL", 5.0, float)
+            if interval is None else interval)
+        self.timeout = float(
+            get_env("MXNET_FLEET_SCRAPE_TIMEOUT", 2.0, float)
+            if timeout is None else timeout)
+        self.stale_after = float(
+            get_env("MXNET_FLEET_STALE_AFTER", 30.0, float)
+            if stale_after is None else stale_after)
+        self.debounce = float(
+            get_env("MXNET_FLEET_ALERT_DEBOUNCE", 60.0, float)
+            if debounce is None else debounce)
+        raw = (get_env("MXNET_FLEET_METRIC_PREFIXES", _DEFAULT_PREFIXES)
+               if prefixes is None else prefixes)
+        if isinstance(raw, str):
+            self.prefixes = tuple(p for p in raw.split(",") if p)
+        else:
+            self.prefixes = tuple(raw)
+        self.window = float(window)
+        self.store = FleetStore(self.interval)
+        self._lock = threading.Lock()
+        self._targets: Dict[str, dict] = {}
+        self._alert_state: Dict[Tuple[str, str], dict] = {}
+        self._history: collections.deque = collections.deque(maxlen=64)
+        self._last_aggregates: dict = {}
+        self._stop_evt = threading.Event()
+
+    # -- thread ------------------------------------------------------------
+
+    def run(self):
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self.sweep()
+            except Exception:
+                _SCRAPE_ERRS.labels(target="collector").inc()
+
+    def halt(self, timeout: float = 5.0):
+        self._stop_evt.set()
+        self.join(timeout)
+
+    # -- one tick ----------------------------------------------------------
+
+    def _fetch_allz(self, endpoint):
+        url = "http://%s:%d/allz?window=%g" % (
+            endpoint.get("host", "127.0.0.1"), int(endpoint["port"]),
+            max(self.interval * 3.0, 30.0))
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+
+    def sweep(self, now=None):
+        """One scrape/merge/derive/alert tick (also driven directly by
+        tests and the smoke probe)."""
+        now = time.time() if now is None else float(now)
+        endpoints = discover(self.fleet_dir, stale_after=self.stale_after,
+                             reap=True, now=now)
+        _TARGETS.set(len(endpoints))
+        with self._lock:
+            for tid in list(self._targets):
+                if tid not in endpoints:
+                    del self._targets[tid]  # reaped: drop its state
+            for tid, ep in endpoints.items():
+                t = self._targets.get(tid)
+                if t is None:
+                    self._targets[tid] = t = {
+                        "endpoint": ep, "first_seen": now, "last_ok": None,
+                        "consecutive_errors": 0, "skip_until": 0.0,
+                        "healthz": None, "rows": []}
+                else:
+                    t["endpoint"] = ep
+            todo = [(tid, dict(t["endpoint"]))
+                    for tid, t in sorted(self._targets.items())
+                    if now >= t["skip_until"]]
+        for tid, ep in todo:
+            t0 = time.time()
+            try:
+                doc = self._fetch_allz(ep)
+                rows = self.store.ingest(tid, doc.get("metrics") or {},
+                                         now, self.prefixes)
+            except Exception:
+                _SCRAPE_ERRS.labels(target=tid).inc()
+                with self._lock:
+                    t = self._targets.get(tid)
+                    if t is not None:
+                        t["consecutive_errors"] += 1
+                        # exponential backoff in whole ticks, capped
+                        skip = min(2 ** (t["consecutive_errors"] - 1), 8)
+                        t["skip_until"] = now + self.interval * (skip - 1)
+                continue
+            _SCRAPES.labels(target=tid).inc()
+            _SCRAPE_TIME.labels(target=tid).observe(time.time() - t0)
+            with self._lock:
+                t = self._targets.get(tid)
+                if t is not None:
+                    t["last_ok"] = now
+                    t["consecutive_errors"] = 0
+                    t["skip_until"] = 0.0
+                    t["healthz"] = doc.get("healthz")
+                    t["rows"] = rows
+        per_rank = self._derive(now)
+        self._evaluate(per_rank, now)
+        return per_rank
+
+    # -- derived fleet aggregates ------------------------------------------
+
+    def _derive(self, now):
+        with self._lock:
+            snap = {tid: {"rows": list(t["rows"]), "last_ok": t["last_ok"],
+                          "role": t["endpoint"].get("role", "worker"),
+                          "healthz": t["healthz"]}
+                    for tid, t in self._targets.items()}
+        per_rank: Dict[str, dict] = {}
+        owners: Dict[str, float] = {}
+        models: Dict[str, dict] = {}
+        p99s: List[Optional[float]] = []
+        for tid, t in sorted(snap.items()):
+            if t["last_ok"] is None or now - t["last_ok"] > self.stale_after:
+                continue
+            hz = t["healthz"] or {}
+            pr = {"role": t["role"], "step_seconds": None, "mfu_pct": None,
+                  "hbm_bytes": 0.0, "hbm_limit": 0.0, "hbm_frac": None,
+                  "verdict": hz.get("cause"), "status": hz.get("status")}
+            for metric, stat, labels, value in t["rows"]:
+                if metric == "serving_request_seconds" and stat == "p99":
+                    p99s.append(value)  # None = off-scale tail
+                    continue
+                if value is None:
+                    continue
+                if metric == "step_seconds_ewma" and stat == "value":
+                    pr["step_seconds"] = value
+                elif metric == "step_mfu_pct" and stat == "value":
+                    pr["mfu_pct"] = value
+                elif metric == "device_bytes_in_use" and stat == "value":
+                    pr["hbm_bytes"] += value
+                elif metric == "device_bytes_limit" and stat == "value":
+                    pr["hbm_limit"] += value
+                elif metric == "memwatch_owner_bytes" and stat == "value":
+                    owner = labels.get("owner", "?")
+                    owners[owner] = owners.get(owner, 0.0) + value
+                elif (metric == "serving_model_requests_total"
+                      and stat == "rate" and value > 0):
+                    m = models.setdefault(labels.get("model", "?"),
+                                          {"qps": 0.0, "shed_rate": 0.0})
+                    if labels.get("outcome") == "ok":
+                        m["qps"] += value
+                    elif labels.get("outcome") == "rejected":
+                        m["shed_rate"] += value
+            if pr["hbm_limit"] > 0:
+                pr["hbm_frac"] = pr["hbm_bytes"] / pr["hbm_limit"]
+            per_rank[tid] = pr
+
+        steps = [pr["step_seconds"] for pr in per_rank.values()
+                 if pr["step_seconds"]]
+        step_rate = sum(1.0 / s for s in steps) if steps else None
+        skew = None
+        if len(steps) >= 2:
+            med = _median(steps)
+            if med > 0:
+                skew = max(steps) / med
+        mfus = [pr["mfu_pct"] for pr in per_rank.values()
+                if pr["mfu_pct"] is not None]
+        mfu = sum(mfus) / len(mfus) if mfus else None
+        fracs = [pr["hbm_frac"] for pr in per_rank.values()
+                 if pr["hbm_frac"] is not None]
+        hbm_frac = max(fracs) if fracs else None
+        p99 = None
+        if p99s:
+            p99 = None if any(v is None for v in p99s) else max(p99s)
+
+        # the synthetic rank="fleet" series the rules + dashboard read
+        fleet_rows = [
+            ("fleet_step_rate", "value", {"rank": "fleet"}, "gauge",
+             step_rate),
+            ("fleet_mfu_pct", "value", {"rank": "fleet"}, "gauge", mfu),
+            ("fleet_straggler_skew", "value", {"rank": "fleet"}, "gauge",
+             skew),
+            ("fleet_hbm_used_frac", "value", {"rank": "fleet"}, "gauge",
+             hbm_frac),
+        ]
+        if p99s:
+            fleet_rows.append(("fleet_serving_p99_seconds", "p99",
+                               {"rank": "fleet"}, "gauge", p99))
+        self.store.push_rows(fleet_rows, now)
+
+        # local gauges (served on this process's /metrics)
+        if step_rate is not None:
+            _STEP_RATE.set(step_rate)
+        if mfu is not None:
+            _FLEET_MFU.set(mfu)
+        if skew is not None:
+            _SKEW.set(skew)
+        if hbm_frac is not None:
+            _HBM_FRAC.set(hbm_frac)
+        if p99s:
+            _SERVING_P99.set(float("nan") if p99 is None else p99)
+        for owner, b in owners.items():
+            _HBM_OWNER.labels(owner=owner).set(b)
+        for tid, pr in per_rank.items():
+            _RANK_HBM.labels(rank=tid).set(pr["hbm_bytes"])
+        for m, d in models.items():
+            _MODEL_QPS.labels(model=m).set(d["qps"])
+            _MODEL_SHED.labels(model=m).set(d["shed_rate"])
+
+        aggregates = {"step_rate": step_rate, "mfu_pct": mfu,
+                      "straggler_skew": skew, "hbm_used_frac": hbm_frac,
+                      "hbm_owner_bytes": owners,
+                      "serving_p99_seconds": p99,
+                      "serving_p99_off_scale": bool(p99s) and p99 is None,
+                      "models": models, "per_rank": per_rank}
+        with self._lock:
+            self._last_aggregates = aggregates
+        return per_rank
+
+    # -- alert evaluation --------------------------------------------------
+
+    def _evaluate(self, per_rank, now):
+        fires, resolves = [], []
+        for rule in rules():
+            if rule.kind == "absence":
+                with self._lock:
+                    conds = [(tid,
+                              now - (t["last_ok"] or t["first_seen"]),
+                              (now - (t["last_ok"] or t["first_seen"]))
+                              > rule.threshold)
+                             for tid, t in sorted(self._targets.items())]
+            else:
+                conds = list(rule.conditions(self.store, now))
+            for group, value, firing in conds:
+                key = (rule.name, group)
+                with self._lock:
+                    st = self._alert_state.setdefault(
+                        key, {"firing": False, "last_fire": 0.0,
+                              "value": None, "severity": rule.severity})
+                    st["value"] = value
+                    if (firing and not st["firing"]
+                            and now - st["last_fire"] >= self.debounce):
+                        st["firing"] = True
+                        st["last_fire"] = now
+                        fires.append((rule, group, value))
+                    elif not firing and st["firing"]:
+                        st["firing"] = False
+                        resolves.append((rule, group))
+        # actions run with no collector lock held (HTTP + runlog I/O)
+        for rule, group, value in fires:
+            self._fire(rule, group, value, per_rank, now)
+        for rule, group in resolves:
+            self._resolve(rule, group)
+        active = {sev: 0 for sev in _SEVERITIES}
+        with self._lock:
+            for st in self._alert_state.values():
+                if st["firing"]:
+                    active[st.get("severity", "warn")] += 1
+        for sev in _SEVERITIES:
+            _ALERTS_ACTIVE.labels(severity=sev).set(active[sev])
+
+    def _fire(self, rule, group, value, per_rank, now):
+        _ALERTS_TOTAL.labels(rule=rule.name, severity=rule.severity).inc()
+        if rule.scope == "rank" or rule.kind == "absence":
+            offender = group
+        elif rule.offender:
+            best = None
+            for tid, pr in per_rank.items():
+                v = pr.get(rule.offender)
+                if v is not None and (best is None or v > best[1]):
+                    best = (tid, v)
+            offender = best[0] if best else None
+        else:
+            offender = None
+        dump_path = None
+        if (rule.severity == "page" and offender
+                and rule.kind != "absence"):
+            with self._lock:
+                t = self._targets.get(offender)
+                ep = dict(t["endpoint"]) if t else None
+            if ep:
+                try:
+                    dump_path = _post_flight_trigger(
+                        ep, "fleet_alert." + rule.name, self.timeout)
+                except Exception:
+                    dump_path = None  # the page still goes out
+        rec = {"rule": rule.name, "severity": rule.severity,
+               "kind": rule.kind, "group": group, "value": value,
+               "threshold": rule.threshold, "offender": offender,
+               "flight_dump": dump_path, "unix_time": now}
+        with self._lock:
+            self._history.append(rec)
+        try:
+            from .. import runlog as _runlog
+            _runlog.event("fleet_alert", rule=rule.name,
+                          severity=rule.severity, group=group, value=value,
+                          threshold=rule.threshold, offender=offender,
+                          flight_dump=dump_path)
+        except Exception:
+            pass
+
+    def _resolve(self, rule, group):
+        try:
+            from .. import runlog as _runlog
+            _runlog.event("fleet_alert_resolved", rule=rule.name,
+                          group=group)
+        except Exception:
+            pass
+
+    # -- readers -----------------------------------------------------------
+
+    def active_alerts(self):
+        with self._lock:
+            state = {k: dict(st) for k, st in self._alert_state.items()}
+        return [{"rule": name, "group": group,
+                 "severity": st.get("severity", "warn"),
+                 "value": st["value"], "since": st["last_fire"]}
+                for (name, group), st in sorted(state.items())
+                if st["firing"]]
+
+    def fleetz_doc(self, window=None, now=None):
+        """The merged fleet view served on /fleetz (and consumed by
+        tools/fleetwatch.py)."""
+        now = time.time() if now is None else float(now)
+        window = self.window if window is None else float(window)
+        with self._lock:
+            targets = {}
+            for tid, t in sorted(self._targets.items()):
+                ep = t["endpoint"]
+                targets[tid] = {
+                    "rank": ep.get("rank"), "role": ep.get("role"),
+                    "pid": ep.get("pid"), "host": ep.get("host"),
+                    "port": ep.get("port"), "run_id": ep.get("run_id"),
+                    "last_ok_age_seconds":
+                        (now - t["last_ok"]) if t["last_ok"] else None,
+                    "consecutive_errors": t["consecutive_errors"],
+                    "healthz": t["healthz"]}
+            aggregates = dict(self._last_aggregates)
+            recent = list(self._history)
+        return {"unix_time": now, "interval": self.interval,
+                "fleet_dir": self.fleet_dir, "targets": targets,
+                "aggregates": aggregates,
+                "alerts": {"active": self.active_alerts(),
+                           "recent": recent},
+                "rules": [r.as_dict() for r in rules()],
+                "series": self.store.snapshot(window_seconds=window,
+                                              now=now)}
+
+    def flight_block(self, now=None):
+        """Bounded fleet context for this process's flight dumps: the
+        target table, derived aggregates and alert state — no ring
+        history (the per-rank evidence lives in the offending rank's
+        own dump)."""
+        doc = self.fleetz_doc(window=0.0, now=now)
+        doc.pop("series", None)
+        doc.pop("rules", None)
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# module singleton
+# ---------------------------------------------------------------------------
+
+_collector: Optional[FleetCollector] = None
+_collector_lock = threading.Lock()
+
+
+def start_collector(fleet_dir=None, interval=None, **kwargs):
+    """Start (or return the already-running) fleet collector daemon."""
+    global _collector
+    with _collector_lock:
+        if _collector is not None and _collector.is_alive():
+            return _collector
+        c = FleetCollector(fleet_dir=fleet_dir, interval=interval,
+                           **kwargs)
+        _collector = c
+    c.start()
+    return c
+
+
+def stop_collector():
+    """Stop the collector thread (merged rings are dropped with it)."""
+    global _collector
+    with _collector_lock:
+        c, _collector = _collector, None
+    if c is not None:
+        c.halt()
+
+
+def running() -> bool:
+    with _collector_lock:
+        return _collector is not None and _collector.is_alive()
+
+
+def collector() -> Optional[FleetCollector]:
+    with _collector_lock:
+        return _collector
+
+
+def fleetz(window=None):
+    """The merged fleet view, or None when no collector is running."""
+    c = collector()
+    return c.fleetz_doc(window=window) if c is not None else None
+
+
+def flight_block():
+    """Fleet block for flight dumps (None when not collecting)."""
+    c = collector()
+    return c.flight_block() if c is not None else None
+
+
+def reset():
+    """Test isolation: stop the collector, drop the endpoint
+    registration and reinstall the default rules."""
+    stop_collector()
+    unregister_endpoint()
+    reset_rules()

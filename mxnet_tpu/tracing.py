@@ -403,6 +403,18 @@ class FlightRecorder:
                         doc["timeseries"] = tsdoc
                 except Exception:
                     pass
+                # fleet context: when this process runs the fleet
+                # collector, its dump carries the merged target table,
+                # derived aggregates and alert state (per-rank evidence
+                # lives in the offending rank's own dump).
+                try:
+                    from .telemetry import fleet as _fleet
+                    if _fleet.running():
+                        blk = _fleet.flight_block()
+                        if blk:
+                            doc["fleet"] = blk
+                except Exception:
+                    pass
                 # memory forensics: the owner-tagged ledger, the leak
                 # suspects table and the last registered program's
                 # footprint (the oom_risk / reason=oom evidence).

@@ -1,0 +1,81 @@
+"""dist_async worker for the fleet acceptance test: every process
+(2 workers + 1 kvstore server) starts a telemetry endpoint on a free
+port and registers it in ``MXNET_FLEET_DIR``; each worker seeds a
+synthetic steady step time (rank 1 is 20x slower — skew max/median
+= 0.2/0.105 ~ 1.9, past the 1.75 straggler band), then idles until the
+test's collector — running in the pytest process — has scraped both
+ranks and fired the straggler-skew burn-rate alert (the test drops a
+``stop`` sentinel into the fleet dir when it is done watching).
+
+The flight-recorder path is set per rank *inside* this script (the
+launcher's env_extra is shared across ranks), so the collector's
+page-severity POST lands the dump in ``flight_<role><rank>.json`` and
+the test can assert it was captured on the offending rank only.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_ROLE = os.environ.get("DMLC_ROLE", "worker") or "worker"
+_RANK = os.environ.get("DMLC_WORKER_ID", "0") or "0"
+_FLEET_DIR = os.environ["MXNET_FLEET_DIR"]
+os.environ["MXNET_FLIGHT_RECORDER_PATH"] = os.path.join(
+    _FLEET_DIR, "flight_%s%s.json" % (_ROLE, _RANK))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import health, nd, telemetry
+from mxnet_tpu.telemetry import fleet
+
+
+def _wait_for_stop(timeout=90.0):
+    stop = os.path.join(_FLEET_DIR, "stop")
+    deadline = time.time() + timeout
+    while time.time() < deadline and not os.path.exists(stop):
+        time.sleep(0.2)
+
+
+def main():
+    assert telemetry.enabled, "worker must run with MXNET_TELEMETRY=1"
+    assert health.enabled, "worker must run with MXNET_HEALTH=1"
+    assert fleet.endpoint_path(), "endpoint must be registered at import"
+    # create() first: in a DMLC_ROLE=server process this enters the
+    # server loop and never returns (its endpoint keeps serving /allz
+    # from the telemetry daemon thread meanwhile)
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2
+
+    step_s = 0.01 if rank == 0 else 0.2
+    kv.init("w", nd.zeros((4, 2)))
+    kv.barrier()
+    for step in range(10):
+        # synthetic closed window: constant dt keeps the EWMA exact
+        health.monitor.observe_step(step_s)
+        kv.push("w", nd.array(np.full((4, 2), rank + step, np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+    kv.barrier()
+
+    # stay alive (and scrapeable) until the test has seen the alert
+    _wait_for_stop()
+
+    if rank == 0:
+        kv.send_command_to_servers(0, "")   # kStopServer
+    kv.close()
+    print("rank %d served fleet scrape with step_seconds=%s"
+          % (rank, step_s))
+    if rank == 0:
+        time.sleep(0.5)  # let the server wind down before cleanup
+
+
+if __name__ == "__main__":
+    main()

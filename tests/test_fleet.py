@@ -1,0 +1,436 @@
+"""Fleet control plane (mxnet_tpu/telemetry/fleet.py + tools/fleetwatch.py).
+
+Covers the endpoint-file discovery protocol (register / heartbeat /
+stale-reap / torn writes), the client-side histogram-quantile mirror and
+its off-scale-is-null overflow round trip, the consolidated ``/allz`` +
+``/healthz`` + ``/fleetz`` + ``POST /flightz`` HTTP surface, the
+scrape/merge/derive/alert collector tick (fire-once debounce, resolve,
+absence, burn-rate coverage gate, page-severity flight-dump capture),
+the fleetwatch renderer, and the 2-process dist acceptance run: two
+workers + one kvstore server register in one fleet dir, the collector
+in *this* process scrapes and merges them, and the injected straggler
+fires the burn-rate page end-to-end (runlog event + flight dump on the
+offending rank only).
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from mxnet_tpu import runlog, telemetry, tracing
+from mxnet_tpu.telemetry import fleet, timeseries
+from mxnet_tpu.telemetry.fleet import AlertRule, FleetStore
+
+import fleetwatch
+import merge_traces
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    fleet.reset()
+    yield
+    fleet.reset()
+    runlog.disable()
+    telemetry.stop_http_server()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _get_json(port, path):
+    url = "http://127.0.0.1:%d%s" % (port, path)
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# histogram-quantile overflow: null must survive the scrape round trip
+# ---------------------------------------------------------------------------
+class TestQuantileOverflow:
+    def test_overflow_round_trip_renders_gtmax(self):
+        h = telemetry.histogram("fleet_test_latency_seconds", "t")
+        h.observe(0.005)
+        h.observe(1e9)  # beyond the largest finite bucket
+        assert h.quantile(0.99) == float("inf")
+        # scrape -> JSON -> parse, exactly what the collector sees
+        snap = json.loads(telemetry.snapshot_json())
+        sample = snap["fleet_test_latency_seconds"]["samples"][0]
+        # off-scale is null, never 0 (0 would read as "instant")
+        assert fleet.quantile_from_buckets(sample, 0.99) is None
+        p50 = fleet.quantile_from_buckets(sample, 0.5)
+        assert p50 == pytest.approx(h.quantile(0.5))
+        assert p50 > 0.0
+        # the dashboard renders the null as >max, not a number
+        assert fleetwatch._fmt_val(None, "p99") == ">max"
+        assert fleetwatch._fmt_val(None, "p50") == ">max"
+        assert fleetwatch._fmt_val(None, "value") == "-"
+
+    def test_overflow_null_survives_store_snapshot(self):
+        store = FleetStore(interval=0.5)
+        now = time.time()
+        store.push_rows([("serving_request_seconds", "p99",
+                          {"rank": "worker0"}, "histogram", None)], now)
+        key = timeseries.series_key("serving_request_seconds", "p99",
+                                    {"rank": "worker0"})
+        snap = json.loads(json.dumps(store.snapshot(window_seconds=30.0,
+                                                    now=now)))
+        pts = snap[key]["tiers"][0]["points"]
+        assert pts and pts[-1][1] is None  # JSON null, not 0
+
+    def test_edge_cases(self):
+        assert fleet.quantile_from_buckets(
+            {"buckets": {"+Inf": 0}, "count": 0}, 0.99) == 0.0
+        # every observation in the overflow bucket
+        assert fleet.quantile_from_buckets(
+            {"buckets": {"0.5": 0, "+Inf": 3}, "count": 3}, 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# endpoint files: register / discover / heartbeat / reap
+# ---------------------------------------------------------------------------
+class TestEndpointDiscovery:
+    def test_register_discover_unregister(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DMLC_ROLE", raising=False)
+        monkeypatch.delenv("DMLC_WORKER_ID", raising=False)
+        path = fleet.register_endpoint(12345, fleet_dir=str(tmp_path))
+        assert path and os.path.exists(path)
+        found = fleet.discover(str(tmp_path))
+        assert set(found) == {"worker0"}
+        assert found["worker0"]["port"] == 12345
+        assert found["worker0"]["pid"] == os.getpid()
+        # idempotent: re-registering replaces the announcement
+        path2 = fleet.register_endpoint(23456, fleet_dir=str(tmp_path))
+        assert fleet.discover(str(tmp_path))["worker0"]["port"] == 23456
+        fleet.unregister_endpoint()
+        assert not os.path.exists(path2)
+        assert fleet.discover(str(tmp_path)) == {}
+
+    def test_stale_endpoint_reaped(self, tmp_path):
+        p = str(tmp_path / "endpoint_worker7_1.json")
+        with open(p, "w") as f:
+            json.dump({"rank": 7, "role": "worker", "pid": 1,
+                       "host": "127.0.0.1", "port": 1, "run_id": "",
+                       "unix_time": 0.0}, f)
+        old = time.time() - 120.0
+        os.utime(p, (old, old))
+        before = telemetry.value("fleet_reaped_endpoints_total")
+        assert fleet.discover(str(tmp_path), stale_after=30.0) == {}
+        assert not os.path.exists(p)
+        assert telemetry.value("fleet_reaped_endpoints_total") == before + 1
+
+    def test_torn_write_tolerated(self, tmp_path):
+        with open(str(tmp_path / "endpoint_worker0_1.json"), "w") as f:
+            f.write("{not json")
+        assert fleet.discover(str(tmp_path), stale_after=30.0) == {}
+
+    def test_heartbeat_keeps_mtime_fresh(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXNET_FLEET_HEARTBEAT", "0.05")
+        path = fleet.register_endpoint(1, fleet_dir=str(tmp_path))
+        old = time.time() - 120.0
+        os.utime(path, (old, old))
+        deadline = time.time() + 5.0
+        while (os.stat(path).st_mtime < time.time() - 60.0
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert os.stat(path).st_mtime > time.time() - 60.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /allz, /healthz, /fleetz, POST /flightz
+# ---------------------------------------------------------------------------
+class TestHttpEndpoints:
+    def test_allz_and_healthz(self):
+        telemetry.gauge("step_seconds_ewma", "t").set(0.05)
+        port = telemetry.start_http_server(0)
+        doc = _get_json(port, "/allz?window=5")
+        assert "unix_time" in doc and "healthz" in doc
+        ewma = doc["metrics"]["step_seconds_ewma"]["samples"][0]
+        assert ewma["value"] == pytest.approx(0.05)
+        hz = _get_json(port, "/healthz")
+        assert hz["status"] in ("ok", "degraded")
+
+    def test_fleetz_404_without_collector(self, tmp_path):
+        port = telemetry.start_http_server(0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(port, "/fleetz")
+        assert ei.value.code == 404
+        fleet.start_collector(fleet_dir=str(tmp_path), interval=5.0)
+        doc = _get_json(port, "/fleetz?window=30")
+        assert doc["fleet_dir"] == str(tmp_path)
+        assert "aggregates" in doc and "alerts" in doc
+
+    def test_flightz_post_triggers_dump(self, tmp_path, monkeypatch):
+        dump = str(tmp_path / "flight.json")
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH", dump)
+        port = telemetry.start_http_server(0)
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/flightz?reason=unit%%20page!" % port,
+            data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        assert body["path"] == dump
+        doc = json.load(open(dump))
+        assert doc["reason"] == "unit_page_"  # shell-unsafe chars scrubbed
+        assert merge_traces.is_flight_dump(doc)
+        assert merge_traces.validate_flight_dump(doc) == []
+
+    def test_collector_dump_embeds_fleet_block(self, tmp_path, monkeypatch):
+        dump = str(tmp_path / "flight_collector.json")
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH", dump)
+        port = telemetry.start_http_server(0)
+        fleet.register_endpoint(port, fleet_dir=str(tmp_path))
+        c = fleet.start_collector(fleet_dir=str(tmp_path), interval=5.0)
+        c.sweep()
+        path = tracing.flight.dump(reason="manual")
+        doc = json.load(open(path))
+        assert "fleet" in doc
+        assert set(doc["fleet"]["targets"])  # our own endpoint, merged
+        assert merge_traces.validate_flight_dump(doc) == []
+
+
+# ---------------------------------------------------------------------------
+# collector tick: merge, derive, alert state machine
+# ---------------------------------------------------------------------------
+class TestCollectorAlerting:
+    def test_fire_once_debounce_resolve(self, tmp_path, monkeypatch):
+        dump = str(tmp_path / "flight_self.json")
+        monkeypatch.setenv("MXNET_FLIGHT_RECORDER_PATH", dump)
+        rl = str(tmp_path / "runlog.jsonl")
+        runlog.enable(rl)
+        g = telemetry.gauge("step_seconds_ewma", "t")
+        g.set(0.05)  # fleet step rate 20/s
+        port = telemetry.start_http_server(0)
+        fleet.register_endpoint(port, fleet_dir=str(tmp_path))
+        fleet.register_rule(AlertRule(
+            "t_slow_fleet", kind="threshold", severity="page",
+            metric="fleet_step_rate", op="<", threshold=100.0,
+            offender="step_seconds", help="unit-test rule"), replace=True)
+        c = fleet.FleetCollector(fleet_dir=str(tmp_path), interval=0.2,
+                                 debounce=60.0)
+        now = time.time()
+
+        def fired():
+            return telemetry.value("fleet_alerts_total",
+                                   rule="t_slow_fleet", severity="page")
+
+        c.sweep(now)
+        assert fired() == 1
+        # the scrape merged rank-attributed and counted itself
+        assert c.store.latest("step_seconds_ewma", "value",
+                              "worker0") == pytest.approx(0.05)
+        assert telemetry.value("fleet_scrape_total", target="worker0") == 1
+        assert telemetry.value("fleet_alerts_active", severity="page") == 1
+        # page severity POSTed the offender's flight-dump trigger
+        assert os.path.exists(dump)
+        # still firing on the next tick: edge-triggered, no refire
+        c.sweep(now + 0.2)
+        assert fired() == 1
+        # condition clears -> resolve
+        g.set(0.001)
+        c.sweep(now + 0.4)
+        assert not any(a["rule"] == "t_slow_fleet"
+                       for a in c.active_alerts())
+        assert telemetry.value("fleet_alerts_active", severity="page") == 0
+        # condition back inside the debounce window -> still no refire
+        g.set(0.05)
+        c.sweep(now + 0.6)
+        assert fired() == 1
+        # ... and past the window it pages again
+        c.sweep(now + 61.0)
+        assert fired() == 2
+        events = [json.loads(line) for line in open(rl) if line.strip()]
+        alerts = [e for e in events if e["event"] == "fleet_alert"
+                  and e["rule"] == "t_slow_fleet"]
+        resolved = [e for e in events if e["event"] == "fleet_alert_resolved"
+                    and e["rule"] == "t_slow_fleet"]
+        assert len(alerts) == 2 and len(resolved) == 1
+        assert alerts[0]["offender"] == "worker0"
+        assert alerts[0]["flight_dump"] == dump
+
+    def test_absence_fires_for_dead_target(self, tmp_path):
+        fleet.register_rule(AlertRule("t_absent", kind="absence",
+                                      severity="warn", threshold=0.5),
+                            replace=True)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        with open(str(tmp_path / "endpoint_worker3_99.json"), "w") as f:
+            json.dump({"rank": 3, "role": "worker", "pid": 99,
+                       "host": "127.0.0.1", "port": dead_port,
+                       "run_id": "", "unix_time": time.time()}, f)
+        c = fleet.FleetCollector(fleet_dir=str(tmp_path), interval=0.2,
+                                 timeout=0.5, debounce=60.0)
+        now = time.time()
+        c.sweep(now)
+        assert telemetry.value("fleet_scrape_errors_total",
+                               target="worker3") >= 1
+        assert telemetry.value("fleet_alerts_total", rule="t_absent",
+                               severity="warn") == 0
+        c.sweep(now + 1.0)  # never scraped for 1.0s > 0.5s threshold
+        assert telemetry.value("fleet_alerts_total", rule="t_absent",
+                               severity="warn") == 1
+        assert any(a["rule"] == "t_absent" and a["group"] == "worker3"
+                   for a in c.active_alerts())
+
+    def test_burn_rate_needs_long_window_coverage(self):
+        rule = AlertRule("t_burn", kind="burn_rate", severity="page",
+                         metric="fleet_straggler_skew", threshold=1.75,
+                         windows=(2.0, 4.0))
+        row = ("fleet_straggler_skew", "value", {"rank": "fleet"},
+               "gauge", 1.9)
+        t0 = time.time()
+        # one hot sample: above threshold but no long-window coverage
+        store = FleetStore(interval=0.5)
+        store.push_rows([row], t0 - 0.1)
+        (_, _, firing), = rule.conditions(store, t0)
+        assert not firing
+        # 4s of sustained skew: both windows above the band -> fires
+        store = FleetStore(interval=0.5)
+        for i in range(9):
+            store.push_rows([row], t0 - 4.0 + i * 0.5)
+        (_, value, firing), = rule.conditions(store, t0)
+        assert firing and value == pytest.approx(1.9)
+        # skew recovers: the short window drops below -> stops firing
+        calm = ("fleet_straggler_skew", "value", {"rank": "fleet"},
+                "gauge", 1.0)
+        for i in range(3):
+            store.push_rows([calm], t0 + 0.5 + i * 1.0)
+        (_, _, firing), = rule.conditions(store, t0 + 2.5)
+        assert not firing
+
+    def test_rule_registry_guards(self):
+        with pytest.raises(ValueError):
+            AlertRule("bad", kind="nope")
+        with pytest.raises(ValueError):
+            AlertRule("bad", kind="threshold", metric="m", severity="loud")
+        with pytest.raises(ValueError):
+            AlertRule("bad", kind="burn_rate", metric="m")  # no windows
+        with pytest.raises(ValueError):  # duplicate without replace=
+            fleet.register_rule(AlertRule(
+                "straggler_skew_burn", kind="threshold", metric="m",
+                threshold=1.0))
+        assert {r.name for r in fleet.rules()} >= {
+            "straggler_skew_burn", "scrape_absence", "fleet_mfu_drop",
+            "hbm_pressure"}
+
+
+# ---------------------------------------------------------------------------
+# fleetwatch rendering
+# ---------------------------------------------------------------------------
+class TestFleetwatch:
+    def test_render_live_doc(self, tmp_path):
+        telemetry.gauge("step_seconds_ewma", "t").set(0.05)
+        port = telemetry.start_http_server(0)
+        fleet.register_endpoint(port, fleet_dir=str(tmp_path))
+        c = fleet.start_collector(fleet_dir=str(tmp_path), interval=5.0)
+        c.sweep()
+        out = fleetwatch.render(fleet.fleetz(window=30.0))
+        assert "worker0" in out and "targets=1" in out
+        # the same doc survives a JSON round trip (what --format json and
+        # --snapshot/--diff consume)
+        out2 = fleetwatch.render(json.loads(json.dumps(
+            fleet.fleetz(window=30.0))))
+        assert "worker0" in out2
+
+
+# ---------------------------------------------------------------------------
+# 2-process fleet acceptance: workers + kvstore server, end-to-end page
+# ---------------------------------------------------------------------------
+class TestDistFleet:
+    def test_two_worker_fleet_straggler_page(self, tmp_path, monkeypatch):
+        import launch
+
+        fleet_dir = str(tmp_path / "fleet")
+        os.makedirs(fleet_dir)
+        rl = str(tmp_path / "runlog.jsonl")
+        runlog.enable(rl)
+        # shrink the burn windows so sustained == a few seconds
+        monkeypatch.setenv("MXNET_FLEET_BURN_SHORT", "1.5")
+        monkeypatch.setenv("MXNET_FLEET_BURN_LONG", "3.0")
+        fleet.reset_rules()
+
+        worker = os.path.join(REPO, "tests", "fleet_worker.py")
+        rc_box = {}
+
+        def _run():
+            rc_box["rc"] = launch.launch_local(
+                2, [sys.executable, worker],
+                env_extra={"JAX_PLATFORMS": "cpu",
+                           "MXNET_TEST_PLATFORM": "cpu",
+                           "MXNET_TELEMETRY": "1",
+                           "MXNET_TELEMETRY_PORT": "0",
+                           "MXNET_TELEMETRY_TS": "0",
+                           "MXNET_HEALTH": "1",
+                           "MXNET_FLEET_DIR": fleet_dir},
+                num_servers=1)
+
+        job = threading.Thread(target=_run, daemon=True)
+        job.start()
+        try:
+            fleet.start_collector(fleet_dir=fleet_dir, interval=0.3,
+                                  debounce=60.0)
+            port = telemetry.start_http_server(0)
+
+            def fired():
+                return telemetry.value("fleet_alerts_total",
+                                       rule="straggler_skew_burn",
+                                       severity="page")
+
+            deadline = time.time() + 120.0
+            while fired() < 1 and time.time() < deadline:
+                time.sleep(0.3)
+            assert fired() == 1, "straggler burn-rate page never fired"
+
+            # merged view over HTTP: every process, rank-attributed
+            doc = _get_json(port, "/fleetz?window=60")
+            assert set(doc["targets"]) == {"worker0", "worker1", "server0"}
+            for rank in ("worker0", "worker1"):
+                key = timeseries.series_key("step_seconds_ewma", "value",
+                                            {"rank": rank})
+                assert key in doc["series"], sorted(doc["series"])
+            # skew = slow/median = 0.2 / median([0.01, 0.2])
+            assert doc["aggregates"]["straggler_skew"] == pytest.approx(
+                0.2 / 0.105, rel=0.05)
+            assert doc["aggregates"]["per_rank"]["worker1"][
+                "step_seconds"] == pytest.approx(0.2, rel=0.05)
+
+            # exactly once: the condition persists but debounce holds
+            time.sleep(1.2)
+            assert fired() == 1
+
+            # the page POSTed the offending rank's flight-dump trigger
+            dump = os.path.join(fleet_dir, "flight_worker1.json")
+            deadline = time.time() + 15.0
+            while not os.path.exists(dump) and time.time() < deadline:
+                time.sleep(0.1)
+            assert os.path.exists(dump), "offender flight dump missing"
+            assert not os.path.exists(
+                os.path.join(fleet_dir, "flight_worker0.json"))
+            dumped = json.load(open(dump))
+            assert dumped["reason"] == "fleet_alert.straggler_skew_burn"
+            assert merge_traces.validate_flight_dump(dumped) == []
+
+            events = [json.loads(line) for line in open(rl)
+                      if line.strip()]
+            alerts = [e for e in events if e["event"] == "fleet_alert"
+                      and e["rule"] == "straggler_skew_burn"]
+            assert len(alerts) == 1
+            assert alerts[0]["offender"] == "worker1"
+            assert alerts[0]["severity"] == "page"
+            assert alerts[0]["flight_dump"] == dump
+        finally:
+            open(os.path.join(fleet_dir, "stop"), "w").close()
+            job.join(120.0)
+        assert not job.is_alive(), "dist job did not wind down"
+        assert rc_box.get("rc") == 0

@@ -1,0 +1,362 @@
+#!/usr/bin/env python
+"""Fleet dashboard CLI: live view, snapshot, diff and smoke-check the
+fleet control plane (mxnet_tpu/telemetry/fleet.py).
+
+Modes:
+
+live (default)
+    Render the merged fleet view as an ASCII dashboard — one row per
+    rank (step rate, MFU, HBM, health verdict, active alerts) plus
+    fleet-aggregate sparklines from the merged multi-resolution tiers.
+    ``--url`` points at a running collector's ``/fleetz``; with
+    ``--fleet-dir`` (or ``MXNET_FLEET_DIR``) an *embedded* collector is
+    started instead, so the dashboard works with no extra process.
+    ``--watch SECS`` refreshes in place; default renders once.
+
+``--snapshot [FILE]``
+    Save the raw ``/fleetz`` JSON (``-`` = stdout) for a later
+    ``--diff``.
+
+``--diff A B``
+    Two saved snapshots -> aggregate and per-rank deltas (who got
+    slower, whose HBM grew, which alerts appeared).
+
+``--format json``
+    Print the raw fleet document instead of the dashboard.
+
+``--smoke``
+    Self-contained in-process acceptance check (<15 s CPU, no separate
+    processes): start a telemetry endpoint, register it in a temp fleet
+    dir, scrape it with an embedded collector, assert rank-attributed
+    merged series, a histogram overflow rendered as ``>max`` (never 0),
+    one synthetic page-severity alert firing exactly once with its
+    flight dump captured, and a collector flight dump carrying a valid
+    ``fleet`` block.  Exit 0/1.
+
+Scraped-quantile convention: a p50/p99 that falls in the histogram's
++Inf overflow bucket arrives as JSON ``null`` and renders ``>max`` —
+an off-scale tail must never read as a healthy zero.
+
+Usage:
+    python tools/fleetwatch.py --url http://127.0.0.1:9102
+    python tools/fleetwatch.py --fleet-dir /tmp/fleet --watch 5
+    python tools/fleetwatch.py --url ... --snapshot before.json
+    python tools/fleetwatch.py --diff before.json after.json
+    python tools/fleetwatch.py --smoke
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_mx():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return "%.1f%s" % (n, unit) if unit != "B" else "%dB" % int(n)
+        n /= 1024.0
+
+
+def _fmt_val(v, stat="value", fmt="%.4g"):
+    """None is overflow for quantile stats (render >max, never 0) and
+    plain no-data otherwise."""
+    if v is None:
+        return ">max" if stat in ("p50", "p90", "p99", "p999") else "-"
+    return fmt % v
+
+
+def _finest_points(series_entry):
+    tiers = series_entry.get("tiers") or []
+    pts = (tiers[0].get("points") or []) if tiers else []
+    return [p[1] for p in pts]
+
+
+def render(doc, width=48):
+    """ASCII dashboard of one /fleetz document."""
+    from mxnet_tpu.telemetry.timeseries import sparkline
+    agg = doc.get("aggregates") or {}
+    per_rank = agg.get("per_rank") or {}
+    targets = doc.get("targets") or {}
+    alerts = (doc.get("alerts") or {}).get("active") or []
+    pages = sum(1 for a in alerts if a.get("severity") == "page")
+    p99 = agg.get("serving_p99_seconds")
+    p99_txt = (">max" if agg.get("serving_p99_off_scale")
+               else _fmt_val(p99))
+    lines = []
+    lines.append("fleet %s  targets=%d  alerts=%d active (%d page)"
+                 % (doc.get("fleet_dir") or doc.get("url", ""),
+                    len(targets), len(alerts), pages))
+    lines.append("  step_rate=%s/s  mfu=%s%%  skew=%sx  "
+                 "hbm_frac=%s  serving_p99=%s"
+                 % (_fmt_val(agg.get("step_rate")),
+                    _fmt_val(agg.get("mfu_pct")),
+                    _fmt_val(agg.get("straggler_skew")),
+                    _fmt_val(agg.get("hbm_used_frac")), p99_txt))
+    hdr = "%-10s %-7s %9s %7s %10s %-12s %s" % (
+        "rank", "role", "step/s", "mfu%", "hbm", "health", "alerts")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    ids = sorted(set(targets) | set(per_rank))
+    for tid in ids:
+        pr = per_rank.get(tid) or {}
+        t = targets.get(tid) or {}
+        step_s = pr.get("step_seconds")
+        rate = (1.0 / step_s) if step_s else None
+        mine = [a for a in alerts
+                if a.get("group") == tid or tid == a.get("offender")]
+        stale = t.get("last_ok_age_seconds") is None
+        health = ("unscraped" if stale
+                  else (pr.get("verdict") or pr.get("status") or "ok"))
+        lines.append("%-10s %-7s %9s %7s %10s %-12s %s" % (
+            tid, pr.get("role") or t.get("role") or "?",
+            _fmt_val(rate, fmt="%.3g"), _fmt_val(pr.get("mfu_pct"),
+                                                 fmt="%.3g"),
+            _fmt_bytes(pr.get("hbm_bytes")), health[:12],
+            ",".join("%s(%s)" % (a["rule"], a["severity"])
+                     for a in mine) or "-"))
+    series = doc.get("series") or {}
+    spark_rows = []
+    for key in sorted(series):
+        s = series[key]
+        metric, stat = s.get("metric"), s.get("stat")
+        rank = (s.get("labels") or {}).get("rank")
+        if metric == "step_seconds_ewma" and stat == "value":
+            spark_rows.append(("step_s %s" % rank, key))
+        elif rank == "fleet" and metric in (
+                "fleet_step_rate", "fleet_straggler_skew",
+                "fleet_mfu_pct", "fleet_serving_p99_seconds"):
+            spark_rows.append((metric, key))
+    if spark_rows:
+        lines.append("")
+        for label, key in spark_rows:
+            vals = _finest_points(series[key])
+            last = next((v for v in reversed(vals) if v is not None),
+                        None)
+            stat = series[key].get("stat", "value")
+            overflow = (stat in ("p50", "p99")
+                        and any(v is None for v in vals))
+            lines.append("%-28s %s last=%s" % (
+                label[:28], sparkline(vals, width),
+                ">max" if overflow and last is None
+                else _fmt_val(last, stat)))
+    return "\n".join(lines) + "\n"
+
+
+def _fetch(url, window=None):
+    full = url.rstrip("/") + "/fleetz"
+    if window is not None:
+        full += "?window=%g" % window
+    with urllib.request.urlopen(full, timeout=10) as resp:
+        doc = json.loads(resp.read().decode())
+    doc["url"] = url
+    return doc
+
+
+def _embedded(fleet_dir, interval):
+    """Start an in-process collector over the fleet dir; returns a
+    zero-argument fetcher."""
+    _import_mx()
+    from mxnet_tpu.telemetry import fleet
+    c = fleet.start_collector(fleet_dir=fleet_dir, interval=interval)
+    c.sweep()  # first paint needs data before the first tick elapses
+
+    def fetch(window=None):
+        return c.fleetz_doc(window=window)
+    return fetch
+
+
+def _diff(path_a, path_b, out=sys.stdout):
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    aa, ba = a.get("aggregates") or {}, b.get("aggregates") or {}
+    out.write("aggregates:\n")
+    for k in sorted(set(aa) | set(ba)):
+        if k in ("per_rank", "hbm_owner_bytes", "models"):
+            continue
+        va, vb = aa.get(k), ba.get(k)
+        if va != vb:
+            out.write("  %-24s %s -> %s\n"
+                      % (k, _fmt_val(va), _fmt_val(vb)))
+    pa, pb = aa.get("per_rank") or {}, ba.get("per_rank") or {}
+    for tid in sorted(set(pa) | set(pb)):
+        ra, rb = pa.get(tid) or {}, pb.get(tid) or {}
+        deltas = []
+        for k in ("step_seconds", "mfu_pct", "hbm_bytes", "verdict"):
+            if ra.get(k) != rb.get(k):
+                deltas.append("%s: %s -> %s" % (k, ra.get(k), rb.get(k)))
+        if not ra:
+            deltas.insert(0, "appeared")
+        if not rb:
+            deltas.insert(0, "vanished")
+        if deltas:
+            out.write("%-10s %s\n" % (tid, "; ".join(deltas)))
+    al_a = {(x["rule"], x["group"])
+            for x in (a.get("alerts") or {}).get("active") or []}
+    al_b = {(x["rule"], x["group"])
+            for x in (b.get("alerts") or {}).get("active") or []}
+    for rule, group in sorted(al_b - al_a):
+        out.write("alert fired: %s on %s\n" % (rule, group))
+    for rule, group in sorted(al_a - al_b):
+        out.write("alert resolved: %s on %s\n" % (rule, group))
+    return 0
+
+
+def _smoke():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("MXNET_FLEET_DIR", None)
+    _import_mx()
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="fleetwatch_smoke_")
+    dump_path = os.path.join(tmp, "flight_self.json")
+    os.environ["MXNET_FLIGHT_RECORDER_PATH"] = dump_path
+    from mxnet_tpu import telemetry, tracing
+    from mxnet_tpu.telemetry import fleet
+
+    port = telemetry.start_http_server(port=0)
+    # synthetic signals: a step gauge (drives fleet_step_rate) and a
+    # serving histogram whose only sample is off-scale -> p99 overflow
+    telemetry.gauge(
+        "step_seconds_ewma",
+        "exponentially weighted moving average of the step interval"
+    ).set(0.05)
+    telemetry.histogram(
+        "serving_request_seconds",
+        "Request wall time from submit to completion").observe(1e9)
+    fleet.register_endpoint(port, fleet_dir=tmp)
+    fleet.register_rule(fleet.AlertRule(
+        "smoke_step_rate", kind="threshold", severity="page",
+        metric="fleet_step_rate", threshold=0.0,
+        offender="step_seconds",
+        help="synthetic smoke rule: any positive fleet step rate"),
+        replace=True)
+    c = fleet.start_collector(fleet_dir=tmp, interval=0.2, debounce=60.0)
+    deadline = time.time() + 10.0
+    fired = 0
+    while time.time() < deadline:
+        fired = telemetry.value("fleet_alerts_total",
+                                rule="smoke_step_rate", severity="page")
+        if fired and os.path.exists(dump_path):
+            break
+        time.sleep(0.1)
+    time.sleep(0.5)  # extra ticks: the firing alert must not re-fire
+    doc = c.fleetz_doc()
+    out = render(doc)
+    scrapes = telemetry.value("fleet_scrape_total", target="worker0")
+    p99 = c.store.latest("serving_request_seconds", "p99", "worker0")
+    checks = {
+        "self_scrape": scrapes >= 2,
+        "rank_attributed": any(
+            (s.get("labels") or {}).get("rank") == "worker0"
+            for s in doc["series"].values()),
+        "alert_fired_once": telemetry.value(
+            "fleet_alerts_total", rule="smoke_step_rate",
+            severity="page") == 1,
+        "flight_dump_captured": os.path.exists(dump_path),
+        "overflow_renders_gtmax": p99 is None and ">max" in out,
+    }
+    # the collector's own dump must carry a schema-valid fleet block
+    collector_dump = tracing.flight.dump(reason="manual")
+    block_ok = False
+    if collector_dump:
+        with open(collector_dump) as f:
+            dumped = json.load(f)
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        import merge_traces
+        problems = merge_traces.validate_flight_dump(dumped)
+        block_ok = "fleet" in dumped and not problems
+        if problems:
+            for p in problems:
+                print("validate: %s" % p, file=sys.stderr)
+    checks["collector_dump_fleet_block"] = block_ok
+    telemetry.stop_http_server()
+    fleet.reset()
+    ok = all(checks.values())
+    print(json.dumps({"probe": "fleetwatch", "ok": ok,
+                      "scrapes": scrapes, **checks}))
+    return 0 if ok else 1
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(
+        description="live fleet dashboard over the telemetry fleet "
+                    "control plane (see docs/observability.md 'Fleet')")
+    ap.add_argument("--url", default=None,
+                    help="a running collector's base URL "
+                         "(e.g. http://127.0.0.1:9102)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="run an embedded collector over this fleet "
+                         "directory (default: $MXNET_FLEET_DIR)")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="embedded collector scrape interval seconds")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="refresh the dashboard every SECS")
+    ap.add_argument("--window", type=float, default=None,
+                    help="sparkline window seconds")
+    ap.add_argument("--format", choices=("ascii", "json"),
+                    default="ascii")
+    ap.add_argument("--snapshot", nargs="?", const="-", default=None,
+                    metavar="FILE",
+                    help="write the raw fleet JSON to FILE ('-'=stdout)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="diff two saved snapshots")
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process acceptance smoke (no server needed)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke()
+    if args.diff:
+        return _diff(args.diff[0], args.diff[1])
+
+    if args.url:
+        def fetch(window=None):
+            return _fetch(args.url, window=window)
+    else:
+        fleet_dir = args.fleet_dir or os.environ.get("MXNET_FLEET_DIR")
+        if not fleet_dir:
+            ap.error("need --url, --fleet-dir or MXNET_FLEET_DIR")
+        fetch = _embedded(fleet_dir, args.interval)
+    _import_mx()
+
+    doc = fetch(window=args.window)
+    if args.snapshot is not None:
+        text = json.dumps(doc, indent=2, sort_keys=True, default=str)
+        if args.snapshot == "-":
+            print(text)
+        else:
+            with open(args.snapshot, "w") as f:
+                f.write(text)
+            print("wrote %s" % args.snapshot)
+        return 0
+
+    while True:
+        if args.format == "json":
+            out = json.dumps(doc, indent=2, sort_keys=True, default=str)
+        else:
+            out = render(doc)
+        if args.watch is not None:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        if args.watch is None:
+            return 0
+        time.sleep(args.watch)
+        doc = fetch(window=args.window)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -107,9 +107,11 @@ def validate_flight_dump(doc):
 
     Covers the ring events and every post-mortem block the recorder has
     grown since PR 3: ``programs`` (health cost records), ``atlas``
-    (per-scope attribution tables) and ``timeseries`` (the trailing
-    metric window) — so a merged multi-process dump set fails loudly on
-    a malformed block instead of silently dropping evidence."""
+    (per-scope attribution tables), ``timeseries`` (the trailing metric
+    window) and ``fleet`` (the collector's merged target table, derived
+    aggregates and alert state) — so a merged multi-process dump set
+    fails loudly on a malformed block instead of silently dropping
+    evidence."""
     errors = []
     if not isinstance(doc.get("events"), list):
         errors.append("events missing or not a list")
@@ -196,6 +198,37 @@ def validate_flight_dump(doc):
                                 "timeseries[%s].points[%d]: expected "
                                 "[t, value|null]" % (key, j))
                             break
+
+    fleet = doc.get("fleet")
+    if fleet is not None:
+        if not isinstance(fleet, dict):
+            errors.append("fleet: not an object")
+        else:
+            targets = fleet.get("targets")
+            if not isinstance(targets, dict):
+                errors.append("fleet: targets not an object")
+            else:
+                for tid, t in targets.items():
+                    if not isinstance(t, dict):
+                        errors.append("fleet.targets[%s]: not an object"
+                                      % tid)
+                        continue
+                    for k in ("role", "port"):
+                        if t.get(k) is None:
+                            errors.append("fleet.targets[%s]: missing %s"
+                                          % (tid, k))
+            if not isinstance(fleet.get("aggregates"), dict):
+                errors.append("fleet: aggregates not an object")
+            alerts = fleet.get("alerts")
+            if not isinstance(alerts, dict) \
+                    or not isinstance(alerts.get("active"), list):
+                errors.append("fleet: alerts.active not a list")
+            else:
+                for j, a in enumerate(alerts["active"]):
+                    if not isinstance(a, dict) \
+                            or not isinstance(a.get("rule"), str):
+                        errors.append("fleet.alerts.active[%d]: missing "
+                                      "rule" % j)
     return errors
 
 
