@@ -46,6 +46,7 @@ from .executor import Executor
 from . import initializer
 from . import initializer as init
 from . import optimizer
+from . import amp
 from . import metric
 from . import lr_scheduler
 from . import callback

@@ -297,8 +297,12 @@ class Executor:
 
     # env flags that select a different fused-step program; they join the
     # program cache key so a toggle takes effect without a rebind (same
-    # contract as ops/registry.py env_keys)
-    STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP", "MXNET_TPU_MESH_STEP")
+    # contract as ops/registry.py env_keys).  MXNET_TPU_BF16 decides array
+    # dtypes at BIND time, but it also selects per-slot mp update_fns
+    # closure-captured by the step program — a mid-process flip must
+    # recompile, not reuse.
+    STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP", "MXNET_TPU_MESH_STEP",
+                     "MXNET_TPU_BF16")
 
     def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
                  args_grad: Dict[str, Any], grad_req: Dict[str, str],
@@ -377,9 +381,18 @@ class Executor:
         existing single-device keys are unchanged)."""
         return (self._mesh_sig,) if self._mesh_sig is not None else ()
 
+    def _dtype_sig(self):
+        """Bound-argument dtype signature.  Joins forward program cache
+        keys next to mesh_sig: dtypes are fixed per binding (every
+        adoption path casts to the bound dtype), but serving hot-swap
+        re-points ``_arg_params`` and a bf16-weights binding must never
+        share a program slot with an fp32 one."""
+        return tuple(np.dtype(self.arg_dict[n].dtype).name
+                     for n in self.arg_names)
+
     def _fwd_key(self, train: bool):
         return ("fwd", bool(train)) + self._plan_env(train) \
-            + self._mesh_key()
+            + self._mesh_key() + self._dtype_sig()
 
     def _fwd_fn(self, train: bool):
         key = self._fwd_key(train)
@@ -415,7 +428,8 @@ class Executor:
         return self._jitted[key]
 
     def _fwdbwd_key(self):
-        return ("fwdbwd",) + self._plan_env(True) + self._mesh_key()
+        return ("fwdbwd",) + self._plan_env(True) + self._mesh_key() \
+            + self._dtype_sig()
 
     def _fwd_bwd_fn(self):
         """Single compiled program: forward + vjp-backward (+aux update)."""
@@ -584,15 +598,32 @@ class Executor:
         return args, auxs
 
     def _ograds_for(self, shapes):
-        """Ones head-gradients for a {arg_name: shape} dict (cached shape
-        inference).  The mesh step passes full-batch shapes here; the bound
-        per-device shapes come from ``_default_ograds``."""
+        """Ones head-gradients for a {arg_name: shape} dict (cached
+        shape+dtype inference).  The mesh step passes full-batch shapes
+        here; the bound per-device shapes come from ``_default_ograds``.
+        Output dtypes come from abstract evaluation of the plan under the
+        bound argument dtypes — ``jax.vjp`` requires cotangent dtype ==
+        output dtype, and bf16 bindings produce bf16 heads (fp32 for heads
+        that reduce in fp32, e.g. SoftmaxOutput on low-precision input)."""
         shape_key = tuple(tuple(shapes[n]) for n in self.arg_names)
-        cached = self._jitted.get(("oshapes", shape_key))
+        key = ("oshapes", shape_key, self._dtype_sig())
+        cached = self._jitted.get(key)
         if cached is None:
-            _, cached, _ = self._symbol.infer_shape(**shapes)
-            self._jitted[("oshapes", shape_key)] = cached
-        return [jnp.ones(s, np.float32) for s in cached]
+            _, oshapes, _ = self._symbol.infer_shape(**shapes)
+            plan = self._plan(True)
+            avals = {n: jax.ShapeDtypeStruct(tuple(shapes[n]),
+                                             np.dtype(self.arg_dict[n].dtype))
+                     for n in self.arg_names}
+            aux_avals = {n: jax.ShapeDtypeStruct(
+                self.aux_dict[n].shape, np.dtype(self.aux_dict[n].dtype))
+                for n in self.aux_names}
+            kstruct = jax.ShapeDtypeStruct((plan.n_rng, 2), np.uint32)
+            outs = jax.eval_shape(
+                lambda a, x, k: plan.execute(a, x, k)[0],
+                avals, aux_avals, kstruct)
+            cached = [(s, o.dtype) for s, o in zip(oshapes, outs)]
+            self._jitted[key] = cached
+        return [jnp.ones(s, dt) for s, dt in cached]
 
     def _default_ograds(self):
         """Ones head-gradients with shapes from (cached) shape inference."""
@@ -646,7 +677,7 @@ class Executor:
             skey = ("fwdsig", bool(is_train),
                     tuple(self.arg_dict[n].shape
                           for n in self.arg_names)) + plan_env \
-                + self._mesh_key()
+                + self._mesh_key() + self._dtype_sig()
             if skey in self._jitted:
                 _PROG_HITS.labels(op="Executor::Forward").inc()
             else:

@@ -203,7 +203,7 @@ class ModuleFusedStep:
         self._pools = [DonationPool() for _ in self._eg.execs]
         self._pending = None
         self._unsupported = False
-        self._structural_ok = None
+        self._structural_ok = {}     # env tuple -> bool
         self._mesh_cache = None      # (key, (mesh, rules, dp_axis)|None)
         self._meshed = False         # handles currently hold mesh globals
         self._mesh_outputs = None    # full-batch outputs of the last step
@@ -275,9 +275,14 @@ class ModuleFusedStep:
         for ex in self._eg.execs:
             if ex._monitor is not None or ex._group2ctx:
                 return False
-        if self._structural_ok is None:
-            self._structural_ok = self._check_structure()
-        return self._structural_ok
+        # keyed by the step env values (bound dtypes are fixed, but the
+        # dtype gate in supports_fused depends on optimizer mp config and
+        # a stale cached verdict must not survive an env flip)
+        env = _env_tuple()
+        ok = self._structural_ok.get(env)
+        if ok is None:
+            ok = self._structural_ok[env] = self._check_structure()
+        return ok
 
     def _check_structure(self):
         m = self._mod
@@ -305,12 +310,21 @@ class ModuleFusedStep:
         ndev = len(self._eg.execs)
         arity = opt_.fused_state_arity()
         # validate any pre-existing (e.g. preloaded) updater states before
-        # touching counts or consuming the pending feed
+        # touching counts or consuming the pending feed.  Expected layout
+        # is per-slot: a low-precision weight's state carries the
+        # master-fp32 leaf on top of the optimizer's own arity.
         from . import optimizer as _opt
         states = m._updater.states
         for slot, st in states.items():
-            leaves = _opt.fused_state_leaves(st)
-            if leaves is None or len(leaves) != arity:
+            i, k = divmod(slot, ndev)
+            if not (0 <= i < len(m._param_names) and k < ndev):
+                self._unsupported = True
+                self.flush_eager()
+                return False
+            w = self._eg.execs[k].arg_dict.get(m._param_names[i])
+            mp = w is not None and opt_.fused_mp(w)
+            leaves = _opt.fused_state_leaves(st, mp)
+            if leaves is None or len(leaves) != arity + (1 if mp else 0):
                 self._unsupported = True
                 self.flush_eager()
                 return False
@@ -337,16 +351,33 @@ class ModuleFusedStep:
                 out.extend(self._slots_for_device_one(ex, i, k, ndev))
         return out
 
+    def _slot_mp(self, ex, name):
+        """Whether this param's slot is multi-precision (bf16/f16 weight
+        with a master-fp32 leaf prepended to its flat state)."""
+        return self._mod._optimizer.fused_mp(ex.arg_dict[name])
+
+    def _slot_leaves(self, ex, name, state):
+        from . import optimizer as _opt
+        return _opt.fused_state_leaves(state, self._slot_mp(ex, name))
+
+    def _update_fns(self, ex, slots):
+        """Per-slot traced update: the mp wrapper for low-precision
+        weights, the plain fused core for fp32 ones — mixed layouts
+        (bf16 conv weights + fp32 batchnorm scales) fuse into one
+        program."""
+        opt_ = self._mod._optimizer
+        return [opt_.fused_update_mp if self._slot_mp(ex, s[0])
+                else opt_.fused_update for s in slots]
+
     def _gather_update_inputs(self, ex, k, slots):
         """Pool-guarded param/state buffers + per-slot scalar arrays."""
-        from . import optimizer as _opt
         m = self._mod
         pool = self._pools[k]
         states = m._updater.states
         pvals, svals = [], []
         for name, slot, _, _, _ in slots:
             pvals.append(pool.take(("w", name), ex.arg_dict[name]))
-            leaves = _opt.fused_state_leaves(states[slot])
+            leaves = self._slot_leaves(ex, name, states[slot])
             svals.append(tuple(pool.take(("s", slot, j), leaf)
                                for j, leaf in enumerate(leaves)))
         lrs = jnp.asarray([s[2] for s in slots], jnp.float32)
@@ -355,12 +386,11 @@ class ModuleFusedStep:
         return pvals, svals, lrs, wds, ts
 
     def _writeback(self, ex, k, slots, new_p, new_s):
-        from . import optimizer as _opt
         pool = self._pools[k]
         states = self._mod._updater.states
         for (name, slot, _, _, _), w, st in zip(slots, new_p, new_s):
             pool.give(("w", name), ex.arg_dict[name], w)
-            leaves = _opt.fused_state_leaves(states[slot])
+            leaves = self._slot_leaves(ex, name, states[slot])
             for j, (leaf, arr) in enumerate(zip(leaves, st)):
                 pool.give(("s", slot, j), leaf, arr)
 
@@ -392,7 +422,7 @@ class ModuleFusedStep:
         keys = ex._keys(plan)
         ex._last_keys = keys
         ogs = ex._default_ograds()
-        update_fns = [opt_.fused_update] * len(slots)
+        update_fns = self._update_fns(ex, slots)
         first_run = ex._step_key() not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns)
         if first_run and _health.enabled:
@@ -441,7 +471,7 @@ class ModuleFusedStep:
                     gvals.append([ex.grad_dict[name]._data])
             rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
             first_run = ex._update_key() not in ex._jitted
-            fn = ex.update_program([opt_.fused_update] * len(slots))
+            fn = ex.update_program(self._update_fns(ex, slots))
             if first_run and k == 0 and _health.enabled:
                 _health.register_program(
                     "update", fn, (pvals, svals, gvals, lrs, wds, ts,
@@ -465,8 +495,11 @@ class ModuleFusedStep:
             states[slot] = opt_.create_state_multi_precision(slot, w)
             m._updater.states_synced[slot] = True
         opt_._update_count(slot)
-        return [(name, slot, opt_._get_lr(slot), opt_._get_wd(slot),
-                 opt_._index_update_count[slot])]
+        t = opt_._index_update_count[slot]
+        # host-side lr corrections (Adam's f64 bias fold) ride in the
+        # captured lr so the traced program matches the eager oracle
+        return [(name, slot, opt_.fused_slot_lr(opt_._get_lr(slot), t),
+                 opt_._get_wd(slot), t)]
 
     # -- mesh (GSPMD) path ------------------------------------------------
     def on_mesh_change(self):
@@ -549,8 +582,9 @@ class ModuleFusedStep:
                 states[sib] = states[base]
                 m._updater.states_synced[sib] = True
                 opt_._index_update_count[sib] = cnt
-            out.append((name, base, opt_._get_lr(base), opt_._get_wd(base),
-                        cnt))
+            out.append((name, base,
+                        opt_.fused_slot_lr(opt_._get_lr(base), cnt),
+                        opt_._get_wd(base), cnt))
         return out
 
     def _take_mesh(self, slot, handles, sharding):
@@ -593,7 +627,9 @@ class ModuleFusedStep:
             sh = psh(name, ex.arg_dict[name].shape)
             pvals.append(self._take_mesh(
                 ("w", name), [e.arg_dict[name] for e in execs], sh))
-            leaves = _opt.fused_state_leaves(states[slot])
+            # mp slots: leaf 0 is the master-fp32 copy — same shape as the
+            # param, so it inherits the param's sharding like every moment
+            leaves = self._slot_leaves(ex, name, states[slot])
             svals.append(tuple(
                 pool.take_sharded(("s", slot, j), leaf, sh)
                 for j, leaf in enumerate(leaves)))
@@ -633,7 +669,7 @@ class ModuleFusedStep:
         pshardings = [psh(s[0], ex.arg_dict[s[0]].shape) for s in slots]
         mesh_sig = (tuple(sorted(mesh.shape.items())),
                     tuple(str(sh.spec) for sh in pshardings))
-        update_fns = [opt_.fused_update] * len(slots)
+        update_fns = self._update_fns(ex, slots)
         first_run = ex._step_key(mesh_sig) not in ex._jitted
         fn = ex.step_program([s[0] for s in slots], update_fns,
                              mesh_sig=mesh_sig, param_shardings=pshardings)
@@ -653,7 +689,7 @@ class ModuleFusedStep:
             pool.give(("w", name), ex.arg_dict[name], w)
             for e in execs[1:]:
                 e.arg_dict[name]._data = w
-            leaves = _opt.fused_state_leaves(states[slot])
+            leaves = self._slot_leaves(ex, name, states[slot])
             for j, (leaf, arr) in enumerate(zip(leaves, st)):
                 pool.give(("s", slot, j), leaf, arr)
         for n, v in zip(ex.aux_names, new_aux):
@@ -690,7 +726,8 @@ class ModuleFusedStep:
             st = states.get(base)
             if st is None:
                 continue
-            leaves = _opt.fused_state_leaves(st) or []
+            mp = opt_.fused_mp(execs[0].arg_dict[name])
+            leaves = _opt.fused_state_leaves(st, mp) or []
             for j, leaf in enumerate(leaves):
                 leaf._data = jax.device_put(
                     leaf._data, execs[0]._ctx.jax_device)
@@ -754,19 +791,23 @@ class TrainerFusedUpdate:
         ncty = len(tr._contexts)
         per_dev = [{"p": [], "s": [], "g": [], "lr": [], "wd": [], "t": []}
                    for _ in range(ncty)]
+        update_fns = []
         # eager order: param-major, device-minor — each device's updater
         # shares the optimizer, so the update count really does advance
         # once per (param, device) visit
         for i, p in live:
             datas, grads = p.list_data(), p.list_grad()
+            mp = opt_.fused_mp(datas[0])
+            update_fns.append(opt_.fused_update_mp if mp
+                              else opt_.fused_update)
             for k, upd in enumerate(tr._updaters):
                 w = datas[k]
                 if i not in upd.states:
                     upd.states[i] = \
                         opt_.create_state_multi_precision(i, w)
                     upd.states_synced[i] = True
-                leaves = _opt.fused_state_leaves(upd.states[i])
-                if leaves is None or len(leaves) != arity:
+                leaves = _opt.fused_state_leaves(upd.states[i], mp)
+                if leaves is None or len(leaves) != arity + (1 if mp else 0):
                     self._unsupported = True
                     return False
                 opt_._update_count(i)
@@ -775,7 +816,8 @@ class TrainerFusedUpdate:
                 d["s"].append(tuple(self._pools[k].take((i, j), leaf)
                                     for j, leaf in enumerate(leaves)))
                 d["g"].append([grads[k]._data])
-                d["lr"].append(opt_._get_lr(i))
+                d["lr"].append(opt_.fused_slot_lr(
+                    opt_._get_lr(i), opt_._index_update_count[i]))
                 d["wd"].append(opt_._get_wd(i))
                 d["t"].append(opt_._index_update_count[i])
         rescale = jnp.asarray(opt_.rescale_grad, jnp.float32)
@@ -784,8 +826,7 @@ class TrainerFusedUpdate:
         first_run = fn is None
         if fn is None:
             from .executor import build_update_program
-            fn = build_update_program([opt_.fused_update] * len(live),
-                                      donate_params=False)
+            fn = build_update_program(update_fns, donate_params=False)
             self._programs[env] = fn
         if first_run and _health.enabled and per_dev:
             d0 = per_dev[0]
@@ -812,7 +853,8 @@ class TrainerFusedUpdate:
                 p.list_data()[k]._data = w
                 if _memwatch.enabled:
                     _memwatch.tag("params", w)
-                leaves = _opt.fused_state_leaves(tr._updaters[k].states[i])
+                leaves = _opt.fused_state_leaves(
+                    tr._updaters[k].states[i], opt_.fused_mp(p.list_data()[k]))
                 for j, (leaf, arr) in enumerate(zip(leaves, st)):
                     pool.give((i, j), leaf, arr)
         return True
@@ -936,14 +978,16 @@ class TrainerMeshUpdate:
         gsh = NamedSharding(mesh, P("dp"))
         # validate/create every state BEFORE any adoption: a donation-bound
         # program must never launch with half-captured inputs
+        mps = {i: opt_.fused_mp(p.list_data()[0]) for i, p in live}
         for i, p in live:
+            nleaves = arity + (1 if mps[i] else 0)
             for k, upd in enumerate(tr._updaters):
                 if i not in upd.states:
                     upd.states[i] = opt_.create_state_multi_precision(
                         i, p.list_data()[k])
                     upd.states_synced[i] = True
-                leaves = _opt.fused_state_leaves(upd.states[i])
-                if leaves is None or len(leaves) != arity:
+                leaves = _opt.fused_state_leaves(upd.states[i], mps[i])
+                if leaves is None or len(leaves) != nleaves:
                     self._unsupported = True
                     return False
         pvals, svals, gvals, lrs, wds, ts = [], [], [], [], [], []
@@ -953,9 +997,10 @@ class TrainerMeshUpdate:
                 grads = [g._data for g in p.list_grad()]
                 pvals.append(_adopt(datas[0].shape, repl, datas))
                 per_leaf = []
-                for j in range(arity):
+                for j in range(arity + (1 if mps[i] else 0)):
                     leaves_k = [_opt.fused_state_leaves(
-                        tr._updaters[k].states[i])[j] for k in range(ndev)]
+                        tr._updaters[k].states[i], mps[i])[j]
+                        for k in range(ndev)]
                     per_leaf.append(self._take_state((i, j), leaves_k, repl))
                 svals.append(tuple(per_leaf))
                 gshape = (ndev * grads[0].shape[0],) + grads[0].shape[1:]
@@ -970,7 +1015,8 @@ class TrainerMeshUpdate:
             # one LOGICAL update per param per step: the global program IS
             # the single update (single-device count semantics)
             opt_._update_count(i)
-            lrs.append(opt_._get_lr(i))
+            lrs.append(opt_.fused_slot_lr(
+                opt_._get_lr(i), opt_._index_update_count[i]))
             wds.append(opt_._get_wd(i))
             ts.append(opt_._index_update_count[i])
         env = _env_tuple()
@@ -979,7 +1025,8 @@ class TrainerMeshUpdate:
         first_run = fn is None
         if fn is None:
             fn = build_mesh_update_program(
-                [opt_.fused_update] * len(live), ndev, repl)
+                [opt_.fused_update_mp if mps[i] else opt_.fused_update
+                 for i, p in live], ndev, repl)
             self._programs[key] = fn
         if first_run and _health.enabled:
             _health.register_program(
@@ -1003,9 +1050,10 @@ class TrainerMeshUpdate:
             _health.audit_donation("trainer_mesh_update", svals)
         for (i, p), w, st in zip(live, new_p, new_s):
             self._scatter(p.list_data(), w)
-            for j in range(arity):
+            for j in range(arity + (1 if mps[i] else 0)):
                 leaves_k = [_opt.fused_state_leaves(
-                    tr._updaters[k].states[i])[j] for k in range(ndev)]
+                    tr._updaters[k].states[i], mps[i])[j]
+                    for k in range(ndev)]
                 self._scatter_state((i, j), leaves_k, st[j])
         return True
 

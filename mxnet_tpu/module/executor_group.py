@@ -39,7 +39,7 @@ class DataParallelExecutorGroup:
                  data_shapes, label_shapes, param_names,
                  for_training, inputs_need_grad, shared_group=None,
                  fixed_param_names=None, grad_req="write", state_names=None,
-                 group2ctxs=None):
+                 group2ctxs=None, type_dict=None):
         self.symbol = symbol
         self.contexts = contexts
         self.workload = workload or [1.0] * len(contexts)
@@ -78,7 +78,9 @@ class DataParallelExecutorGroup:
             for l in (label_shapes or []):
                 shapes[l.name] = (n_i,) + l.shape[1:]
             self.execs.append(symbol.simple_bind(ctx=ctx, grad_req=req,
-                                                 group2ctx=g2c, **shapes))
+                                                 group2ctx=g2c,
+                                                 type_dict=type_dict,
+                                                 **shapes))
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
 

@@ -121,11 +121,19 @@ class Module(BaseModule):
         self._grad_req = grad_req
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        from .. import amp
+        type_dict = None
+        if amp.enabled():
+            # bind-time dtype policy: params/data bf16, labels and
+            # normalization scale/shift fp32 (see amp.type_dict_for)
+            type_dict = amp.type_dict_for(
+                self._symbol, self._data_names,
+                [l.name for l in (label_shapes or [])])
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            group2ctxs=self._group2ctxs)
+            group2ctxs=self._group2ctxs, type_dict=type_dict)
         self.binded = True
         if self._arg_params is not None:
             self._exec_group.set_params(self._arg_params, self._aux_params,

@@ -716,6 +716,13 @@ def _softmax_output(attrs, data, label):
         return grad * scale, jnp.zeros_like(l)
 
     _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    # loss head: low-precision logits go through the exp/sum reduction in
+    # f32 (keyed on input dtype, never on env — GL002); output prob stays
+    # f32 so downstream loss reduction is full precision.  The cast sits
+    # OUTSIDE the custom VJP so its transpose re-casts the f32 head
+    # gradient back to the logits' storage dtype automatically.
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        data = data.astype(jnp.float32)
     return _fwd(data, label)
 
 

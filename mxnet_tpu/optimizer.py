@@ -64,7 +64,8 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        from . import amp as _amp
+        if self.multi_precision and _amp.is_low_precision(weight.dtype):
             w32 = weight.astype(np.float32)
             state = (self.create_state(index, w32), w32)
         else:
@@ -80,7 +81,25 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
+        """Generic multi-precision step (the fused path's parity oracle):
+        the fp32 update runs against the master copy with the fp32-cast
+        gradient, then the low-precision weight is re-cast from the new
+        master.  Optimizers with dedicated mp kernels (SGD) override."""
+        if self._mp_state(weight, state):
+            inner, w32 = state
+            self.update(index, w32, grad.astype(np.float32), inner)
+            w32.copyto(weight)
+            return
         self.update(index, weight, grad, state)
+
+    def _mp_state(self, weight, state):
+        """Whether ``state`` is the eager multi-precision layout
+        ``(inner_state, master_fp32)`` for this low-precision weight."""
+        from . import amp as _amp
+        return (self.multi_precision and _amp.is_low_precision(weight.dtype)
+                and isinstance(state, tuple) and len(state) == 2
+                and isinstance(state[1], NDArray)
+                and state[1].dtype == np.float32)
 
     def set_learning_rate(self, lr):
         self.lr = lr
@@ -178,6 +197,35 @@ class Optimizer:
         ``(new_w, new_state_leaves)``.  All array args are jax values."""
         raise MXNetError("%s has no fused update" % type(self).__name__)
 
+    def fused_mp(self, weight):
+        """Whether this weight rides the fused path in multi-precision
+        form: low-precision storage with a master-fp32 leaf PREPENDED to
+        its flat state tuple, updated via ``fused_update_mp``."""
+        from . import amp as _amp
+        return self.multi_precision and _amp.is_low_precision(weight.dtype)
+
+    def fused_update_mp(self, weight, grad, state, lr, wd, rescale, t):
+        """Multi-precision twin of ``fused_update``: ``state[0]`` is the
+        master-fp32 copy, the rest are the optimizer's own leaves.  The
+        update runs in fp32 against the master (grad up-cast first) and
+        the low-precision weight is re-cast from the new master — the
+        traced mirror of the eager ``update_multi_precision`` oracle."""
+        import jax.numpy as jnp
+        master = state[0]
+        new_master, inner = self.fused_update(
+            master, grad.astype(jnp.float32), tuple(state[1:]),
+            lr, wd, rescale, t)
+        return (new_master.astype(weight.dtype),
+                (new_master,) + tuple(inner))
+
+    def fused_slot_lr(self, lr, t):
+        """Per-slot learning rate with any host-side correction folded in
+        (Adam's f64 bias correction).  The fused drivers capture lr
+        through this hook so the traced programs see exactly the lr the
+        eager update computes on the host — the master-fp32 trajectory
+        stays bit-identical to the eager oracle."""
+        return lr
+
     def atlas_scope_name(self):
         """Name the atlas uses for this optimizer's update stage inside
         fused programs (``Optimizer::<name>``).  Override to disambiguate
@@ -185,12 +233,13 @@ class Optimizer:
         return type(self).__name__
 
     def _fused_dtype_ok(self, weight):
-        # fused restricts to fp32 weights: multi-precision carries a
-        # master-fp32 copy in the state tuple with per-optimizer layout,
-        # and traced f32 scalars (lr/wd/t) would promote fp16 arithmetic
-        # to f32 where eager weak python floats keep it in fp16 — both
-        # stay on the eager oracle
-        return weight.dtype == np.float32
+        # fp32 weights always; low-precision weights only in
+        # multi-precision mode, where the update runs in f32 against the
+        # master leaf prepended to the state tuple (fused_update_mp).
+        # Low-precision WITHOUT a master stays on the eager oracle:
+        # traced f32 scalars (lr/wd/t) would promote fp16 arithmetic to
+        # f32 where eager weak python floats keep it in fp16.
+        return weight.dtype == np.float32 or self.fused_mp(weight)
 
     def _fused_attrs(self, lr, wd, rescale):
         # clip_gradient must stay a static python float: _prep_grad branches
@@ -398,15 +447,17 @@ class Adam(Optimizer):
     def fused_state_arity(self):
         return 2
 
+    def fused_slot_lr(self, lr, t):
+        # bias correction folded into lr exactly as the eager update does
+        # it — host-side f64, so the traced program and the eager oracle
+        # consume bit-identical lr values.  t is a per-slot host count at
+        # capture time; the correction never enters the trace.
+        return lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+
     def fused_update(self, weight, grad, state, lr, wd, rescale, t):
-        import jax.numpy as jnp
         from .ops import optimizer_ops as _ops
         attrs = self._fused_attrs(lr, wd, rescale)
         attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
-        # bias correction folded into lr as in eager update; t is traced so
-        # the same program serves every step
-        attrs["lr"] = lr * jnp.sqrt(1.0 - jnp.power(self.beta2, t)) \
-            / (1.0 - jnp.power(self.beta1, t))
         mean, var = state
         w, m, v = _ops._adam_update(attrs, weight, grad, mean, var)
         return w, (m, v)
@@ -690,11 +741,27 @@ class Test(Optimizer):
         state[:] = weight
 
 
-def fused_state_leaves(state):
+def fused_state_leaves(state, mp=False):
     """Flatten an updater state into a tuple of NDArray leaves for the
     fused step (``None`` -> ``()``); returns ``None`` when the structure
-    isn't fusable (non-NDArray leaves, e.g. nested multi-precision
-    holders), signalling fallback to the eager oracle."""
+    isn't fusable, signalling fallback to the eager oracle.
+
+    With ``mp=True`` the state must be the eager multi-precision layout
+    ``(inner_state, master_fp32)``; the flat fused layout PREPENDS the
+    master — ``(master, *inner_leaves)`` — matching what
+    ``fused_update_mp`` consumes and returns.  (The master can't ride
+    LAST: ``fused_update_mp`` slices ``state[1:]`` for the wrapped
+    optimizer, and a positional convention keeps the slot shape
+    independent of the inner arity.)
+    """
+    if mp:
+        if not (isinstance(state, (tuple, list)) and len(state) == 2
+                and isinstance(state[1], NDArray)):
+            return None
+        inner = fused_state_leaves(state[0])
+        if inner is None:
+            return None
+        return (state[1],) + inner
     if state is None:
         return ()
     if isinstance(state, NDArray):

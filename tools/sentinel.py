@@ -100,6 +100,17 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     # census + ledger hooks must stay at noise level, same bar as the
     # monitor/sampler
     "memwatch_overhead_pct":        ("lower",  0.00, 1.0),
+    # bf16 mixed precision (ISSUE 19).  Throughput on CPU is an
+    # emulation canary (XLA upcasts per op) that wobbles ±50% with host
+    # load at the small CPU iteration count, so the band only catches a
+    # collapse; the load-bearing rows are the footprint ratios — params
+    # must stay at ~half of fp32 and the peak must not creep back
+    # toward the fp32 peak.  Re-band on a real chip.
+    "resnet50_bf16_img_per_sec":    ("higher", 0.50, 0.0),
+    "resnet50_bf16_peak_bytes_in_use": ("lower", 0.25, float(8 << 20)),
+    # ratios are bounded [0, ~1]: absolute slack, no relative band
+    "bf16_params_ratio":            ("lower",  0.00, 0.05),
+    "bf16_params_activations_ratio": ("lower", 0.00, 0.08),
 }
 #: band for metrics not in the table: 15% relative, either direction bad
 #: is unknowable, so assume higher-is-better (throughput-style default).
@@ -169,6 +180,33 @@ def _norm_bench_parsed(parsed: dict, source: str) -> dict:
         ctx["unvalidated"] = True
     return {"round": _round_of(source), "source": os.path.basename(source),
             "kind": "bench", "metrics": metrics, "context": ctx}
+
+
+def _norm_bench_bf16(doc: dict, source: str) -> dict:
+    """bench.py --bf16 record (ISSUE 19).  The throughput row keeps the
+    model-qualified metric name the bench emitted (``resnet50_bf16_*``);
+    the footprint ratios are model-agnostic bands — on any model, bf16
+    params at more than ~half of fp32 means the cast policy broke."""
+    metrics: Dict[str, float] = {}
+
+    def put(name, v):
+        v = _num(v)
+        if v is not None:
+            metrics[name] = v
+
+    name = str(doc.get("metric") or "bf16_img_per_sec")
+    put(name, doc.get("value"))
+    put(name.replace("_img_per_sec", "_peak_bytes_in_use"),
+        doc.get("peak_bytes_in_use"))
+    put("bf16_params_ratio", doc.get("params_ratio"))
+    put("bf16_params_activations_ratio",
+        doc.get("params_activations_ratio"))
+    ctx = {k: doc[k] for k in ("model", "batch", "platform", "unit",
+                               "throughput_chip_pending", "loss_delta",
+                               "matched_convergence", "footprint_halved",
+                               "ok") if k in doc}
+    return {"round": _round_of(source), "source": os.path.basename(source),
+            "kind": "bench_bf16", "metrics": metrics, "context": ctx}
 
 
 def _norm_multichip(doc: dict, source: str) -> dict:
@@ -297,6 +335,8 @@ def normalize(doc, source: str = "<inline>") -> dict:
         return _norm_bench_parsed(doc["parsed"], source)
     if "scaling_efficiency" in doc or "n_devices" in doc:
         return _norm_multichip(doc, source)
+    if "throughput_chip_pending" in doc:                # bench.py --bf16
+        return _norm_bench_bf16(doc, source)
     if doc.get("bench") == "serving" or "sweep" in doc:
         return _norm_serving_gateway(doc, source)
     if "p99_ms" in doc or "latency_p99_ms" in doc or \

@@ -18,7 +18,12 @@ exists for:
    every bucket never compiles after warmup (per-server verdict AND the
    global Executor::Forward miss counter);
 4. **per-model attribution** — each model's bucket programs appear
-   under its own ``serving:<model>:b<bucket>:`` namespace on /programz.
+   under its own ``serving:<model>:b<bucket>:`` namespace on /programz;
+5. **bf16 params serve cleanly** — a model registered with bf16 weights
+   (integer-valued, so promotion is exact) answers bit-identically to
+   its fp32 twin and never compiles after warmup: the param dtype joins
+   the serving program cache key, so bf16 and fp32 registrations of the
+   same architecture are distinct programs, each compiled exactly once.
 
 Usage:
     python tools/serving_probe.py --smoke    # CI-sized (same coverage)
@@ -157,6 +162,31 @@ def main(argv):
                 assert key in progs, "missing %s on /programz" % key
         result["programs"] = sorted(
             n for n in progs if n.startswith("serving:"))
+
+        # -- 5. bf16 params: exact parity, zero post-warmup compiles -------
+        from mxnet_tpu import amp
+        p1_bf16 = {n: v.astype(amp.compute_dtype()) for n, v in p1.items()}
+        reg.register("rt16", sym1.tojson(), p1_bf16, {"data": (8,)},
+                     max_batch_size=4, batch_timeout_ms=1)
+        for n in (1, 2, 4):
+            X = rng.randint(-2, 3, (n, 8)).astype(np.float32)
+            want = reg.predict({"data": X}, model="rt")[0]
+            got = reg.predict({"data": X}, model="rt16")[0]
+            assert np.array_equal(got, want), \
+                "bf16 integer weights diverged from fp32 at rows=%d" % n
+        warm = telemetry.value("op_jit_cache_misses_total",
+                               op="Executor::Forward")
+        for i in range(rounds):
+            n = int(rng.choice([1, 2, 4]))
+            X = rng.randint(-2, 3, (n, 8)).astype(np.float32)
+            reg.predict({"data": X}, model="rt16")
+        after = telemetry.value("op_jit_cache_misses_total",
+                                op="Executor::Forward")
+        assert after == warm, \
+            "bf16 post-warmup compiles: %d" % (after - warm)
+        assert reg.get("rt16").health()["post_warmup_compiles"] == 0
+        result["bf16_parity"] = True
+        result["bf16_post_warmup_compiles"] = 0
     finally:
         reg.stop_all()
         health.disable()
