@@ -643,6 +643,199 @@ def bench_bf16():
     return 0 if ok else 1
 
 
+def bench_transformer():
+    """Entry for ``bench.py --transformer``: decoder-LM training
+    tokens/s + MFU through the Module fused-step path (ISSUE 20).
+
+    The workload is ``models.transformer_lm`` on a ``models.configs``
+    ladder entry, fed by ``io.SyntheticLMIter`` (deterministic
+    next-token stream), trained with SGD — the whole step in one
+    donated-buffer executable, attention dispatching to the Pallas
+    flash kernel when ``MXNET_TPU_FLASH_ATTENTION`` + the shape gates
+    allow (``attention_dispatch_total{path=...}`` says which path this
+    run actually compiled).  Reported alongside the throughput row:
+
+      - **MFU** against the chip peak from ``health.peak_tflops`` using
+        ``TransformerConfig.flops_per_token()`` (PaLM 6N+12LTd
+        convention) — the honest denominator for cross-paper compares;
+      - **atlas** per-layer flops/bytes table (which scopes own the MFU
+        gap) + the min per-program coverage;
+      - **memwatch** owner bytes (params / activations / opt_state) and
+        per-device peak;
+      - **post-warmup compiles**: jit-cache misses after the warmup
+        steps — a nonzero count means something (env key churn, shape
+        wobble) is recompiling inside the measurement window.
+
+    ``--smoke`` runs the tiny config and GATES on the last two: zero
+    post-warmup compiles and >=90%% atlas coverage (the verify-skill
+    probe).  The full run writes the sentinel verdict like the other
+    bench entries.
+    """
+    smoke = "--smoke" in sys.argv
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import health as _health
+    from mxnet_tpu import memwatch as _memwatch
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models import get_config
+    from mxnet_tpu.models.transformer import transformer_lm
+
+    ctx = mx.tpu(0) if mx.context.num_tpus() else mx.cpu(0)
+    on_cpu = ctx.device_type == "cpu"
+    cfg_name = os.environ.get(
+        "BENCH_TFM_CONFIG",
+        "tiny" if smoke else ("mini" if on_cpu else "gpt2-small"))
+    overrides = {}
+    if os.environ.get("BENCH_TFM_SEQLEN"):
+        overrides["seq_len"] = int(os.environ["BENCH_TFM_SEQLEN"])
+    elif smoke:
+        overrides["seq_len"] = 32
+    cfg = get_config(cfg_name, **overrides)
+    batch = int(os.environ.get("BENCH_TFM_BATCH",
+                               "4" if smoke else ("8" if on_cpu else "16")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "1" if on_cpu else "3"))
+    iters = int(os.environ.get("BENCH_ITERS",
+                               "2" if smoke else ("4" if on_cpu else "16")))
+    bf16 = os.environ.get("MXNET_TPU_BF16", "0") != "0"
+    dtype = "bfloat16" if bf16 else "float32"
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "0")) \
+        or _health.peak_tflops(dtype)
+
+    telemetry.enable()
+    _health.enable()
+    _health.monitor.dtype = dtype
+    _memwatch.reset()
+    _memwatch.enable()
+
+    net = transformer_lm(cfg)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",), context=[ctx])
+    mod.bind(data_shapes=[("data", (batch, cfg.seq_len))],
+             label_shapes=[("softmax_label", (batch, cfg.seq_len))])
+    mx.random.seed(7)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    mod.init_optimizer(kvstore="local", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "multi_precision": bf16})
+
+    it = mx.io.SyntheticLMIter(cfg.vocab_size, cfg.seq_len,
+                               batch_size=batch, num_batches=8, seed=0)
+
+    def next_batch():
+        try:
+            return next(it)
+        except StopIteration:
+            it.reset()
+            return next(it)
+
+    def step():
+        mod.forward_backward(next_batch())
+        mod.update()
+        return mod
+
+    def fetch(m):
+        # the make_loss head is the graph output: this D2H of the mean
+        # CE data-depends on the whole donated step chain
+        return float(m.get_outputs()[0].asnumpy().ravel()[0])
+
+    # warmup OUTSIDE _measure so the post-warmup compile count brackets
+    # exactly the measurement window (warmup pays all legitimate
+    # compiles; anything after is a cache-key bug)
+    for _ in range(warmup):
+        fetch(step())
+    misses0, _ = _health._compile_totals()
+    tokens = batch * cfg.seq_len
+    m = _measure(step, fetch, tokens, 0, iters)
+    post_compiles = int(_health._compile_totals()[0] - misses0)
+
+    flops_per_tok = cfg.flops_per_token()
+    achieved = _health.achieved_tflops(m["rate"], flops_per_tok)
+    mfu = _health.mfu_fraction(m["rate"], flops_per_tok, peak_tflops)
+    if _health.mfu_impossible(mfu, ctx.device_type):
+        print(json.dumps({"metric": "transformer_tokens_per_sec",
+                          "value": 0.0, "unit": "tokens/s/chip",
+                          "error": "impossible: %.0f%% MFU" % (100 * mfu)}))
+        return 1
+
+    from mxnet_tpu import atlas as _atlas
+    atlas_snap = _atlas.snapshot(top_k=10)
+    covs = [a.get("coverage_pct") for a in atlas_snap.values()
+            if isinstance(a, dict) and a.get("coverage_pct") is not None]
+    atlas_cov = min(covs) if covs else 0.0
+
+    snap = _memwatch.census()
+    owners = {o: rec["bytes"] for o, rec in snap["owners"].items()}
+    paths = {}
+    fam = telemetry.registry().get("attention_dispatch_total")
+    if fam is not None:
+        # samples() yields (label-values-tuple, value); sole label: path
+        paths = {lv[0]: int(v) for lv, v in fam.samples()}
+
+    finite = np.isfinite(m["last_loss"])
+    gates_ok = post_compiles == 0 and atlas_cov >= 90.0
+    ok = finite and (gates_ok if smoke else True)
+    result = {
+        "metric": "transformer_tokens_per_sec",
+        "value": round(m["rate"], 1),
+        "unit": "tokens/s/chip",
+        "config": cfg.name,
+        "vocab_size": cfg.vocab_size, "n_layers": cfg.n_layers,
+        "d_model": cfg.d_model, "n_heads": cfg.n_heads,
+        "d_ff": cfg.d_ff, "seq_len": cfg.seq_len, "batch": batch,
+        "n_params": cfg.n_params(),
+        "flops_per_token": flops_per_tok,
+        "dtype": dtype,
+        "platform": "cpu" if on_cpu else "tpu",
+        "flash_attention_env": os.environ.get(
+            "MXNET_TPU_FLASH_ATTENTION", "1"),
+        "attention_dispatch": paths,
+        "step_ms_median_blocked": round(m["step_ms_median_blocked"], 2),
+        "step_spread_pct": round(m["step_spread_pct"], 1),
+        "blocked_tokens_per_sec": round(m["blocked_rate"], 1),
+        "windowed_tokens_per_sec": round(m["windowed_rate"], 1),
+        "window_scaling_ratio": round(m["window_scaling_ratio"], 3),
+        "window_suspect": m["window_suspect"],
+        "last_loss": round(m["last_loss"], 4),
+        "achieved_tflops": round(achieved, 3),
+        "mfu_pct": round(100 * mfu, 2),
+        "post_warmup_compiles": post_compiles,
+        "atlas_coverage_min_pct": round(atlas_cov, 2),
+        "atlas": atlas_snap,
+        "params_bytes": owners.get("params", 0),
+        "activations_bytes": owners.get("activations", 0),
+        "opt_state_bytes": owners.get("opt_state", 0),
+        "peak_bytes_in_use": max(
+            (st["peak_bytes_in_use"]
+             for st in snap["devices"].values()), default=0),
+        "smoke": smoke,
+        "zero_post_warmup_compiles": post_compiles == 0,
+        "atlas_coverage_ok": atlas_cov >= 90.0,
+        "ok": bool(ok),
+    }
+    if os.environ.get("BENCH_SENTINEL", "1") != "0" and not smoke:
+        try:
+            from tools import sentinel as _sentinel
+            if os.path.exists(_sentinel.DEFAULT_BASELINE):
+                with open(_sentinel.DEFAULT_BASELINE) as f:
+                    bdoc = json.load(f)
+                cand = _sentinel.normalize(result, "bench.py --transformer")
+                rows = _sentinel.compare(bdoc, cand)
+                sys.stderr.write(_sentinel.markdown_table(rows, bdoc, cand))
+                result["sentinel"] = {
+                    "regression": bool(_sentinel.verdict_exit(rows)),
+                    "rows": [r for r in rows
+                             if r["verdict"] in ("FAIL", "WARN")],
+                }
+        except Exception as e:
+            result["sentinel"] = {"error": repr(e)[:200]}
+    out = dict(result)
+    if smoke:  # keep the smoke line greppable; the full table is --full's
+        out.pop("atlas", None)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def main():
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
@@ -1105,4 +1298,6 @@ if __name__ == "__main__":
         sys.exit(bench_multichip())
     if "--bf16" in sys.argv:
         sys.exit(bench_bf16())
+    if "--transformer" in sys.argv:
+        sys.exit(bench_transformer())
     sys.exit(main())

@@ -62,6 +62,7 @@ from . import module
 from . import module as mod
 from . import model
 from .model import FeedForward
+from . import models
 from . import contrib
 from . import profiler
 from . import monitor as _monitor_mod

@@ -300,9 +300,15 @@ class Executor:
     # contract as ops/registry.py env_keys).  MXNET_TPU_BF16 decides array
     # dtypes at BIND time, but it also selects per-slot mp update_fns
     # closure-captured by the step program — a mid-process flip must
-    # recompile, not reuse.
+    # recompile, not reuse.  The attention gates are consulted at trace
+    # time wherever a step contains attention — the MultiHeadAttention op
+    # (whose own env_keys join the plan union) or the functional
+    # parallel/ring_attention forms composed into a custom stage, which
+    # the plan's op-level union cannot see — so they are declared here
+    # too: a flip re-specializes every cached step program.
     STEP_ENV_KEYS = ("MXNET_TPU_FUSED_STEP", "MXNET_TPU_MESH_STEP",
-                     "MXNET_TPU_BF16")
+                     "MXNET_TPU_BF16", "MXNET_TPU_FLASH_ATTENTION",
+                     "MXNET_TPU_PALLAS_ATTN")
 
     def __init__(self, symbol, ctx: Context, args: Dict[str, Any],
                  args_grad: Dict[str, Any], grad_req: Dict[str, str],

@@ -47,9 +47,10 @@ _IO_WORKERS = _telemetry.gauge(
     "io_pipeline_workers",
     "Worker threads producing batches for the pipeline", ("iter",))
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
-           "LibSVMIter", "ImageDetRecordIter"]
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "SyntheticLMIter", "CSVIter", "MNISTIter", "PrefetchingIter",
+           "ResizeIter", "ImageRecordIter", "LibSVMIter",
+           "ImageDetRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -234,6 +235,88 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+
+class SyntheticLMIter(DataIter):
+    """Deterministic synthetic next-token-prediction stream for LM
+    workloads (models.transformer): data is ``(B, T)`` token ids, label
+    is the same stream shifted one position (a REAL next-token target,
+    not independent noise, so eval losses below ln(vocab) are
+    achievable).  The full corpus is generated once from ``seed`` —
+    identical across processes and runs, which is what makes bench
+    rounds and multi-host parity tests reproducible without shipping a
+    dataset.  ``num_parts``/``part_index`` follow the
+    ``parallel.mesh.host_shard_hint`` contract (each host keeps its
+    contiguous batch-row block)."""
+
+    def __init__(self, vocab_size, seq_len, batch_size=1, num_batches=16,
+                 seed=0, data_name="data", label_name="softmax_label",
+                 dtype="float32", num_parts=1, part_index=0, sharding=None):
+        super().__init__(batch_size, sharding=sharding)
+        if not 0 <= part_index < num_parts:
+            raise MXNetError("part_index %d out of range for num_parts %d"
+                             % (part_index, num_parts))
+        self.vocab_size = int(vocab_size)
+        self.seq_len = int(seq_len)
+        self.num_batches = int(num_batches)
+        self.seed = int(seed)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = np.dtype(dtype)
+        rng = np.random.RandomState(self.seed)
+        # one extra token so every position has a next-token label
+        corpus = rng.randint(0, self.vocab_size,
+                             size=self.num_batches * batch_size
+                             * self.seq_len + 1)
+        n = self.num_batches * batch_size * self.seq_len
+        self._data = corpus[:n].reshape(
+            self.num_batches, batch_size, self.seq_len).astype(self.dtype)
+        self._label = corpus[1:n + 1].reshape(
+            self.num_batches, batch_size, self.seq_len).astype(self.dtype)
+        if num_parts > 1:
+            if batch_size % num_parts:
+                raise MXNetError("batch_size %d not divisible by "
+                                 "num_parts %d" % (batch_size, num_parts))
+            self.batch_size = batch_size // num_parts
+            self._data = self._data[:, _part_slice(batch_size, part_index,
+                                                   num_parts)]
+            self._label = self._label[:, _part_slice(batch_size, part_index,
+                                                     num_parts)]
+        self.cursor = -1
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size, self.seq_len),
+                         self.dtype, layout="NT")]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size, self.seq_len),
+                         self.dtype, layout="NT")]
+
+    def reset(self):
+        self.cursor = -1
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor < self.num_batches
+
+    def _batch_array(self, v):
+        arr = nd.array(v[self.cursor], dtype=self.dtype)
+        if self.sharding is not None:
+            import jax
+            arr._data = jax.device_put(arr._data, self.sharding)
+        return arr
+
+    def getdata(self):
+        return [self._batch_array(self._data)]
+
+    def getlabel(self):
+        return [self._batch_array(self._label)]
+
+
+def _part_slice(batch, rank, nranks):
+    return slice(batch * rank // nranks, batch * (rank + 1) // nranks)
 
 
 class CSVIter(DataIter):

@@ -1,0 +1,21 @@
+"""First-class model zoo (symbol-level workloads).
+
+Reference analog: ``example/`` model definitions in the reference tree —
+promoted here into the library because the transformer LM is the
+workload class the TPU benches and the parallel/ subsystems exist for
+(ROADMAP item 1).  ``transformer`` builds decoder-only LMs as Symbol
+graphs that train through Module's fused/mesh step; ``configs`` is the
+size ladder.
+"""
+from . import configs
+from . import transformer
+from .configs import TransformerConfig, CONFIGS, get_config
+from .transformer import (transformer_lm, transformer_block,
+                          init_block_params, block_apply,
+                          pipeline_transformer, long_context_attention,
+                          moe_transformer_ffn)
+
+__all__ = ["configs", "transformer", "TransformerConfig", "CONFIGS",
+           "get_config", "transformer_lm", "transformer_block",
+           "init_block_params", "block_apply", "pipeline_transformer",
+           "long_context_attention", "moe_transformer_ffn"]

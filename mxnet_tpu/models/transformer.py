@@ -1,0 +1,194 @@
+"""Decoder-only transformer LM as a first-class Symbol workload.
+
+Reference analog: none in-tree — the reference (2018) stops at
+example/rnn word LMs; this is the beyond-parity workload ROADMAP item 1
+names.  Two layers of API:
+
+* **Symbol graph** (``transformer_lm`` / ``transformer_block``): the
+  training graph that binds through Module and runs the fused/mesh step
+  end to end — Embedding, pre-norm blocks around the
+  ``MultiHeadAttention`` op (Pallas flash kernel behind
+  ``MXNET_TPU_FLASH_ATTENTION``), gelu FFN, streaming-CE loss.
+  Parameter names are chosen so ``parallel.mesh.megatron_rules`` shards
+  a DP×TP mesh with zero configuration: ``*_query/key/value_weight`` and
+  ``*_fc1_weight`` column-parallel, ``*_out_proj_weight`` and
+  ``*_down_weight`` row-parallel, ``*_embedding_weight`` vocab-split.
+
+* **Functional block** (``init_block_params`` / ``block_apply`` +
+  the composition helpers): the SAME block math as pure jax functions
+  reusing the registered op implementations, which is what the
+  parallel/ subsystems compose — ``pipeline_transformer`` runs blocks as
+  GPipe stages, ``long_context_attention`` shards the sequence over a
+  mesh ``sp`` axis via ring attention, ``moe_transformer_ffn`` swaps the
+  dense FFN for the expert-parallel MoE layer.  Reusing the op fns (not
+  a re-implementation) is what makes the parity tests in
+  tests/test_transformer.py bit-exact.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .configs import TransformerConfig
+from ..ops.registry import OPS
+
+
+# ---------------------------------------------------------------------------
+# Symbol graph
+# ---------------------------------------------------------------------------
+def transformer_block(x, cfg: TransformerConfig, idx: int, prefix: str):
+    """One pre-norm decoder block: x + Attn(LN(x)); x + FFN(LN(x))."""
+    from .. import symbol as sym
+    n = "%sl%d_" % (prefix, idx)
+    h = sym.LayerNorm(x, name=n + "ln1")
+    a = sym.MultiHeadAttention(h, num_heads=cfg.n_heads, causal=True,
+                               name=n + "attn")
+    x = sym.elemwise_add(x, a, name=n + "attn_res")
+    h = sym.LayerNorm(x, name=n + "ln2")
+    f = sym.FullyConnected(h, num_hidden=cfg.d_ff, flatten=False,
+                           no_bias=False, name=n + "ffn_fc1")
+    f = sym.Activation(f, act_type="gelu", name=n + "ffn_gelu")
+    f = sym.FullyConnected(f, num_hidden=cfg.d_model, flatten=False,
+                           no_bias=False, name=n + "ffn_down")
+    return sym.elemwise_add(x, f, name=n + "ffn_res")
+
+
+def transformer_lm(cfg: TransformerConfig, prefix: str = "tfm_",
+                   loss: bool = True):
+    """Build the decoder LM Symbol.
+
+    ``loss=True`` (training): returns ``make_loss(mean(streaming CE))``
+    — a scalar loss head whose implicit backward seeds ones, so
+    ``Module.forward_backward`` / the fused step train it directly and
+    ``get_outputs()[0]`` IS the batch loss.  ``loss=False``: returns the
+    ``(B, T, vocab)`` logits (serving / eval).
+
+    Positions are encoded with a learned table added post-embedding
+    (gpt2 style); data is ``(B, T)`` token ids, label ``(B, T)`` next
+    tokens.
+    """
+    from .. import symbol as sym
+    data = sym.Variable("data")                       # (B, T) token ids
+    tok = sym.Embedding(data, input_dim=cfg.vocab_size,
+                        output_dim=cfg.d_model,
+                        name=prefix + "tok_embedding")
+    # learned positions: arange(T) broadcast over the batch rides the
+    # same Embedding op — slice_axis of a (1, T) iota variable would need
+    # a T-sized input; instead embed positions of `data*0 + iota` shape
+    pos_ids = sym.broadcast_like(
+        sym.expand_dims(sym.arange(0, cfg.seq_len, name=prefix + "iota"),
+                        axis=0),
+        data, name=prefix + "pos_ids")
+    pos = sym.Embedding(pos_ids, input_dim=cfg.seq_len,
+                        output_dim=cfg.d_model,
+                        name=prefix + "pos_embedding")
+    x = sym.broadcast_add(tok, pos, name=prefix + "embed_sum")
+    for i in range(cfg.n_layers):
+        x = transformer_block(x, cfg, i, prefix)
+    x = sym.LayerNorm(x, name=prefix + "final_ln")
+    logits = sym.FullyConnected(x, num_hidden=cfg.vocab_size,
+                                flatten=False, no_bias=True,
+                                name=prefix + "lm_head")
+    if not loss:
+        return logits
+    label = sym.Variable("softmax_label")             # (B, T) next ids
+    ce = sym.streaming_softmax_ce(logits, label, axis=-1,
+                                  name=prefix + "ce")
+    return sym.make_loss(sym.mean(ce), name=prefix + "loss")
+
+
+# ---------------------------------------------------------------------------
+# Functional block (shared math with the Symbol graph via the op registry)
+# ---------------------------------------------------------------------------
+_LN_ATTRS = {"axis": -1, "eps": 1e-5, "output_mean_var": False}
+
+
+def _ln(x, gamma, beta):
+    return OPS["LayerNorm"].fn(_LN_ATTRS, x, gamma, beta)[0]
+
+
+def _mha(cfg, x, wq, wk, wv, wo):
+    return OPS["MultiHeadAttention"].fn(
+        {"num_heads": cfg.n_heads, "causal": True}, x, wq, wk, wv, wo)
+
+
+def init_block_params(cfg: TransformerConfig, rng: np.random.RandomState,
+                      dtype=jnp.float32):
+    """One block's parameter dict (same shapes/orientation as the Symbol
+    graph's auto-allocated args: weights are (out, in))."""
+    d, f = cfg.d_model, cfg.d_ff
+
+    def w(*shape, scale=0.02):
+        return jnp.asarray(rng.standard_normal(shape) * scale, dtype)
+
+    return {
+        "ln1_gamma": jnp.ones((d,), dtype), "ln1_beta": jnp.zeros((d,), dtype),
+        "query_weight": w(d, d), "key_weight": w(d, d),
+        "value_weight": w(d, d), "out_proj_weight": w(d, d),
+        "ln2_gamma": jnp.ones((d,), dtype), "ln2_beta": jnp.zeros((d,), dtype),
+        "fc1_weight": w(f, d), "fc1_bias": jnp.zeros((f,), dtype),
+        "down_weight": w(d, f), "down_bias": jnp.zeros((d,), dtype),
+    }
+
+
+def block_apply(cfg: TransformerConfig, params, x):
+    """Functional pre-norm block — identical math to ``transformer_block``
+    (same op implementations out of the registry)."""
+    h = _ln(x, params["ln1_gamma"], params["ln1_beta"])
+    x = x + _mha(cfg, h, params["query_weight"], params["key_weight"],
+                 params["value_weight"], params["out_proj_weight"])
+    h = _ln(x, params["ln2_gamma"], params["ln2_beta"])
+    h = jnp.matmul(h, params["fc1_weight"].T) + params["fc1_bias"]
+    h = jax.nn.gelu(h, approximate=False)
+    h = jnp.matmul(h, params["down_weight"].T) + params["down_bias"]
+    return x + h
+
+
+# ---------------------------------------------------------------------------
+# Parallel composition
+# ---------------------------------------------------------------------------
+def long_context_attention(q, k, v, mesh, axis: str = "sp",
+                           causal: bool = True,
+                           block_size: int = 512,
+                           scale: Optional[float] = None):
+    """Sequence-parallel exact attention for contexts that don't fit one
+    chip: ``parallel.ring_attention`` over the mesh ``axis`` — K/V shards
+    rotate the ICI ring while each chip keeps its Q shard.  [B,H,T,D]
+    with T sharded on ``axis``; bit-parity vs ``blockwise_attention`` is
+    pinned by tests/test_transformer.py."""
+    from ..parallel.ring_attention import ring_attention
+    return ring_attention(q, k, v, mesh, axis=axis, causal=causal,
+                          block_size=block_size, scale=scale)
+
+
+def moe_transformer_ffn(x, moe_params, mesh=None, axis: str = "ep",
+                        k: int = 2, capacity_factor: float = 1.25):
+    """MoE FFN block body: drop-in replacement for the dense FFN half of
+    ``block_apply`` (caller keeps the pre-norm + residual).  Experts are
+    sharded over the mesh ``axis``; gelu to match the dense path."""
+    from ..parallel.moe import moe_ffn
+    T = x.shape[-2] if x.ndim > 2 else x.shape[0]
+    del T
+    flat = x.reshape(-1, x.shape[-1])
+    out = moe_ffn(flat, moe_params, mesh=mesh, axis=axis, k=k,
+                  capacity_factor=capacity_factor,
+                  act=lambda a: jax.nn.gelu(a, approximate=False))
+    return out.reshape(x.shape)
+
+
+def pipeline_transformer(mesh, axis: str, cfg: TransformerConfig,
+                         stage_params, x, n_micro: int):
+    """Run transformer blocks as GPipe pipeline stages over ``mesh[axis]``:
+    ``stage_params`` leaves carry a leading stage dim (one block per
+    stage); microbatches stream through ``parallel.pipeline``.  Parity vs
+    sequentially applying the same blocks is pinned by tests."""
+    from ..parallel.pipeline import pipeline_apply
+
+    def stage_fn(params, xb):
+        return block_apply(cfg, params, xb)
+
+    return pipeline_apply(mesh, axis, stage_fn, stage_params, x, n_micro)

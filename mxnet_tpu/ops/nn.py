@@ -440,7 +440,8 @@ def _pooling(attrs, data):
 
 @register("Activation", nin=1, aliases=("activation",),
           params={"act_type": param(["relu", "sigmoid", "tanh", "softrelu",
-                                     "softsign"], "relu", required=True)})
+                                     "softsign", "gelu"], "relu",
+                                    required=True)})
 def _activation(attrs, x):
     act = attrs["act_type"]
     if act == "relu":
@@ -451,6 +452,10 @@ def _activation(attrs, x):
         return jnp.tanh(x)
     if act == "softrelu":
         return jnp.logaddexp(x, 0.0)
+    if act == "gelu":
+        # exact (erf) formulation: the tanh approximation would put the
+        # fused and eager transformer steps on different curves
+        return jax.nn.gelu(x, approximate=False)
     return jax.nn.soft_sign(x)
 
 
@@ -570,6 +575,101 @@ def _layer_norm(attrs, data, gamma, beta):
     shape[ax] = data.shape[ax]
     out = (x32 - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
     return (out.astype(data.dtype), jnp.squeeze(mean, ax), jnp.squeeze(var, ax))
+
+
+_ATTN_DISPATCH = _telemetry.counter(
+    "attention_dispatch_total",
+    "MultiHeadAttention dispatch decisions by formulation path (trace-time)",
+    ("path",))
+
+
+def _mha_reference(q, k, v, causal, scale):
+    """XLA reference attention, [B,H,T,d].  Same math contract as the
+    Pallas flash kernel: f32 score/softmax/accumulate regardless of the
+    input dtype, and the causal mask admits position j<=i exactly."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        keep = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(keep, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o.astype(q.dtype)
+
+
+@register("MultiHeadAttention", nin=5, aliases=("multiheadattention",),
+          params={"num_heads": param(int, 0, required=True),
+                  "causal": param(bool, True)},
+          env_keys=("MXNET_TPU_FLASH_ATTENTION", "MXNET_TPU_PALLAS_ATTN"))
+def _multi_head_attention(attrs, data, query_weight, key_weight,
+                          value_weight, out_proj_weight):
+    """Decoder attention: QKV projections, scaled-dot-product over
+    ``num_heads``, output projection.  No reference analog — the
+    reference predates transformer first-class ops; the contract follows
+    ``sym.FullyConnected`` conventions (weights are (out, in), y=x·Wᵀ).
+
+    Dispatch: ``MXNET_TPU_FLASH_ATTENTION`` (default on) selects the
+    Pallas flash kernel (ops/pallas_attention.py) whenever its shape/
+    VMEM gate admits the problem; otherwise the XLA reference runs.
+    Both env gates are declared in ``env_keys`` so flipping either
+    re-specializes every cached program containing this op (GL001).
+
+    Weight names are chosen so ``parallel.mesh.megatron_rules`` shards
+    them with zero extra configuration: query/key/value_weight match the
+    column-parallel rule (P(t, None)), out_proj_weight the row-parallel
+    rule (P(None, t)).
+    """
+    import os
+    from functools import partial
+    from . import pallas_attention as pa
+    if data.ndim != 3:
+        raise MXNetError(
+            "MultiHeadAttention: data must be (batch, time, model_dim), "
+            "got %s" % (data.shape,))
+    B, T, D = data.shape
+    H = attrs["num_heads"]
+    if H <= 0 or D % H:
+        raise MXNetError(
+            "MultiHeadAttention: num_heads=%d must divide model_dim=%d"
+            % (H, D))
+    d = D // H
+    causal = attrs["causal"]
+    scale = 1.0 / (d ** 0.5)
+
+    def proj(w):
+        y = jnp.matmul(data, w.T)                     # [B,T,D]
+        return y.reshape(B, T, H, d).transpose(0, 2, 1, 3)   # [B,H,T,d]
+
+    q, k, v = proj(query_weight), proj(key_weight), proj(value_weight)
+
+    use_flash = os.environ.get("MXNET_TPU_FLASH_ATTENTION", "1") != "0" \
+        and pa.flash_attention_available(B, H, T, T, d, q.dtype)
+    ref = partial(_mha_reference, causal=causal, scale=scale)
+    if use_flash:
+        flash = partial(pa.flash_attention, causal=causal, scale=scale)
+        if pa.INTERPRET:       # test hook: force the interpreter on CPU
+            out = flash(q, k, v)
+            path = "flash_interpret"
+        else:
+            # platform resolved at LOWERING time where the jax version
+            # supports branch pruning (advisor r03), trace time otherwise
+            from ..parallel._compat import platform_dependent
+            out = platform_dependent(q, k, v, tpu=flash,
+                                     default=lambda q, k, v: ref(q, k, v))
+            path = "flash"
+    else:
+        out = ref(q, k, v)
+        path = "reference"
+    if _telemetry.enabled:
+        # one inc per compiled attention variant, not per step — the
+        # dispatch is a trace-time choice, same contract as conv_dispatch
+        # graftlint: disable=GL002 -- counts compiled variants, not calls
+        _ATTN_DISPATCH.labels(path=path).inc()
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)  # [B,T,D]
+    return jnp.matmul(out, out_proj_weight.T)
 
 
 @register("InstanceNorm", nin=3, aliases=("instancenorm",),

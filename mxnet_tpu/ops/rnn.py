@@ -91,7 +91,8 @@ def _cell_scan(mode, xproj, h0, c0, R, bR):
             # The axon PJRT plugin registers platform name "tpu" (verified:
             # the compiled LM step carries the Mosaic custom-call through
             # the tunnel), so the tpu= key covers it.
-            return jax.lax.platform_dependent(
+            from ..parallel._compat import platform_dependent
+            return platform_dependent(
                 xproj, h0, c0,
                 tpu=lambda xp, h, c: pallas_rnn.lstm_scan(xp, h, c, R, bR),
                 default=_lstm_scan_xla)

@@ -78,6 +78,20 @@ def _embedding_hint(attrs, shapes):
     return out
 
 
+def _mha_hint(attrs, shapes):
+    """MultiHeadAttention: all four projection weights are square
+    (model_dim, model_dim) in the FullyConnected (out, in) orientation."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    D = data[-1]
+    out = list(shapes)
+    for i in range(1, len(out)):
+        if out[i] is None:
+            out[i] = (D, D)
+    return out
+
+
 def _rnn_hint(attrs, shapes):
     """RNN: packed parameter size + state shapes from the TNC data shape
     (reference rnn-inl.h RNNShape/GetParamSize)."""
@@ -133,6 +147,9 @@ def install():
                       _channel_hint("axis", -1)),
         "InstanceNorm": (("data", "gamma", "beta"), (), _channel_hint()),
         "Embedding": (("data", "weight"), (), _embedding_hint),
+        "MultiHeadAttention": (("data", "query_weight", "key_weight",
+                                "value_weight", "out_proj_weight"), (),
+                               _mha_hint),
         "LeakyReLU": (("data", "gamma"), (), _channel_hint()),
         "RNN": (("data", "parameters", "state", "state_cell"), (),
                 _rnn_hint),

@@ -1,0 +1,92 @@
+"""jax version-compat shims for the parallel/ package.
+
+The manual-collective modules here track jax's SPMD API, which has moved
+twice in supported releases:
+
+* ``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+  top-level ``jax.shard_map``; older jaxlibs only ship the experimental
+  spelling.  ``from jax import shard_map`` on those raises ImportError
+  at call time and took out every tier-1 test that touches parallel/.
+* the varying-axis cast is spelled ``jax.lax.pvary`` on current jax,
+  ``jax.lax.pcast(..., to="varying")`` on the transitional releases,
+  and does not exist at all before the check_vma typing landed — there
+  the cast is a no-op because shard_map carries no varying-axis types
+  (the matching ``check_rep`` flag is probed by callers off
+  ``shard_map``'s signature, which keeps working through this shim
+  since we re-export the real function, not a wrapper).
+"""
+from __future__ import annotations
+
+import jax
+
+try:                                    # jax >= 0.6: top-level export
+    from jax import shard_map as shard_map  # type: ignore[attr-defined]
+except ImportError:                     # older jax: experimental module
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map", "pvary", "platform_dependent"]
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _platform_dependent_prunes() -> bool:
+    """True when ``jax.lax.platform_dependent`` statically prunes branches
+    that don't match the lowering platform, so a Mosaic-only ``tpu``
+    branch is harmless inside a CPU program.  Old jax lowers EVERY branch
+    into the cond and the Pallas branch then fails CPU lowering outright.
+    Probed behaviorally (one throwaway tiny compile, cached for the
+    process): version sniffing would rot, and the failure mode of a wrong
+    guess is a hard lowering error, not a silent wrong answer."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _kernel(o_ref):
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    def _tpu():
+        return pl.pallas_call(_kernel, out_shape=jax.ShapeDtypeStruct(
+            (8, 128), jnp.float32))()
+
+    def _default():
+        return jnp.zeros((8, 128), jnp.float32)
+
+    try:
+        with jax.default_device(jax.devices("cpu")[0]):
+            jax.jit(lambda: jax.lax.platform_dependent(
+                tpu=_tpu, default=_default)).lower().compile()
+        return True
+    except Exception:  # noqa: BLE001 — any lowering failure means "no"
+        return False
+
+
+def platform_dependent(*args, default=None, **platform_branches):
+    """``jax.lax.platform_dependent`` with a fallback for jax versions
+    that can't carry un-lowerable branches: there the branch is resolved
+    at TRACE time from the default backend instead of at lowering time.
+    The trace-time fallback loses one nicety — CPU-committed arrays on a
+    TPU host pick the tpu branch — which only the pruning versions can
+    express at all."""
+    if _platform_dependent_prunes():
+        return jax.lax.platform_dependent(*args, default=default,
+                                          **platform_branches)
+    fn = platform_branches.get(jax.default_backend(), default)
+    if fn is None:
+        raise ValueError(
+            "platform_dependent: no branch for backend %r and no default"
+            % jax.default_backend())
+    return fn(*args)
+
+
+def pvary(xs, axis_names):
+    """Mark ``xs`` device-varying over ``axis_names`` where the jax
+    version has varying-axis types; identity where it doesn't (those
+    versions never check, so an unmarked carry is already legal)."""
+    axes = tuple(axis_names)
+    lax = jax.lax
+    if hasattr(lax, "pvary"):
+        return lax.pvary(xs, axes)
+    if hasattr(lax, "pcast"):
+        return lax.pcast(xs, axes, to="varying")
+    return xs

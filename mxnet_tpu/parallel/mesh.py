@@ -101,8 +101,11 @@ def megatron_rules(mesh: Mesh, tp_axis: str = "tp") -> ShardingRules:
     """Default TP rules for our model zoo's parameter naming."""
     t = tp_axis
     return ShardingRules(mesh, rules=[
-        (r"(fc|dense|proj|query|key|value)\d*_weight$", P(t, None)),
+        # row-parallel (input-split) rule FIRST: out_proj/fc2/down names
+        # also end in proj_weight/fc2_weight, which the column rule below
+        # would otherwise claim — first match wins in spec_for
         (r"(out_proj|fc2|down)\w*_weight$", P(None, t)),
+        (r"(fc|dense|proj|query|key|value)\d*_weight$", P(t, None)),
         (r"conv\w*_weight$", P(t, None, None, None)),
         (r"embedding\w*_weight$", P(None, t)),
     ])

@@ -124,7 +124,7 @@ def moe_ffn(x, params: MoEParams, mesh: Optional[Mesh] = None,
         return jnp.einsum("tec,ecd->td", combine.astype(x.dtype),
                           expert_out)
 
-    from jax import shard_map
+    from ._compat import shard_map
     n = mesh.shape[axis]
     if E % n:
         raise ValueError("num_experts %d not divisible by %s=%d"

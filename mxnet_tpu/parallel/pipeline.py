@@ -74,8 +74,8 @@ class Pipeline:
         outputs0 = jnp.zeros((m,) + mb_shape, micro_in.dtype)
         prev0 = jnp.zeros(mb_shape, micro_in.dtype)
         # carries vary per stage: mark them device-varying for shard_map
-        outputs0, prev0 = lax.pcast((outputs0, prev0), (self.axis,),
-                                    to="varying")
+        from ._compat import pvary
+        outputs0, prev0 = pvary((outputs0, prev0), (self.axis,))
         (outputs, _), _ = lax.scan(tick, (outputs0, prev0),
                                    jnp.arange(ticks))
         return outputs
@@ -90,7 +90,7 @@ def pipeline_apply(mesh, axis: str, stage_fn: Callable, stage_params,
     ``num_stages`` (leaf shape (S, ...)); each stage sees its own slice.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from ._compat import shard_map
 
     s = mesh.shape[axis]
     n = x.shape[0]

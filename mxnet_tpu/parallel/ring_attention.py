@@ -15,6 +15,16 @@ This module provides:
 - ``ulysses_attention``: all-to-all head-scatter alternative (attention-heavy
   models with many heads: seq-gather/head-scatter costs one all_to_all each
   way instead of (n-1) ring hops).
+
+Trace-time env gate: these entry points consult
+``ops.pallas_attention.flash_attention_available`` (the
+``MXNET_TPU_PALLAS_ATTN`` kernel gate) when deciding the per-shard
+formulation, so the decision is baked into whatever program the caller
+traces them into.  The declared cache-key contract covering that read:
+``Executor.STEP_ENV_KEYS`` re-specializes every cached step program when
+the gate flips, and the ``MultiHeadAttention`` op declares the same keys
+in its ``env_keys`` for plan-level programs.  Callers jitting these
+functions directly own their own cache and must key it likewise.
 """
 from __future__ import annotations
 
@@ -68,8 +78,10 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
             if pa.INTERPRET:   # test hook: force the interpreter on CPU
                 return flash(q, k, v)
             # platform resolved at LOWERING time: CPU-committed arrays on
-            # a TPU host get the scan branch, never Mosaic (advisor r03)
-            return jax.lax.platform_dependent(
+            # a TPU host get the scan branch, never Mosaic (advisor r03);
+            # jax versions without branch pruning resolve at trace time
+            from ._compat import platform_dependent
+            return platform_dependent(
                 q, k, v, tpu=flash,
                 default=partial(blockwise_attention, block_size=block_size,
                                 causal=causal, scale=scale,
@@ -141,7 +153,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     def _pvary(*xs):
         # carries become device-varying after the first ppermute, so the
         # initial values must be marked varying over the ring axis too
-        return jax.lax.pcast(xs, (axis,), to="varying")
+        from ._compat import pvary
+        return pvary(xs, (axis,))
 
     def per_shard_scan(qs, ks, vs):
         idx = jax.lax.axis_index(axis)
@@ -302,10 +315,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     def per_shard(qs, ks, vs):
         if pa.INTERPRET:        # test hook: force the interpreter on CPU
             return _ring_flash(qs, ks, vs)
-        return jax.lax.platform_dependent(
+        from ._compat import platform_dependent
+        return platform_dependent(
             qs, ks, vs, tpu=_ring_flash, default=per_shard_scan)
 
-    from jax import shard_map
+    from ._compat import shard_map
     spec = P(None, None, axis, None)
     kw = {}
     if use_flash:
@@ -330,7 +344,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                       causal: bool = False, scale: Optional[float] = None):
     """Ulysses/DeepSpeed-style: all-to-all so each chip gets ALL sequence for
     a subset of heads, runs full attention locally, then all-to-alls back."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     n = mesh.shape[axis]
 

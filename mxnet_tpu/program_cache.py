@@ -484,6 +484,7 @@ def disable() -> None:
     with _lock:
         if _state.cache is None:
             _state.resolved = False
+            _state.memory_hits = 0
             return
         _log_summary()
         _uninstall_from_jax()
@@ -492,6 +493,7 @@ def disable() -> None:
         _state.root = None
         _state.info = None
         _state.resolved = False
+        _state.memory_hits = 0
 
 
 def stats() -> Dict[str, Any]:

@@ -361,6 +361,8 @@ EXEMPT = {
     "softmax_cross_entropy": "loss op: scalar loss + implicit grad; "
                              "tests/test_fused.py",
     "RNN": "tests/test_gluon_rnn.py + tests/test_pallas_rnn.py",
+    "MultiHeadAttention": "flash-vs-reference parity + op-level grads in "
+                          "tests/test_pallas_attention.py",
     "Custom": "tests/test_custom_op.py",
     "_foreach": "tests/test_benchmarks.py + control-flow tests",
     "CTCLoss": "tests/test_contrib_ops.py",

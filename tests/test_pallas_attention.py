@@ -174,6 +174,62 @@ def test_flash_bwd_kernel_exact_vs_dense():
                                    rtol=2e-5, atol=2e-5, err_msg=nme)
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["fp32", "bf16"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_mha_op_flash_matches_reference(causal, dtype):
+    """ISSUE 20: the MultiHeadAttention op's two dispatch arms agree.
+    With INTERPRET on, the op runs the Pallas flash kernel (interpret
+    mode); with it off on CPU, the Tk<2048 size gate closes and the op
+    runs the dense XLA reference — same weights, both precisions, both
+    mask modes.  This is the default-path parity the flash-by-default
+    dispatch rests on."""
+    from mxnet_tpu.ops.registry import OPS
+    B, T, Dm, Hn = 2, 128, 64, 4
+    r = np.random.default_rng(5)
+    x = jnp.asarray(r.standard_normal((B, T, Dm)) * 0.5, dtype)
+    ws = [jnp.asarray(r.standard_normal((Dm, Dm)) * 0.1, dtype)
+          for _ in range(4)]
+    attrs = {"num_heads": Hn, "causal": causal}
+    fn = OPS["MultiHeadAttention"].fn
+
+    got = fn(attrs, x, *ws)          # autouse fixture: flash (interpret)
+    assert pa.flash_attention_available(B, Hn, T, T, Dm // Hn, dtype)
+    pa.INTERPRET = False             # closes the size gate -> reference
+    assert not pa.flash_attention_available(B, Hn, T, T, Dm // Hn, dtype)
+    ref = fn(attrs, x, *ws)
+    pa.INTERPRET = True
+
+    assert got.dtype == x.dtype
+    tol = {"rtol": 2e-5, "atol": 2e-5} if dtype == jnp.float32 else \
+        {"rtol": 2e-2, "atol": 2e-2}
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32), **tol)
+
+
+def test_mha_op_flash_gradients_match_reference():
+    """Op-level backward parity: d(loss)/d(all five inputs) through the
+    flash (interpret) arm vs the reference arm."""
+    from mxnet_tpu.ops.registry import OPS
+    B, T, Dm, Hn = 1, 128, 32, 2
+    r = np.random.default_rng(11)
+    x = jnp.asarray(r.standard_normal((B, T, Dm)) * 0.5, jnp.float32)
+    ws = [jnp.asarray(r.standard_normal((Dm, Dm)) * 0.1, jnp.float32)
+          for _ in range(4)]
+    fn = OPS["MultiHeadAttention"].fn
+
+    def loss(*args):
+        return jnp.sum(fn({"num_heads": Hn, "causal": True}, *args) ** 2)
+
+    gf = jax.grad(loss, tuple(range(5)))(x, *ws)
+    pa.INTERPRET = False
+    gr = jax.grad(loss, tuple(range(5)))(x, *ws)
+    pa.INTERPRET = True
+    for a, b, nme in zip(gf, gr, ("x", "wq", "wk", "wv", "wo")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=nme)
+
+
 def test_ring_flash_bwd_8way_mesh():
     """The done-criterion shape: 8-way virtual mesh, grads vs the scan
     ring to <=1e-5 rel (VERDICT r4 item 1)."""

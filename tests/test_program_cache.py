@@ -202,6 +202,10 @@ class TestCallPath:
             pytest.skip("jax internals moved; config-mode fallback active")
         import jax
         import jax.numpy as jnp
+        # start from an empty in-process jit cache so every helper
+        # program (ones/convert_element_type) compiles — and puts —
+        # under THIS cache, regardless of what earlier tests warmed
+        jax.clear_caches()
         jax.jit(_affine)(jnp.ones((4,))).block_until_ready()
         puts = c.stats["puts"]
         assert puts >= 1

@@ -111,6 +111,17 @@ TOLERANCES: Dict[str, Tuple[str, float, float]] = {
     # ratios are bounded [0, ~1]: absolute slack, no relative band
     "bf16_params_ratio":            ("lower",  0.00, 0.05),
     "bf16_params_activations_ratio": ("lower", 0.00, 0.08),
+    # transformer LM workload (ISSUE 20).  CPU throughput on the small
+    # iteration count wobbles with host load (same story as bf16), so
+    # the bands catch a collapse, not a wobble; re-band on a real chip.
+    # The zero-tolerance compile row and the atlas floor are the
+    # load-bearing gates — they are also what --smoke asserts.
+    "transformer_tokens_per_sec":   ("higher", 0.35, 0.0),
+    "transformer_mfu_pct":          ("higher", 0.35, 0.0),
+    "transformer_step_spread_pct":  ("lower",  0.00, 8.0),
+    "transformer_post_warmup_compiles": ("lower", 0.00, 0.0),
+    "transformer_atlas_coverage_pct": ("higher", 0.00, 5.0),
+    "transformer_peak_bytes_in_use": ("lower", 0.30, float(8 << 20)),
 }
 #: band for metrics not in the table: 15% relative, either direction bad
 #: is unknowable, so assume higher-is-better (throughput-style default).
@@ -207,6 +218,36 @@ def _norm_bench_bf16(doc: dict, source: str) -> dict:
                                "ok") if k in doc}
     return {"round": _round_of(source), "source": os.path.basename(source),
             "kind": "bench_bf16", "metrics": metrics, "context": ctx}
+
+
+def _norm_bench_transformer(doc: dict, source: str) -> dict:
+    """bench.py --transformer record (ISSUE 20): decoder-LM tokens/s +
+    MFU, the zero-tolerance post-warmup compile count, the worst-program
+    atlas coverage and the per-device peak.  Metric names are
+    transformer-qualified so merging into the baseline never collides
+    with the resnet/serving rows of the same name."""
+    metrics: Dict[str, float] = {}
+
+    def put(name, v):
+        v = _num(v)
+        if v is not None:
+            metrics[name] = v
+
+    put("transformer_tokens_per_sec", doc.get("value"))
+    put("transformer_mfu_pct", doc.get("mfu_pct"))
+    put("transformer_step_spread_pct", doc.get("step_spread_pct"))
+    put("transformer_post_warmup_compiles",
+        doc.get("post_warmup_compiles"))
+    put("transformer_atlas_coverage_pct",
+        doc.get("atlas_coverage_min_pct"))
+    put("transformer_peak_bytes_in_use", doc.get("peak_bytes_in_use"))
+    ctx = {k: doc[k] for k in ("config", "batch", "seq_len", "dtype",
+                               "platform", "n_params", "unit",
+                               "attention_dispatch", "window_suspect",
+                               "last_loss", "ok") if k in doc}
+    return {"round": _round_of(source), "source": os.path.basename(source),
+            "kind": "bench_transformer", "metrics": metrics,
+            "context": ctx}
 
 
 def _norm_multichip(doc: dict, source: str) -> dict:
@@ -337,6 +378,8 @@ def normalize(doc, source: str = "<inline>") -> dict:
         return _norm_multichip(doc, source)
     if "throughput_chip_pending" in doc:                # bench.py --bf16
         return _norm_bench_bf16(doc, source)
+    if "flops_per_token" in doc:                 # bench.py --transformer
+        return _norm_bench_transformer(doc, source)
     if doc.get("bench") == "serving" or "sweep" in doc:
         return _norm_serving_gateway(doc, source)
     if "p99_ms" in doc or "latency_p99_ms" in doc or \
